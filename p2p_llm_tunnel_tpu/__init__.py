@@ -1,0 +1,23 @@
+"""p2p_llm_tunnel_tpu — a TPU-native P2P LLM tunnel + inference framework.
+
+A from-scratch rebuild of the capabilities of michaelneale/p2p-llm-tunnel
+(reference at /root/reference), with the external HTTP LLM upstream replaced by
+an in-process JAX/XLA inference engine designed for TPU:
+
+- ``protocol``  — binary multiplexed frame codec, byte-compatible with the
+  reference wire format (reference: tunnel/src/protocol.rs).
+- ``signaling`` — WebSocket rendezvous client + server
+  (reference: tunnel/src/signaling.rs, signal-server/src/index.ts).
+- ``transport`` — data-channel abstraction: loopback (tests), TCP, and
+  hole-punched encrypted UDP (reference: tunnel/src/rtc.rs).
+- ``endpoints`` — serve (provider) / proxy (consumer) peers
+  (reference: tunnel/src/serve.rs, tunnel/src/proxy.rs).
+- ``engine``    — continuous-batching inference engine (net-new; replaces the
+  reference's reqwest→Ollama hop at serve.rs:219).
+- ``models``    — functional JAX Llama/Gemma model families.
+- ``ops``       — Pallas kernels + reference ops (attention, norms, rope,
+  sampling, quant).
+- ``parallel``  — Mesh / sharding / tensor-parallel / ring-attention.
+"""
+
+__version__ = "0.1.0"

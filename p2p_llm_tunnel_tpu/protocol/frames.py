@@ -1,0 +1,289 @@
+"""Binary tunnel frame codec and handshake negotiation.
+
+Frame layout (wire-compatible with reference tunnel/src/protocol.rs:148-172):
+
+    [type: u8][stream_id: u32 big-endian][payload: bytes]
+
+Control payloads (Hello/Agree/Req-/ResHeaders/Error) are UTF-8 JSON; body
+payloads are raw bytes. Eleven message types (reference protocol.rs:88-100).
+
+The handshake (reference protocol.rs:17-81): the proxy peer sends HELLO
+advertising a protocol name, a [min_version, max_version] range, and a feature
+list; the serve peer answers AGREE with the highest overlapping version and the
+intersection of features. The only v1 feature is "sse".
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+PROTOCOL_VERSION = 1
+PROTOCOL_NAME = "httptunnel"
+
+#: Hard cap on a single encoded frame (reference protocol.rs:10). Keeps frames
+#: under typical data-channel message limits.
+MAX_FRAME_SIZE = 64 * 1024
+#: Max body bytes per REQ_BODY/RES_BODY frame, leaving slack for the 5-byte
+#: header + transport overhead (reference protocol.rs:12).
+MAX_BODY_CHUNK = MAX_FRAME_SIZE - 128
+
+#: Features this implementation supports (reference protocol.rs:67).
+SUPPORTED_FEATURES = ["sse"]
+
+_HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE
+
+
+class ProtocolError(Exception):
+    """Malformed frame: truncated header, unknown type byte, oversize, bad JSON."""
+
+
+class NegotiationError(Exception):
+    """HELLO/AGREE negotiation failed (wrong protocol or disjoint versions)."""
+
+
+class MessageType(enum.IntEnum):
+    """Frame type tags (reference protocol.rs:88-100)."""
+
+    HELLO = 1
+    AGREE = 2
+    PING = 3
+    PONG = 4
+    REQ_HEADERS = 10
+    REQ_BODY = 11
+    REQ_END = 12
+    RES_HEADERS = 20
+    RES_BODY = 21
+    RES_END = 22
+    ERROR = 99
+
+    @classmethod
+    def from_u8(cls, v: int) -> "MessageType | None":
+        try:
+            return cls(v)
+        except ValueError:
+            return None
+
+
+@dataclass
+class Hello:
+    """Handshake opener (reference protocol.rs:17-38). JSON keys: proto,
+    min_version, max_version, features."""
+
+    proto: str = PROTOCOL_NAME
+    min_version: int = 1
+    max_version: int = PROTOCOL_VERSION
+    features: List[str] = field(default_factory=lambda: list(SUPPORTED_FEATURES))
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "proto": self.proto,
+                "min_version": self.min_version,
+                "max_version": self.max_version,
+                "features": self.features,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Hello":
+        try:
+            obj = json.loads(data)
+            return cls(
+                proto=obj["proto"],
+                min_version=int(obj["min_version"]),
+                max_version=int(obj["max_version"]),
+                features=list(obj["features"]),
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad HELLO payload: {e}") from e
+
+
+@dataclass
+class Agree:
+    """Handshake reply carrying the negotiated version + feature intersection
+    (reference protocol.rs:25-81)."""
+
+    version: int = PROTOCOL_VERSION
+    features: List[str] = field(default_factory=lambda: list(SUPPORTED_FEATURES))
+
+    def to_json(self) -> bytes:
+        return json.dumps({"version": self.version, "features": self.features}).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Agree":
+        try:
+            obj = json.loads(data)
+            return cls(version=int(obj["version"]), features=list(obj["features"]))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad AGREE payload: {e}") from e
+
+    @classmethod
+    def from_hello(cls, hello: Hello) -> "Agree":
+        """Negotiate: highest overlapping version, feature-set intersection.
+
+        Raises NegotiationError on unknown protocol name or disjoint version
+        ranges (reference protocol.rs:44-81).
+        """
+        if hello.proto != PROTOCOL_NAME:
+            raise NegotiationError(f"unknown protocol: {hello.proto}")
+        our_min, our_max = 1, PROTOCOL_VERSION
+        overlap_min = max(hello.min_version, our_min)
+        overlap_max = min(hello.max_version, our_max)
+        if overlap_min > overlap_max:
+            raise NegotiationError(
+                f"no compatible version: peer=[{hello.min_version},{hello.max_version}],"
+                f" ours=[{our_min},{our_max}]"
+            )
+        agreed = [f for f in hello.features if f in SUPPORTED_FEATURES]
+        return cls(version=overlap_max, features=agreed)
+
+
+@dataclass
+class RequestHeaders:
+    """REQ_HEADERS JSON payload (reference protocol.rs:123-128)."""
+
+    stream_id: int
+    method: str
+    path: str
+    headers: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "stream_id": self.stream_id,
+                "method": self.method,
+                "path": self.path,
+                "headers": self.headers,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "RequestHeaders":
+        try:
+            obj = json.loads(data)
+            return cls(
+                stream_id=int(obj["stream_id"]),
+                method=str(obj["method"]),
+                path=str(obj["path"]),
+                headers=dict(obj["headers"]),
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad REQ_HEADERS payload: {e}") from e
+
+
+@dataclass
+class ResponseHeaders:
+    """RES_HEADERS JSON payload (reference protocol.rs:132-136)."""
+
+    stream_id: int
+    status: int
+    headers: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "stream_id": self.stream_id,
+                "status": self.status,
+                "headers": self.headers,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "ResponseHeaders":
+        try:
+            obj = json.loads(data)
+            return cls(
+                stream_id=int(obj["stream_id"]),
+                status=int(obj["status"]),
+                headers=dict(obj["headers"]),
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad RES_HEADERS payload: {e}") from e
+
+
+@dataclass
+class TunnelMessage:
+    """One framed tunnel message (reference protocol.rs:140-262)."""
+
+    msg_type: MessageType
+    stream_id: int
+    payload: bytes = b""
+
+    # -- codec ------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        out = _HEADER.pack(int(self.msg_type), self.stream_id) + self.payload
+        if len(out) > MAX_FRAME_SIZE:
+            raise ProtocolError(
+                f"frame too large: {len(out)} > {MAX_FRAME_SIZE}"
+            )
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TunnelMessage":
+        if len(data) < _HEADER.size:
+            raise ProtocolError(f"frame too short: {len(data)} bytes")
+        if len(data) > MAX_FRAME_SIZE:
+            raise ProtocolError(f"frame too large: {len(data)} > {MAX_FRAME_SIZE}")
+        type_byte, stream_id = _HEADER.unpack_from(data)
+        msg_type = MessageType.from_u8(type_byte)
+        if msg_type is None:
+            raise ProtocolError(f"unknown message type: {type_byte}")
+        return cls(msg_type=msg_type, stream_id=stream_id, payload=bytes(data[5:]))
+
+    # -- convenience constructors (reference protocol.rs:176-262) ---------
+
+    @classmethod
+    def hello(cls, hello: Hello | None = None) -> "TunnelMessage":
+        return cls(MessageType.HELLO, 0, (hello or Hello()).to_json())
+
+    @classmethod
+    def agree(cls, agree: Agree) -> "TunnelMessage":
+        return cls(MessageType.AGREE, 0, agree.to_json())
+
+    @classmethod
+    def ping(cls) -> "TunnelMessage":
+        return cls(MessageType.PING, 0)
+
+    @classmethod
+    def pong(cls) -> "TunnelMessage":
+        return cls(MessageType.PONG, 0)
+
+    @classmethod
+    def req_headers(cls, headers: RequestHeaders) -> "TunnelMessage":
+        return cls(MessageType.REQ_HEADERS, headers.stream_id, headers.to_json())
+
+    @classmethod
+    def req_body(cls, stream_id: int, data: bytes) -> "TunnelMessage":
+        return cls(MessageType.REQ_BODY, stream_id, data)
+
+    @classmethod
+    def req_end(cls, stream_id: int) -> "TunnelMessage":
+        return cls(MessageType.REQ_END, stream_id)
+
+    @classmethod
+    def res_headers(cls, headers: ResponseHeaders) -> "TunnelMessage":
+        return cls(MessageType.RES_HEADERS, headers.stream_id, headers.to_json())
+
+    @classmethod
+    def res_body(cls, stream_id: int, data: bytes) -> "TunnelMessage":
+        return cls(MessageType.RES_BODY, stream_id, data)
+
+    @classmethod
+    def res_end(cls, stream_id: int) -> "TunnelMessage":
+        return cls(MessageType.RES_END, stream_id)
+
+    @classmethod
+    def error(cls, stream_id: int, msg: str) -> "TunnelMessage":
+        # ERROR payload is plain UTF-8 text (reference protocol.rs:240-246).
+        return cls(MessageType.ERROR, stream_id, msg.encode())
+
+
+def iter_body_chunks(data: bytes, chunk_size: int = MAX_BODY_CHUNK):
+    """Split a body into frame-sized chunks. Yields nothing for empty bodies."""
+    for i in range(0, len(data), chunk_size):
+        yield data[i : i + chunk_size]

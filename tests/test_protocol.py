@@ -1,0 +1,251 @@
+"""Protocol codec + negotiation tests.
+
+Ports the semantics of the reference's 24-test matrix (tunnel/src/protocol.rs
+:265-550): roundtrips for every payload-bearing type, corrupt input, boundary
+cases, version negotiation, feature intersection — plus wire-format golden
+bytes so byte-compatibility with the reference binary is pinned down.
+"""
+
+import json
+
+import pytest
+
+from p2p_llm_tunnel_tpu.protocol import (
+    MAX_BODY_CHUNK,
+    MAX_FRAME_SIZE,
+    PROTOCOL_NAME,
+    PROTOCOL_VERSION,
+    Agree,
+    Hello,
+    MessageType,
+    NegotiationError,
+    ProtocolError,
+    RequestHeaders,
+    ResponseHeaders,
+    TunnelMessage,
+)
+from p2p_llm_tunnel_tpu.protocol.frames import iter_body_chunks
+
+
+# --- wire format goldens -------------------------------------------------
+
+def test_wire_layout_golden():
+    """Header is [type:u8][stream_id:u32 BE]; payload follows verbatim."""
+    msg = TunnelMessage(MessageType.RES_BODY, 0x01020304, b"abc")
+    assert msg.encode() == bytes([21, 1, 2, 3, 4]) + b"abc"
+
+
+def test_wire_layout_req_end():
+    assert TunnelMessage.req_end(7).encode() == bytes([12, 0, 0, 0, 7])
+
+
+def test_constants_match_reference():
+    assert PROTOCOL_VERSION == 1
+    assert PROTOCOL_NAME == "httptunnel"
+    assert MAX_FRAME_SIZE == 65536
+    assert MAX_BODY_CHUNK == 65408
+
+
+# --- roundtrips for every payload-bearing type ---------------------------
+
+def test_hello_roundtrip():
+    encoded = TunnelMessage.hello().encode()
+    decoded = TunnelMessage.decode(encoded)
+    assert decoded.msg_type == MessageType.HELLO
+    assert decoded.stream_id == 0
+    hello = Hello.from_json(decoded.payload)
+    assert hello.proto == PROTOCOL_NAME
+    assert hello.min_version == 1
+    assert hello.max_version == PROTOCOL_VERSION
+    assert hello.features == ["sse"]
+
+
+def test_hello_json_keys():
+    obj = json.loads(TunnelMessage.hello().payload)
+    assert set(obj) == {"proto", "min_version", "max_version", "features"}
+
+
+def test_agree_roundtrip():
+    agree = Agree(version=1, features=["sse"])
+    decoded = TunnelMessage.decode(TunnelMessage.agree(agree).encode())
+    assert decoded.msg_type == MessageType.AGREE
+    parsed = Agree.from_json(decoded.payload)
+    assert parsed.version == 1
+    assert parsed.features == ["sse"]
+
+
+def test_req_headers_roundtrip():
+    rh = RequestHeaders(
+        stream_id=42,
+        method="POST",
+        path="/v1/chat/completions",
+        headers={"content-type": "application/json", "x-custom": "1"},
+    )
+    decoded = TunnelMessage.decode(TunnelMessage.req_headers(rh).encode())
+    assert decoded.msg_type == MessageType.REQ_HEADERS
+    assert decoded.stream_id == 42
+    parsed = RequestHeaders.from_json(decoded.payload)
+    assert parsed == rh
+
+
+def test_req_headers_json_keys():
+    rh = RequestHeaders(stream_id=1, method="GET", path="/x", headers={})
+    assert set(json.loads(rh.to_json())) == {"stream_id", "method", "path", "headers"}
+
+
+def test_res_headers_roundtrip():
+    rh = ResponseHeaders(
+        stream_id=9, status=200, headers={"content-type": "text/event-stream"}
+    )
+    decoded = TunnelMessage.decode(TunnelMessage.res_headers(rh).encode())
+    assert decoded.msg_type == MessageType.RES_HEADERS
+    assert decoded.stream_id == 9
+    assert ResponseHeaders.from_json(decoded.payload) == rh
+
+
+def test_req_body_roundtrip():
+    decoded = TunnelMessage.decode(TunnelMessage.req_body(3, b"hello body").encode())
+    assert decoded.msg_type == MessageType.REQ_BODY
+    assert decoded.stream_id == 3
+    assert decoded.payload == b"hello body"
+
+
+def test_res_body_roundtrip():
+    data = bytes(range(256)) * 4
+    decoded = TunnelMessage.decode(TunnelMessage.res_body(5, data).encode())
+    assert decoded.msg_type == MessageType.RES_BODY
+    assert decoded.payload == data
+
+
+def test_end_frames_roundtrip():
+    for ctor, mt in [
+        (TunnelMessage.req_end, MessageType.REQ_END),
+        (TunnelMessage.res_end, MessageType.RES_END),
+    ]:
+        decoded = TunnelMessage.decode(ctor(11).encode())
+        assert decoded.msg_type == mt
+        assert decoded.stream_id == 11
+        assert decoded.payload == b""
+
+
+def test_ping_pong_roundtrip():
+    for ctor, mt in [
+        (TunnelMessage.ping, MessageType.PING),
+        (TunnelMessage.pong, MessageType.PONG),
+    ]:
+        decoded = TunnelMessage.decode(ctor().encode())
+        assert decoded.msg_type == mt
+        assert decoded.stream_id == 0
+        assert decoded.payload == b""
+
+
+def test_error_roundtrip_plain_text():
+    """ERROR payload is plain UTF-8 text, not JSON (reference protocol.rs:240)."""
+    decoded = TunnelMessage.decode(TunnelMessage.error(8, "upstream died").encode())
+    assert decoded.msg_type == MessageType.ERROR
+    assert decoded.stream_id == 8
+    assert decoded.payload == b"upstream died"
+
+
+# --- corrupt input -------------------------------------------------------
+
+def test_decode_empty():
+    with pytest.raises(ProtocolError):
+        TunnelMessage.decode(b"")
+
+
+def test_decode_truncated_header():
+    with pytest.raises(ProtocolError):
+        TunnelMessage.decode(bytes([1, 0, 0]))
+
+
+def test_decode_unknown_type():
+    with pytest.raises(ProtocolError):
+        TunnelMessage.decode(bytes([77, 0, 0, 0, 1]) + b"x")
+
+
+def test_decode_oversize():
+    with pytest.raises(ProtocolError):
+        TunnelMessage.decode(bytes([21, 0, 0, 0, 1]) + b"x" * MAX_FRAME_SIZE)
+
+
+def test_encode_oversize():
+    with pytest.raises(ProtocolError):
+        TunnelMessage(MessageType.RES_BODY, 1, b"x" * (MAX_FRAME_SIZE - 4)).encode()
+
+
+# --- boundary cases ------------------------------------------------------
+
+def test_header_only_frame():
+    decoded = TunnelMessage.decode(bytes([3, 0, 0, 0, 0]))
+    assert decoded.msg_type == MessageType.PING
+    assert decoded.payload == b""
+
+
+def test_stream_id_zero_and_max():
+    for sid in (0, 2**32 - 1):
+        decoded = TunnelMessage.decode(TunnelMessage.req_body(sid, b"x").encode())
+        assert decoded.stream_id == sid
+
+
+def test_max_size_payload():
+    data = b"\xab" * MAX_BODY_CHUNK
+    encoded = TunnelMessage.res_body(1, data).encode()
+    assert len(encoded) == 5 + MAX_BODY_CHUNK
+    assert TunnelMessage.decode(encoded).payload == data
+
+
+def test_empty_payload_body_frame():
+    decoded = TunnelMessage.decode(TunnelMessage.res_body(1, b"").encode())
+    assert decoded.payload == b""
+
+
+def test_iter_body_chunks():
+    data = b"z" * (MAX_BODY_CHUNK * 2 + 100)
+    chunks = list(iter_body_chunks(data))
+    assert [len(c) for c in chunks] == [MAX_BODY_CHUNK, MAX_BODY_CHUNK, 100]
+    assert b"".join(chunks) == data
+    assert list(iter_body_chunks(b"")) == []
+
+
+# --- version negotiation -------------------------------------------------
+
+def test_negotiate_exact_match():
+    agree = Agree.from_hello(Hello())
+    assert agree.version == PROTOCOL_VERSION
+    assert agree.features == ["sse"]
+
+
+def test_negotiate_overlap_picks_highest():
+    # Peer supports 1-3, we support exactly 1 → agree on 1.
+    hello = Hello(proto=PROTOCOL_NAME, min_version=1, max_version=3, features=["sse"])
+    assert Agree.from_hello(hello).version == 1
+
+
+def test_negotiate_disjoint_versions():
+    hello = Hello(proto=PROTOCOL_NAME, min_version=5, max_version=9, features=[])
+    with pytest.raises(NegotiationError):
+        Agree.from_hello(hello)
+
+
+def test_negotiate_wrong_protocol():
+    with pytest.raises(NegotiationError):
+        Agree.from_hello(Hello(proto="ftp", min_version=1, max_version=1))
+
+
+def test_negotiate_feature_intersection():
+    hello = Hello(features=["sse", "compression", "multiplex-v2"])
+    assert Agree.from_hello(hello).features == ["sse"]
+
+
+def test_negotiate_disjoint_features():
+    hello = Hello(features=["compression"])
+    assert Agree.from_hello(hello).features == []
+
+
+def test_hello_defaults():
+    hello = Hello()
+    assert hello.proto == PROTOCOL_NAME
+    assert hello.min_version == 1
+    assert hello.max_version == PROTOCOL_VERSION
+    assert hello.features == ["sse"]
