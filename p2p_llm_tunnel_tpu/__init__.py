@@ -1,23 +1,16 @@
-"""p2p_llm_tunnel_tpu — a TPU-native P2P LLM tunnel + inference framework.
+"""TPU-native P2P LLM tunnel.
 
-A from-scratch rebuild of the capabilities of michaelneale/p2p-llm-tunnel
-(reference at /root/reference), with the external HTTP LLM upstream replaced by
-an in-process JAX/XLA inference engine designed for TPU:
+A re-design of michaelneale/p2p-llm-tunnel for TPU hardware: the same tunnel
+capabilities (binary framing, signaling rendezvous, P2P data channel, serve/
+proxy endpoints) with the external HTTP LLM upstream replaced by an
+in-process JAX/XLA inference engine.
 
-- ``protocol``  — binary multiplexed frame codec, byte-compatible with the
-  reference wire format (reference: tunnel/src/protocol.rs).
-- ``signaling`` — WebSocket rendezvous client + server
-  (reference: tunnel/src/signaling.rs, signal-server/src/index.ts).
-- ``transport`` — data-channel abstraction: loopback (tests), TCP, and
-  hole-punched encrypted UDP (reference: tunnel/src/rtc.rs).
-- ``endpoints`` — serve (provider) / proxy (consumer) peers
-  (reference: tunnel/src/serve.rs, tunnel/src/proxy.rs).
-- ``engine``    — continuous-batching inference engine (net-new; replaces the
-  reference's reqwest→Ollama hop at serve.rs:219).
-- ``models``    — functional JAX Llama/Gemma model families.
-- ``ops``       — Pallas kernels + reference ops (attention, norms, rope,
-  sampling, quant).
-- ``parallel``  — Mesh / sharding / tensor-parallel / ring-attention.
+Subpackages (implemented):
+- ``protocol``  — wire-compatible frame codec + HELLO/AGREE negotiation
+- ``transport`` — channel contract, loopback pair, network transports
+- ``endpoints`` — serve (provider) and proxy (consumer) + HTTP/1.1 runtime
+- ``testing``   — mock LLM upstream fixture (SSE-paced)
+- ``utils``     — env-filtered logging, observability counters
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

@@ -1,0 +1,306 @@
+"""Proxy (consumer) endpoint: local HTTP/1.1 listener → tunnel frames → back.
+
+Reference behavior being matched (tunnel/src/proxy.rs):
+- send HELLO, await AGREE ≤300 s (proxy.rs:64-88), then mark tunnel ready
+- 503 "Tunnel not ready" before the handshake completes (:257-263)
+- keepalive ping every 10 s (:91-103); answer PING with PONG (:154-162)
+- response-reader task demuxes RES_*/ERROR frames into per-stream event
+  queues (:105-172)
+- stream ids allocated from a counter starting at 1 — the proxy is the sole
+  allocator (:52, :265)
+- request bodies fully buffered before sending (:280-289), chunked to
+  MAX_BODY_CHUNK (:318-330)
+- 504 on response-header timeout (60 s, :339-341, :367-375); 502 on tunnel
+  error before headers (:360-366); hop-by-hop headers stripped from the
+  rebuilt response (:379-388)
+- mid-stream ERROR truncates the body without an HTTP error (:408-412)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, Optional, Union
+
+from p2p_llm_tunnel_tpu.endpoints.http11 import (
+    HttpRequest,
+    HttpResponse,
+    start_http_server,
+)
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    MAX_BODY_CHUNK,
+    Agree,
+    Hello,
+    MessageType,
+    ProtocolError,
+    RequestHeaders,
+    ResponseHeaders,
+    TunnelMessage,
+    iter_body_chunks,
+)
+from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+log = get_logger(__name__)
+
+HANDSHAKE_TIMEOUT = 300.0  # proxy.rs:72-78
+RESPONSE_HEADER_TIMEOUT = 60.0  # proxy.rs:339-341
+PING_INTERVAL = 10.0  # proxy.rs:93
+
+_HOP_BY_HOP_RESPONSE = {"transfer-encoding", "connection"}
+
+
+@dataclass
+class _Headers:
+    headers: ResponseHeaders
+
+
+@dataclass
+class _Body:
+    data: bytes
+
+
+@dataclass
+class _Error:
+    message: str
+
+
+class _End:
+    pass
+
+
+_StreamEvent = Union[_Headers, _Body, _Error, _End]
+
+
+class ProxyState:
+    """Shared state between the HTTP handler and the response-reader task."""
+
+    def __init__(self, channel: Channel):
+        self.channel = channel
+        self.tunnel_ready = False
+        self._next_stream_id = 1
+        self.pending: Dict[int, asyncio.Queue[_StreamEvent]] = {}
+
+    def alloc_stream_id(self) -> int:
+        sid = self._next_stream_id
+        self._next_stream_id += 1
+        return sid
+
+
+def _abort_pending(state: ProxyState, reason: str) -> None:
+    """Wake every in-flight stream with an error so no handler hangs."""
+    for sid, q in list(state.pending.items()):
+        q.put_nowait(_Error(reason))
+    state.pending.clear()
+
+
+async def _response_reader(state: ProxyState) -> None:
+    """Demux incoming frames into per-stream event queues (proxy.rs:105-172)."""
+    channel = state.channel
+    while True:
+        try:
+            raw = await channel.recv()
+        except ChannelClosed:
+            log.debug("response reader ended: channel closed")
+            _abort_pending(state, "tunnel closed")
+            return
+        try:
+            msg = TunnelMessage.decode(raw)
+        except ProtocolError as e:
+            log.warning("failed to decode tunnel message: %s", e)
+            continue
+
+        if msg.msg_type == MessageType.RES_HEADERS:
+            try:
+                headers = ResponseHeaders.from_json(msg.payload)
+            except ProtocolError as e:
+                log.error("failed to parse response headers: %s", e)
+                continue
+            q = state.pending.get(headers.stream_id)
+            if q is not None:
+                q.put_nowait(_Headers(headers))
+        elif msg.msg_type == MessageType.RES_BODY:
+            q = state.pending.get(msg.stream_id)
+            if q is not None:
+                q.put_nowait(_Body(msg.payload))
+        elif msg.msg_type == MessageType.RES_END:
+            q = state.pending.pop(msg.stream_id, None)
+            if q is not None:
+                q.put_nowait(_End())
+        elif msg.msg_type == MessageType.ERROR:
+            text = msg.payload.decode("utf-8", "replace")
+            log.error("tunnel error for stream %d: %s", msg.stream_id, text)
+            q = state.pending.pop(msg.stream_id, None)
+            if q is not None:
+                q.put_nowait(_Error(text))
+        elif msg.msg_type == MessageType.PING:
+            try:
+                await channel.send(TunnelMessage.pong().encode())
+            except ChannelClosed:
+                _abort_pending(state, "tunnel closed")
+                return
+        elif msg.msg_type == MessageType.PONG:
+            log.debug("received pong")
+        else:
+            log.debug("proxy ignoring message type %s", msg.msg_type.name)
+
+
+def _plain(status: int, text: str) -> HttpResponse:
+    return HttpResponse(status, {"content-type": "text/plain"}, text.encode())
+
+
+async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
+    """One HTTP request through the tunnel (proxy.rs:249-426)."""
+    if not state.tunnel_ready:
+        return _plain(503, "Tunnel not ready")
+
+    channel = state.channel
+    stream_id = state.alloc_stream_id()
+    t_start = time.monotonic()
+    global_metrics.inc("proxy_requests_total")
+    log.debug("proxying %s %s (stream %d)", req.method, req.path, stream_id)
+
+    events: asyncio.Queue[_StreamEvent] = asyncio.Queue()
+    state.pending[stream_id] = events
+    global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
+
+    try:
+        await channel.send(
+            TunnelMessage.req_headers(
+                RequestHeaders(stream_id, req.method, req.path, dict(req.headers))
+            ).encode()
+        )
+        for chunk in iter_body_chunks(req.body, MAX_BODY_CHUNK):
+            await channel.send(TunnelMessage.req_body(stream_id, chunk).encode())
+        await channel.send(TunnelMessage.req_end(stream_id).encode())
+    except ChannelClosed:
+        state.pending.pop(stream_id, None)
+        return _plain(502, "Tunnel send failed")
+
+    # Wait for response headers (proxy.rs:338-376).
+    res_headers: Optional[ResponseHeaders] = None
+    deadline = time.monotonic() + RESPONSE_HEADER_TIMEOUT
+    while res_headers is None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            state.pending.pop(stream_id, None)
+            return _plain(504, "Tunnel response timeout")
+        try:
+            event = await asyncio.wait_for(events.get(), remaining)
+        except asyncio.TimeoutError:
+            state.pending.pop(stream_id, None)
+            return _plain(504, "Tunnel response timeout")
+        if isinstance(event, _Headers):
+            res_headers = event.headers
+        elif isinstance(event, _Error):
+            state.pending.pop(stream_id, None)
+            return _plain(502, f"Tunnel error: {event.message}")
+        elif isinstance(event, _End):
+            state.pending.pop(stream_id, None)
+            return _plain(502, "Tunnel error: response ended before headers")
+        else:
+            log.warning("received body chunk before headers for stream %d", stream_id)
+
+    headers_out = {
+        k: v
+        for k, v in res_headers.headers.items()
+        if k.lower() not in _HOP_BY_HOP_RESPONSE
+    }
+
+    async def body_stream() -> AsyncIterator[bytes]:
+        first = True
+        try:
+            while True:
+                event = await events.get()
+                if isinstance(event, _Body):
+                    if first:
+                        global_metrics.observe(
+                            "proxy_ttfb_ms", (time.monotonic() - t_start) * 1000.0
+                        )
+                        first = False
+                    global_metrics.inc("proxy_body_bytes_total", len(event.data))
+                    yield event.data
+                elif isinstance(event, (_End, _Error)):
+                    # ERROR mid-stream truncates the body silently
+                    # (proxy.rs:408-412) — HTTP status already went out.
+                    if isinstance(event, _Error):
+                        log.warning(
+                            "tunnel error mid-stream for %d: %s", stream_id, event.message
+                        )
+                    return
+                else:
+                    log.warning("unexpected duplicate headers for stream %d", stream_id)
+        finally:
+            state.pending.pop(stream_id, None)
+            global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
+
+    return HttpResponse(res_headers.status, headers_out, body_stream())
+
+
+async def run_proxy(
+    channel: Channel,
+    listen_host: str = "127.0.0.1",
+    listen_port: int = 8000,
+    ready: Optional["asyncio.Future[int]"] = None,
+) -> None:
+    """Run the consumer side until the tunnel dies; raises to trigger retry.
+
+    ``ready`` (optional) resolves to the bound port once the listener is up —
+    the programmatic readiness signal (the reference greps logs instead,
+    scripts/test-tunnel.sh:79-86).
+    """
+    state = ProxyState(channel)
+
+    if not channel.connected.is_set():
+        log.info("waiting for channel to be ready...")
+        await channel.connected.wait()
+    log.info("channel ready, performing handshake...")
+
+    await channel.send(TunnelMessage.hello(Hello()).encode())
+    try:
+        raw = await asyncio.wait_for(channel.recv(), HANDSHAKE_TIMEOUT)
+    except asyncio.TimeoutError:
+        raise RuntimeError("handshake timeout: no AGREE received within 5 minutes")
+    except ChannelClosed:
+        raise RuntimeError("channel closed before handshake")
+    agree_msg = TunnelMessage.decode(raw)
+    if agree_msg.msg_type != MessageType.AGREE:
+        raise RuntimeError(f"expected AGREE, got {agree_msg.msg_type.name}")
+    agree = Agree.from_json(agree_msg.payload)
+    log.info("received AGREE: version=%d features=%s", agree.version, agree.features)
+    state.tunnel_ready = True
+
+    async def keepalive() -> None:
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            try:
+                await channel.send(TunnelMessage.ping().encode())
+            except ChannelClosed:
+                return
+
+    ping_task = asyncio.create_task(keepalive())
+    reader_task = asyncio.create_task(_response_reader(state))
+    server = None
+    try:
+        async def handler(req: HttpRequest) -> HttpResponse:
+            return await handle_proxy_request(state, req)
+
+        server = await start_http_server(handler, listen_host, listen_port)
+        bound_port = server.sockets[0].getsockname()[1]
+        log.info("proxy listening on http://%s:%d", listen_host, bound_port)
+        if ready is not None and not ready.done():
+            ready.set_result(bound_port)
+        await channel.disconnected.wait()
+        raise RuntimeError("tunnel connection failed, exiting proxy to trigger reconnect")
+    finally:
+        ping_task.cancel()
+        reader_task.cancel()
+        _abort_pending(state, "proxy shutting down")
+        if server is not None:
+            server.close()
+            try:
+                await asyncio.wait_for(server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                log.warning("proxy listener did not close cleanly within 5s")

@@ -1,0 +1,219 @@
+"""Serve (provider) endpoint: tunnel frames in → upstream → streamed frames out.
+
+Reference behavior being matched (tunnel/src/serve.rs):
+- wait for channel, receive HELLO (≤300 s, serve.rs:37-43), reply AGREE
+- keepalive ping every 10 s (serve.rs:68-80); answer PING with PONG (:140-148)
+- reassemble per-stream requests, dispatch one task per request (:112-139)
+- strip hop-by-hop request headers host/connection/transfer-encoding (:207-212)
+- advertise-prefix path rewrite (:167-185)
+- 502 with a text body on upstream failure (:221-241)
+- stream response chunks as they arrive, sub-chunked to MAX_BODY_CHUNK (:263-277)
+- ERROR frame on mid-stream upstream failure, then RES_END (:278-290)
+
+The upstream is pluggable: the default backend forwards over HTTP like the
+reference's reqwest hop (serve.rs:219); the TPU engine registers an in-process
+backend instead (engine/api.py) — that swap is this project's whole point.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+
+from p2p_llm_tunnel_tpu.endpoints import http11
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    MAX_BODY_CHUNK,
+    Agree,
+    Hello,
+    MessageType,
+    ProtocolError,
+    RequestHeaders,
+    ResponseHeaders,
+    TunnelMessage,
+    iter_body_chunks,
+)
+from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+log = get_logger(__name__)
+
+HANDSHAKE_TIMEOUT = 300.0  # serve.rs:37-43
+PING_INTERVAL = 10.0  # serve.rs:70
+
+#: Backend contract: (request, body) -> (status, headers, async chunk iterator).
+#: Raising before returning headers → 502; raising mid-iteration → ERROR frame.
+Backend = Callable[
+    [RequestHeaders, bytes],
+    Awaitable[Tuple[int, Dict[str, str], AsyncIterator[bytes]]],
+]
+
+_HOP_BY_HOP = {"host", "connection", "transfer-encoding"}
+
+
+def build_upstream_url(upstream_base: str, advertise_prefix: str, request_path: str) -> str:
+    """Rewrite a tunneled request path for the upstream.
+
+    Matches the reference matrix exactly (serve.rs:167-185 and its 7 tests):
+    prefix "/" or "" → pass-through; otherwise strip the prefix, an exact
+    match becomes "/", and non-matching paths pass through unchanged.
+    """
+    base = upstream_base.rstrip("/")
+    prefix = advertise_prefix.rstrip("/")
+    if prefix in ("", "/"):
+        return base + request_path
+    if request_path.startswith(prefix):
+        stripped = request_path[len(prefix):] or "/"
+        return base + stripped
+    return base + request_path
+
+
+def http_backend(upstream_url: str, advertise_prefix: str = "/") -> Backend:
+    """The reference-equivalent backend: forward over HTTP, stream the body."""
+
+    async def backend(req: RequestHeaders, body: bytes):
+        url = build_upstream_url(upstream_url, advertise_prefix, req.path)
+        headers = {k: v for k, v in req.headers.items() if k.lower() not in _HOP_BY_HOP}
+        resp = await http11.http_request(req.method, url, headers, body)
+        return resp.status, resp.headers, resp.iter_chunks()
+
+    return backend
+
+
+async def _handle_request(
+    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes
+) -> None:
+    try:
+        await _handle_request_inner(channel, backend, req, body)
+    except ChannelClosed:
+        # Tunnel died while responding; the serve loop notices separately.
+        log.debug("channel closed while responding to stream %d", req.stream_id)
+
+
+async def _handle_request_inner(
+    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes
+) -> None:
+    stream_id = req.stream_id
+    global_metrics.inc("serve_requests_total")
+    try:
+        status, headers, chunks = await backend(req, body)
+    except Exception as e:
+        log.error("upstream request failed for stream %d: %s", stream_id, e)
+        global_metrics.inc("serve_upstream_errors_total")
+        await channel.send(
+            TunnelMessage.res_headers(
+                ResponseHeaders(stream_id, 502, {"content-type": "text/plain"})
+            ).encode()
+        )
+        await channel.send(
+            TunnelMessage.res_body(stream_id, f"Bad Gateway: {e}".encode()).encode()
+        )
+        await channel.send(TunnelMessage.res_end(stream_id).encode())
+        return
+
+    await channel.send(
+        TunnelMessage.res_headers(ResponseHeaders(stream_id, status, headers)).encode()
+    )
+    try:
+        async for chunk in chunks:
+            for sub in iter_body_chunks(chunk, MAX_BODY_CHUNK):
+                await channel.send(TunnelMessage.res_body(stream_id, sub).encode())
+    except Exception as e:
+        # Upstream dropped mid-stream — truncate with an ERROR frame
+        # (serve.rs:278-284); the proxy ends the HTTP body without an error.
+        log.error("upstream stream error for stream %d: %s", stream_id, e)
+        await channel.send(TunnelMessage.error(stream_id, f"upstream error: {e}").encode())
+    await channel.send(TunnelMessage.res_end(stream_id).encode())
+    log.debug("response %d complete: status=%d", stream_id, status)
+
+
+async def run_serve(
+    channel: Channel,
+    upstream_url: str = "",
+    advertise_prefix: str = "/",
+    backend: Optional[Backend] = None,
+) -> None:
+    """Run the provider side until the tunnel dies; raises to trigger retry."""
+    if backend is None:
+        backend = http_backend(upstream_url, advertise_prefix)
+
+    if not channel.connected.is_set():
+        log.info("waiting for channel to be ready...")
+        await channel.connected.wait()
+    log.info("channel ready, performing handshake...")
+
+    try:
+        raw = await asyncio.wait_for(channel.recv(), HANDSHAKE_TIMEOUT)
+    except asyncio.TimeoutError:
+        raise RuntimeError("handshake timeout: no HELLO received within 5 minutes")
+    except ChannelClosed:
+        raise RuntimeError("channel closed before handshake")
+
+    hello_msg = TunnelMessage.decode(raw)
+    if hello_msg.msg_type != MessageType.HELLO:
+        raise RuntimeError(f"expected HELLO, got {hello_msg.msg_type.name}")
+    hello = Hello.from_json(hello_msg.payload)
+    agree = Agree.from_hello(hello)
+    await channel.send(TunnelMessage.agree(agree).encode())
+    log.info("sent AGREE, tunnel ready")
+
+    pending: Dict[int, Tuple[RequestHeaders, bytearray]] = {}
+    request_tasks: set[asyncio.Task] = set()
+
+    async def keepalive() -> None:
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            try:
+                await channel.send(TunnelMessage.ping().encode())
+            except ChannelClosed:
+                return
+
+    ping_task = asyncio.create_task(keepalive())
+    try:
+        while True:
+            try:
+                raw = await channel.recv()
+            except ChannelClosed:
+                raise RuntimeError("channel closed, serve ending")
+
+            try:
+                msg = TunnelMessage.decode(raw)
+            except ProtocolError as e:
+                log.warning("failed to decode tunnel message: %s", e)
+                continue
+
+            if msg.msg_type == MessageType.REQ_HEADERS:
+                try:
+                    headers = RequestHeaders.from_json(msg.payload)
+                except ProtocolError as e:
+                    # One malformed frame must not tear down every stream.
+                    log.warning("bad REQ_HEADERS payload: %s", e)
+                    continue
+                log.debug("request %d %s %s", headers.stream_id, headers.method, headers.path)
+                pending[headers.stream_id] = (headers, bytearray())
+            elif msg.msg_type == MessageType.REQ_BODY:
+                entry = pending.get(msg.stream_id)
+                if entry is not None:
+                    entry[1].extend(msg.payload)
+            elif msg.msg_type == MessageType.REQ_END:
+                entry = pending.pop(msg.stream_id, None)
+                if entry is not None:
+                    req, body = entry
+                    task = asyncio.create_task(
+                        _handle_request(channel, backend, req, bytes(body))
+                    )
+                    request_tasks.add(task)
+                    task.add_done_callback(request_tasks.discard)
+            elif msg.msg_type == MessageType.PING:
+                try:
+                    await channel.send(TunnelMessage.pong().encode())
+                except ChannelClosed:
+                    raise RuntimeError("channel closed, serve ending")
+            elif msg.msg_type == MessageType.PONG:
+                log.debug("received pong")
+            else:
+                log.debug("serve ignoring message type %s", msg.msg_type.name)
+    finally:
+        ping_task.cancel()
+        for t in request_tasks:
+            t.cancel()

@@ -11,6 +11,12 @@ The handshake (reference protocol.rs:17-81): the proxy peer sends HELLO
 advertising a protocol name, a [min_version, max_version] range, and a feature
 list; the serve peer answers AGREE with the highest overlapping version and the
 intersection of features. The only v1 feature is "sse".
+
+Intentional divergence from the reference: ``decode()`` rejects frames larger
+than MAX_FRAME_SIZE, which the reference decoder tolerates (protocol.rs:
+157-172 has no size check). Both encoders only ever *emit* frames within the
+cap, so compliant peers are unaffected; rejecting oversize input here bounds
+memory for a frame that should never exist on the wire.
 """
 
 from __future__ import annotations

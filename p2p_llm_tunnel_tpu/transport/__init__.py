@@ -1,0 +1,13 @@
+"""Transports: the byte-message channel the tunnel endpoints run over.
+
+The contract (`Channel`) mirrors the reference's DataChannelPair semantics
+(reference tunnel/src/rtc.rs:23-28): a send handle, an ordered stream of
+received raw frames, and connected/disconnected events.  Implementations:
+
+- ``loopback_pair()`` — in-process pair for tests and same-process stacks.
+"""
+
+from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
+
+__all__ = ["Channel", "ChannelClosed", "loopback_pair"]

@@ -1,0 +1,102 @@
+"""First-class observability counters.
+
+The reference has logging only — no counters, no /metrics (SURVEY.md §5).
+This framework exposes the BASELINE-graded quantities (tok/s, TTFT, queue
+depth, batch occupancy) as a tiny in-process registry that endpoints, the
+engine, and ``bench.py`` all share.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+
+class _Percentiles:
+    """Bounded reservoir of observations with percentile queries."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._values: List[float] = []
+
+    def observe(self, v: float) -> None:
+        if len(self._values) >= self._cap:
+            # Drop the oldest half to stay bounded while keeping recency.
+            self._values = self._values[self._cap // 2 :]
+        self._values.append(v)
+
+    def percentile(self, p: float) -> float:
+        if not self._values:
+            return 0.0
+        xs = sorted(self._values)
+        idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+        return xs[idx]
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+
+class Metrics:
+    """Thread-safe registry of counters, gauges, and latency histograms."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, _Percentiles] = defaultdict(_Percentiles)
+        self._t0 = time.monotonic()
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += amount
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists[name].observe(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
+    def percentile(self, name: str, p: float) -> float:
+        with self._lock:
+            return self._hists[name].percentile(p)
+
+    def rate(self, name: str) -> float:
+        """Counter value divided by registry lifetime — a crude average rate."""
+        with self._lock:
+            dt = time.monotonic() - self._t0
+            return self._counters.get(name, 0.0) / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = dict(self._counters)
+            out.update(self._gauges)
+            for name, hist in self._hists.items():
+                if hist.count:
+                    out[f"{name}_p50"] = hist.percentile(50)
+                    out[f"{name}_p95"] = hist.percentile(95)
+                    out[f"{name}_count"] = float(hist.count)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self._t0 = time.monotonic()
+
+
+#: Process-wide default registry.
+global_metrics = Metrics()

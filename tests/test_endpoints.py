@@ -1,0 +1,346 @@
+"""End-to-end tunnel tests over the loopback transport.
+
+curl-equivalent → proxy → loopback frames → serve → mock upstream, matching
+the reference integration flow (scripts/test-local.sh:34-133) plus the tests
+the reference lacks (SURVEY.md §4 gaps): multi-stream concurrency and SSE
+pass-through with real pacing.
+"""
+
+import asyncio
+import contextlib
+import json
+import time
+
+from p2p_llm_tunnel_tpu.endpoints import http11, proxy as proxy_mod
+from p2p_llm_tunnel_tpu.endpoints.http11 import HttpRequest, HttpResponse, start_http_server
+from p2p_llm_tunnel_tpu.endpoints.proxy import ProxyState, handle_proxy_request, run_proxy
+from p2p_llm_tunnel_tpu.endpoints.serve import build_upstream_url, run_serve
+from p2p_llm_tunnel_tpu.testing.mock_llm import create_mock_llm_handler
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+
+
+# ---------------------------------------------------------------------------
+# build_upstream_url matrix (serve.rs:296-359 parity)
+# ---------------------------------------------------------------------------
+
+def test_url_default_prefix():
+    assert build_upstream_url("http://localhost:3001", "/", "/models") == \
+        "http://localhost:3001/models"
+
+
+def test_url_with_prefix():
+    assert build_upstream_url("http://localhost:3001", "/v1", "/v1/models") == \
+        "http://localhost:3001/models"
+
+
+def test_url_trailing_slashes():
+    assert build_upstream_url("http://localhost:3001/", "/v1/", "/v1/models") == \
+        "http://localhost:3001/models"
+
+
+def test_url_empty_prefix():
+    assert build_upstream_url("http://localhost:3001", "", "/chat/completions") == \
+        "http://localhost:3001/chat/completions"
+
+
+def test_url_exact_prefix():
+    assert build_upstream_url("http://localhost:3001", "/v1", "/v1") == \
+        "http://localhost:3001/"
+
+
+def test_url_no_prefix_match():
+    assert build_upstream_url("http://localhost:3001", "/v1", "/health") == \
+        "http://localhost:3001/health"
+
+
+def test_url_nested_prefix():
+    assert build_upstream_url(
+        "http://localhost:3001", "/api/v1", "/api/v1/chat/completions"
+    ) == "http://localhost:3001/chat/completions"
+
+
+# ---------------------------------------------------------------------------
+# full-stack harness
+# ---------------------------------------------------------------------------
+
+@contextlib.asynccontextmanager
+async def serve_proxy_pair(serve_kwargs):
+    """serve + proxy over a loopback pair; yields the proxy's base URL."""
+    serve_ch, proxy_ch = loopback_pair()
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    serve_task = asyncio.create_task(run_serve(serve_ch, **serve_kwargs))
+    proxy_task = asyncio.create_task(run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready))
+    port = await asyncio.wait_for(ready, 5.0)
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        serve_task.cancel()
+        proxy_task.cancel()
+        serve_ch.close()
+        await asyncio.gather(serve_task, proxy_task, return_exceptions=True)
+
+
+@contextlib.asynccontextmanager
+async def tunnel_stack(upstream_handler=None, advertise="/", sse_pace=0.02):
+    """Mock upstream + serve + proxy over a loopback pair; yields proxy URL."""
+    if upstream_handler is None:
+        upstream_handler = create_mock_llm_handler(pace_s=sse_pace)
+    upstream = await start_http_server(upstream_handler, "127.0.0.1", 0)
+    up_port = upstream.sockets[0].getsockname()[1]
+    kwargs = dict(upstream_url=f"http://127.0.0.1:{up_port}", advertise_prefix=advertise)
+    try:
+        async with serve_proxy_pair(kwargs) as base:
+            yield base
+    finally:
+        upstream.close()
+        await upstream.wait_closed()
+
+
+def test_models_through_tunnel():
+    async def run():
+        async with tunnel_stack() as base:
+            resp = await http11.http_request("GET", f"{base}/v1/models")
+            body = await resp.read_all()
+            assert resp.status == 200
+            assert b"test-model" in body
+
+    asyncio.run(run())
+
+
+def test_health_through_tunnel():
+    async def run():
+        async with tunnel_stack() as base:
+            resp = await http11.http_request("GET", f"{base}/health")
+            assert resp.status == 200
+            assert await resp.read_all() == b"ok"
+
+    asyncio.run(run())
+
+
+def test_404_passthrough():
+    async def run():
+        async with tunnel_stack() as base:
+            resp = await http11.http_request("GET", f"{base}/nope")
+            assert resp.status == 404
+
+    asyncio.run(run())
+
+
+def test_non_streaming_completion():
+    async def run():
+        async with tunnel_stack() as base:
+            payload = json.dumps({"messages": [], "stream": False}).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/v1/chat/completions",
+                {"content-type": "application/json"}, payload,
+            )
+            assert resp.status == 200
+            obj = json.loads(await resp.read_all())
+            assert obj["choices"][0]["message"]["content"] == "Hello from the tunnel!"
+
+    asyncio.run(run())
+
+
+def test_sse_streams_incrementally_through_tunnel():
+    """SSE chunks must arrive as separate paced chunks, not one buffered blob."""
+    async def run():
+        pace = 0.05
+        async with tunnel_stack(sse_pace=pace) as base:
+            payload = json.dumps({"stream": True}).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/v1/chat/completions",
+                {"content-type": "application/json"}, payload,
+            )
+            assert resp.status == 200
+            assert "text/event-stream" in resp.headers.get("content-type", "")
+            arrivals = []
+            body = b""
+            async for chunk in resp.iter_chunks():
+                arrivals.append(time.monotonic())
+                body += chunk
+            assert body.strip().endswith(b"data: [DONE]")
+            assert body.count(b"data:") == 7  # 5 tokens + finish + DONE
+            # Streaming proof: arrivals must span most of the pacing window.
+            assert len(arrivals) >= 3
+            assert arrivals[-1] - arrivals[0] >= pace * 2.5
+
+    asyncio.run(run())
+
+
+def test_multi_stream_concurrency():
+    """16 concurrent requests with paced SSE bodies all complete correctly
+    and in parallel (absent even from the reference's test suite)."""
+    async def run():
+        pace = 0.04
+        n = 16
+        async with tunnel_stack(sse_pace=pace) as base:
+            async def one(i):
+                payload = json.dumps({"stream": True}).encode()
+                resp = await http11.http_request(
+                    "POST", f"{base}/v1/chat/completions", {}, payload,
+                )
+                body = await resp.read_all()
+                assert resp.status == 200
+                assert body.count(b"data:") == 7
+                return body
+
+            t0 = time.monotonic()
+            results = await asyncio.gather(*[one(i) for i in range(n)])
+            elapsed = time.monotonic() - t0
+            assert len(results) == n
+            # Serial execution would take n * 5 * pace = 3.2 s; parallel
+            # should be close to one request's 0.2 s. Allow generous slack.
+            assert elapsed < n * 5 * pace * 0.5
+
+    asyncio.run(run())
+
+
+def test_large_body_chunked_over_frames():
+    """A body larger than MAX_BODY_CHUNK must be split and reassembled."""
+    async def run():
+        big = bytes(range(256)) * 1024  # 256 KiB, > 3 frames
+
+        async def echo_handler(req: HttpRequest) -> HttpResponse:
+            return HttpResponse(200, {"content-type": "application/octet-stream"}, req.body)
+
+        async with tunnel_stack(upstream_handler=echo_handler) as base:
+            resp = await http11.http_request("POST", f"{base}/echo", {}, big)
+            assert resp.status == 200
+            assert await resp.read_all() == big
+
+    asyncio.run(run())
+
+
+def test_502_on_dead_upstream():
+    async def run():
+        # Port 9 (discard): nothing listens there.
+        async with serve_proxy_pair(dict(upstream_url="http://127.0.0.1:9")) as base:
+            resp = await http11.http_request("GET", f"{base}/x")
+            body = await resp.read_all()
+            assert resp.status == 502
+            assert b"Bad Gateway" in body
+
+    asyncio.run(run())
+
+
+def test_503_before_handshake():
+    async def run():
+        ch, _peer = loopback_pair()
+        state = ProxyState(ch)  # tunnel_ready defaults False
+        resp = await handle_proxy_request(state, HttpRequest("GET", "/x", {}, b""))
+        assert resp.status == 503
+        assert resp.body == b"Tunnel not ready"
+
+    asyncio.run(run())
+
+
+def test_504_on_header_timeout(monkeypatch):
+    async def run():
+        async def never_backend(req, body):
+            await asyncio.sleep(3600)
+
+        monkeypatch.setattr(proxy_mod, "RESPONSE_HEADER_TIMEOUT", 0.2)
+        async with serve_proxy_pair(dict(backend=never_backend)) as base:
+            t0 = time.monotonic()
+            resp = await http11.http_request("GET", f"{base}/slow", timeout=10.0)
+            assert resp.status == 504
+            assert time.monotonic() - t0 < 5.0
+
+    asyncio.run(run())
+
+
+def test_midstream_error_truncates_body():
+    """Upstream dying mid-stream → ERROR frame → body truncated, no HTTP error
+    (serve.rs:278-284 + proxy.rs:408-412 semantics)."""
+    async def run():
+        async def flaky_backend(req, body):
+            async def chunks():
+                yield b"first-chunk"
+                raise IOError("upstream blew up")
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        async with serve_proxy_pair(dict(backend=flaky_backend)) as base:
+            resp = await http11.http_request("GET", f"{base}/flaky")
+            body = await resp.read_all()
+            assert resp.status == 200
+            assert body == b"first-chunk"
+
+    asyncio.run(run())
+
+
+def test_advertise_prefix_through_tunnel():
+    """--advertise /v1: consumer sends /v1/models, upstream sees /models
+    (the C13 test_upstream.py scenario)."""
+    async def run():
+        async def bare_handler(req: HttpRequest) -> HttpResponse:
+            if req.path == "/models":
+                return HttpResponse(200, {}, b'{"data":[{"id":"bare-model"}]}')
+            return HttpResponse(404, {}, b"not found")
+
+        async with tunnel_stack(upstream_handler=bare_handler, advertise="/v1") as base:
+            resp = await http11.http_request("GET", f"{base}/v1/models")
+            assert resp.status == 200
+            assert b"bare-model" in await resp.read_all()
+
+    asyncio.run(run())
+
+
+def test_tunnel_death_midstream_unblocks_client():
+    """If the channel dies while a response is streaming, the client's body
+    must terminate instead of hanging forever (code-review r2 finding #1)."""
+    async def run():
+        serve_ch, proxy_ch = loopback_pair()
+        started = asyncio.Event()
+
+        async def stalling_backend(req, body):
+            async def chunks():
+                yield b"alive"
+                started.set()
+                await asyncio.sleep(3600)
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        ready: asyncio.Future = asyncio.get_running_loop().create_future()
+        serve_task = asyncio.create_task(run_serve(serve_ch, backend=stalling_backend))
+        proxy_task = asyncio.create_task(run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready))
+        port = await asyncio.wait_for(ready, 5.0)
+        try:
+            resp = await http11.http_request("GET", f"http://127.0.0.1:{port}/stall")
+            agen = resp.iter_chunks()
+            first = await asyncio.wait_for(agen.__anext__(), 5.0)
+            assert first == b"alive"
+            await started.wait()
+            serve_ch.close()  # kill the tunnel mid-body
+            # Body must end (StopAsyncIteration) promptly, not hang.
+            with contextlib.suppress(StopAsyncIteration):
+                await asyncio.wait_for(agen.__anext__(), 5.0)
+        finally:
+            serve_task.cancel()
+            proxy_task.cancel()
+            await asyncio.gather(serve_task, proxy_task, return_exceptions=True)
+
+    asyncio.run(run())
+
+
+def test_hop_by_hop_headers_stripped():
+    """host/connection/transfer-encoding must not reach the upstream
+    (serve.rs:207-212)."""
+    async def run():
+        seen = {}
+
+        async def capture_handler(req: HttpRequest) -> HttpResponse:
+            seen.update(req.headers)
+            return HttpResponse(200, {}, b"ok")
+
+        async with tunnel_stack(upstream_handler=capture_handler) as base:
+            resp = await http11.http_request(
+                "GET", f"{base}/capture", {"x-custom": "yes", "connection": "keep-alive"}
+            )
+            await resp.read_all()
+            assert seen.get("x-custom") == "yes"
+            # The serve endpoint strips the tunneled hop-by-hop values; the
+            # http client adds its own fresh host/connection for its own hop.
+            assert seen.get("connection") != "keep-alive"
+
+    asyncio.run(run())

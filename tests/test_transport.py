@@ -1,0 +1,87 @@
+"""Loopback transport contract tests (DataChannelPair semantics, rtc.rs:23-28)."""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.transport import ChannelClosed, loopback_pair
+
+
+def test_send_recv_roundtrip():
+    async def run():
+        a, b = loopback_pair()
+        await a.send(b"hello")
+        await b.send(b"world")
+        assert await b.recv() == b"hello"
+        assert await a.recv() == b"world"
+
+    asyncio.run(run())
+
+
+def test_order_preserved():
+    async def run():
+        a, b = loopback_pair()
+        for i in range(100):
+            await a.send(bytes([i]))
+        got = [await b.recv() for _ in range(100)]
+        assert got == [bytes([i]) for i in range(100)]
+
+    asyncio.run(run())
+
+
+def test_connected_immediately():
+    async def run():
+        a, b = loopback_pair()
+        assert a.connected.is_set() and b.connected.is_set()
+        assert not a.disconnected.is_set() and not b.disconnected.is_set()
+
+    asyncio.run(run())
+
+
+def test_close_propagates_to_peer():
+    async def run():
+        a, b = loopback_pair()
+        a.close()
+        assert a.disconnected.is_set()
+        assert b.disconnected.is_set()
+        with pytest.raises(ChannelClosed):
+            await b.recv()
+        with pytest.raises(ChannelClosed):
+            await a.send(b"x")
+
+    asyncio.run(run())
+
+
+def test_close_drains_pending_messages_then_raises():
+    async def run():
+        a, b = loopback_pair()
+        await a.send(b"one")
+        await a.send(b"two")
+        a.close()
+        # Messages already delivered are still readable.
+        assert await b.recv() == b"one"
+        assert await b.recv() == b"two"
+        with pytest.raises(ChannelClosed):
+            await b.recv()
+
+    asyncio.run(run())
+
+
+def test_multiple_waiters_all_wake_on_close():
+    async def run():
+        a, b = loopback_pair()
+
+        async def waiter():
+            try:
+                await b.recv()
+                return "got"
+            except ChannelClosed:
+                return "closed"
+
+        tasks = [asyncio.create_task(waiter()) for _ in range(4)]
+        await asyncio.sleep(0.01)
+        a.close()
+        results = await asyncio.gather(*tasks)
+        assert results == ["closed"] * 4
+
+    asyncio.run(run())
