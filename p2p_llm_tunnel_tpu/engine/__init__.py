@@ -1,0 +1,11 @@
+"""The in-process TPU inference engine.
+
+Replaces the reference's external HTTP LLM upstream (the reqwest hop at
+serve.rs:219) with jitted JAX prefill/decode over a slot-based KV cache,
+continuous batching, and OpenAI/Ollama-shaped streaming APIs.
+"""
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.engine.api import engine_backend
+
+__all__ = ["EngineConfig", "InferenceEngine", "engine_backend"]

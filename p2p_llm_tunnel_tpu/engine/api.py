@@ -1,0 +1,313 @@
+"""OpenAI- and Ollama-shaped API over the in-process engine.
+
+This is the serve-endpoint backend that replaces the reference's external
+HTTP hop (serve.rs:219): instead of forwarding frames to an upstream LLM
+server, requests terminate here and stream straight out of the TPU decode
+loop — one RES_BODY frame per SSE event.
+
+Surfaces (BASELINE.md configs):
+- OpenAI: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
+- Ollama: GET /api/tags, POST /api/generate, POST /api/chat
+- GET /health
+
+SSE chunk shape matches the conformance fixture tmp/mock_llm.py:36-88.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import AsyncIterator, Dict, Tuple
+
+from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_JSON = {"content-type": "application/json"}
+_SSE = {"content-type": "text/event-stream", "cache-control": "no-cache"}
+_NDJSON = {"content-type": "application/x-ndjson"}
+
+
+async def _once(data: bytes) -> AsyncIterator[bytes]:
+    yield data
+
+
+def _json_response(status: int, obj) -> Tuple[int, Dict[str, str], AsyncIterator[bytes]]:
+    return status, dict(_JSON), _once(json.dumps(obj).encode())
+
+
+def _error(status: int, message: str):
+    return _json_response(status, {"error": {"message": message, "type": "invalid_request_error"}})
+
+
+def render_chat_prompt(messages) -> str:
+    """Flatten an OpenAI messages list into a plain prompt.
+
+    Deliberately template-minimal: real chat templates are tokenizer-specific
+    and belong to the checkpoint adapter; this keeps the byte-level path
+    deterministic.
+    """
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        parts.append(f"{role}: {content}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+class EngineAPI:
+    """Routes tunneled requests to the engine; one instance per serve peer."""
+
+    def __init__(self, engine: InferenceEngine, model_name: str | None = None):
+        self.engine = engine
+        self.model_name = model_name or engine.mcfg.name
+
+    # -- shared generation plumbing --------------------------------------
+
+    def _gen_kwargs(self, body: dict) -> dict:
+        """Extract sampling/generation controls; raises ValueError on invalid
+        values so the router can 400 *before* any stream starts."""
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = body.get("max_new_tokens")
+        max_tokens = 64 if max_tokens is None else int(max_tokens)
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        temperature = float(body.get("temperature") or 0.0)
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        return dict(
+            max_new_tokens=max_tokens,
+            temperature=temperature,
+            top_k=int(body.get("top_k") or 0),
+            top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
+        )
+
+    def _check_prompt(self, prompt_ids) -> None:
+        """Reject unservable prompts eagerly (scheduler would raise lazily,
+        after a streaming 200 has already gone out)."""
+        if not prompt_ids:
+            raise ValueError("prompt must be non-empty")
+        max_seq = self.engine.ecfg.max_seq
+        if len(prompt_ids) >= max_seq:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds max context {max_seq}"
+            )
+
+    # -- OpenAI ----------------------------------------------------------
+
+    def _models_payload(self):
+        return {
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model", "owned_by": "p2p-llm-tunnel-tpu"}],
+        }
+
+    async def _openai_stream(
+        self, prompt_ids, kwargs, object_name: str, completion_id: str
+    ) -> AsyncIterator[bytes]:
+        def chunk(delta, finish):
+            return (
+                "data: "
+                + json.dumps(
+                    {
+                        "id": completion_id,
+                        "object": object_name,
+                        "created": int(time.time()),
+                        "model": self.model_name,
+                        "choices": [
+                            {"index": 0, "delta": delta, "finish_reason": finish}
+                        ],
+                    }
+                )
+                + "\n\n"
+            ).encode()
+
+        finish_reason = "stop"
+        async for ev in self.engine.generate(prompt_ids, **kwargs):
+            if ev.text:
+                yield chunk({"content": ev.text}, None)
+            if ev.finish_reason is not None:
+                finish_reason = ev.finish_reason
+        yield chunk({}, finish_reason)
+        yield b"data: [DONE]\n\n"
+
+    async def _openai_complete(self, prompt_ids, kwargs, chat: bool):
+        text = []
+        finish_reason = "stop"
+        n_tokens = 0
+        async for ev in self.engine.generate(prompt_ids, **kwargs):
+            n_tokens += 1
+            if ev.text:
+                text.append(ev.text)
+            if ev.finish_reason is not None:
+                finish_reason = ev.finish_reason
+        content = "".join(text)
+        usage = {
+            "prompt_tokens": len(prompt_ids),
+            "completion_tokens": n_tokens,
+            "total_tokens": len(prompt_ids) + n_tokens,
+        }
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish_reason,
+            }
+            obj_name = "chat.completion"
+        else:
+            choice = {"index": 0, "text": content, "finish_reason": finish_reason}
+            obj_name = "text_completion"
+        return _json_response(
+            200,
+            {
+                "id": f"cmpl-{int(time.time() * 1000)}",
+                "object": obj_name,
+                "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [choice],
+                "usage": usage,
+            },
+        )
+
+    # -- Ollama ----------------------------------------------------------
+
+    async def _ollama_generate_stream(self, prompt_ids, kwargs) -> AsyncIterator[bytes]:
+        finish = "stop"
+        async for ev in self.engine.generate(prompt_ids, **kwargs):
+            if ev.finish_reason is not None:
+                finish = ev.finish_reason
+            if ev.text:
+                yield (json.dumps(
+                    {"model": self.model_name, "response": ev.text, "done": False}
+                ) + "\n").encode()
+        yield (json.dumps(
+            {"model": self.model_name, "response": "", "done": True,
+             "done_reason": finish}
+        ) + "\n").encode()
+
+    async def _ollama_chat_stream(self, prompt_ids, kwargs) -> AsyncIterator[bytes]:
+        finish = "stop"
+        async for ev in self.engine.generate(prompt_ids, **kwargs):
+            if ev.finish_reason is not None:
+                finish = ev.finish_reason
+            if ev.text:
+                yield (json.dumps(
+                    {"model": self.model_name,
+                     "message": {"role": "assistant", "content": ev.text},
+                     "done": False}
+                ) + "\n").encode()
+        yield (json.dumps(
+            {"model": self.model_name,
+             "message": {"role": "assistant", "content": ""},
+             "done": True, "done_reason": finish}
+        ) + "\n").encode()
+
+    # -- router ----------------------------------------------------------
+
+    async def handle(self, req: RequestHeaders, body: bytes):
+        path = req.path.split("?")[0]
+        method = req.method.upper()
+
+        if method == "GET" and path == "/health":
+            return 200, {"content-type": "text/plain"}, _once(b"ok")
+        if method == "GET" and path == "/v1/models":
+            return _json_response(200, self._models_payload())
+        if method == "GET" and path == "/api/tags":
+            return _json_response(
+                200, {"models": [{"name": self.model_name, "model": self.model_name}]}
+            )
+
+        if method != "POST":
+            return _error(405, f"method {method} not allowed on {path}")
+
+        try:
+            payload = json.loads(body) if body else {}
+        except json.JSONDecodeError as e:
+            return _error(400, f"invalid JSON body: {e}")
+
+        try:
+            kwargs = self._gen_kwargs(payload)
+            stream = bool(
+                payload.get("stream", path == "/api/generate" or path == "/api/chat")
+            )
+
+            if path == "/v1/chat/completions":
+                messages = payload.get("messages")
+                if not isinstance(messages, list):
+                    return _error(400, "messages must be a list")
+                prompt_ids = self.engine.tokenizer.encode(render_chat_prompt(messages))
+                self._check_prompt(prompt_ids)
+                if stream:
+                    cid = f"chatcmpl-{int(time.time() * 1000)}"
+                    return 200, dict(_SSE), self._openai_stream(
+                        prompt_ids, kwargs, "chat.completion.chunk", cid
+                    )
+                return await self._openai_complete(prompt_ids, kwargs, chat=True)
+
+            if path == "/v1/completions":
+                prompt = payload.get("prompt", "")
+                if isinstance(prompt, list):
+                    prompt = "".join(prompt)
+                prompt_ids = self.engine.tokenizer.encode(str(prompt))
+                self._check_prompt(prompt_ids)
+                if stream:
+                    cid = f"cmpl-{int(time.time() * 1000)}"
+                    return 200, dict(_SSE), self._openai_stream(
+                        prompt_ids, kwargs, "text_completion.chunk", cid
+                    )
+                return await self._openai_complete(prompt_ids, kwargs, chat=False)
+
+            if path == "/api/generate":
+                prompt_ids = self.engine.tokenizer.encode(str(payload.get("prompt", "")))
+                self._check_prompt(prompt_ids)
+                if stream:
+                    return 200, dict(_NDJSON), self._ollama_generate_stream(
+                        prompt_ids, kwargs
+                    )
+                text, n, finish = await self._drain(prompt_ids, kwargs)
+                return _json_response(
+                    200, {"model": self.model_name, "response": text, "done": True,
+                          "done_reason": finish, "eval_count": n},
+                )
+
+            if path == "/api/chat":
+                messages = payload.get("messages") or []
+                prompt_ids = self.engine.tokenizer.encode(render_chat_prompt(messages))
+                self._check_prompt(prompt_ids)
+                if stream:
+                    return 200, dict(_NDJSON), self._ollama_chat_stream(
+                        prompt_ids, kwargs
+                    )
+                text, n, finish = await self._drain(prompt_ids, kwargs)
+                return _json_response(
+                    200, {"model": self.model_name,
+                          "message": {"role": "assistant", "content": text},
+                          "done": True, "done_reason": finish, "eval_count": n},
+                )
+        except (ValueError, TypeError) as e:
+            return _error(400, str(e))
+
+        return _error(404, f"unknown path {path}")
+
+    async def _drain(self, prompt_ids, kwargs):
+        parts, n, finish = [], 0, "stop"
+        async for ev in self.engine.generate(prompt_ids, **kwargs):
+            n += 1
+            if ev.text:
+                parts.append(ev.text)
+            if ev.finish_reason is not None:
+                finish = ev.finish_reason
+        return "".join(parts), n, finish
+
+
+def engine_backend(engine: InferenceEngine, model_name: str | None = None):
+    """Adapter: EngineAPI as a serve-endpoint Backend (endpoints/serve.py)."""
+    api = EngineAPI(engine, model_name)
+
+    async def backend(req: RequestHeaders, body: bytes):
+        return await api.handle(req, body)
+
+    return backend

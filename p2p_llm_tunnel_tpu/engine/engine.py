@@ -1,0 +1,339 @@
+"""The inference engine: jitted prefill/decode over slot-batched KV cache.
+
+Continuous batching, TPU-style (SURVEY.md §7 hard-part #1): the KV cache has
+``num_slots`` fixed rows; every decode step runs ONE fixed-shape XLA program
+over all slots (inactive rows compute but are masked at sampling), so
+admission/eviction never recompiles.  Prompts prefill into padded power-of-2
+buckets to bound the number of compiled prefill programs.
+
+Async contract: ``generate()`` yields TokenEvents as decode steps finish;
+requests admit/evict between steps; blocking XLA calls run in an executor
+thread so the tunnel's event loop never stalls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_llm_tunnel_tpu.engine import sampling
+from p2p_llm_tunnel_tpu.engine.scheduler import GenRequest, RunningSlot, Scheduler
+from p2p_llm_tunnel_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, Tokenizer
+from p2p_llm_tunnel_tpu.models.config import ModelConfig, get_config
+from p2p_llm_tunnel_tpu.models.transformer import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill_into_cache,
+)
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+log = get_logger(__name__)
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny"
+    num_slots: int = 8
+    max_seq: int = 256
+    dtype: str = "bfloat16"
+    seed: int = 0
+    min_prefill_bucket: int = 16
+
+
+@dataclass
+class TokenEvent:
+    token_id: int
+    text: str
+    finish_reason: Optional[str] = None  # "stop" | "length" on the last event
+
+
+@dataclass
+class _ActiveRequest:
+    queue: "asyncio.Queue[Optional[TokenEvent]]"
+    decoder: StreamDecoder
+    t_submit: float
+    first_token_at: Optional[float] = None
+
+
+class InferenceEngine:
+    """Slot-batched continuous-decode engine over one model."""
+
+    def __init__(
+        self,
+        model_cfg: Optional[ModelConfig] = None,
+        engine_cfg: Optional[EngineConfig] = None,
+        params=None,
+        tokenizer: Optional[Tokenizer] = None,
+        mesh=None,
+        param_shardings=None,
+    ):
+        self.ecfg = engine_cfg or EngineConfig()
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.mcfg = model_cfg or get_config(
+            self.ecfg.model, vocab_size=self.tokenizer.vocab_size
+        )
+        dtype = jnp.dtype(self.ecfg.dtype)
+        key = jax.random.PRNGKey(self.ecfg.seed)
+        if params is None:
+            log.info("initialising random params for %s", self.mcfg.name)
+            params = init_params(self.mcfg, key, dtype)
+        self.params = params
+        self.mesh = mesh
+        self.param_shardings = param_shardings
+
+        b, s = self.ecfg.num_slots, self.ecfg.max_seq
+        self.kv_cache = init_kv_cache(self.mcfg, b, s, dtype)
+        self.scheduler = Scheduler(b, s)
+
+        # Host-side per-slot state driving each decode step.
+        self._last_token = np.zeros((b,), np.int32)
+        self._positions = np.zeros((b,), np.int32)
+        self._active_mask = np.zeros((b,), bool)
+        self._temp = np.zeros((b,), np.float32)
+        self._top_k = np.zeros((b,), np.int32)
+        self._top_p = np.ones((b,), np.float32)
+
+        self._requests: Dict[int, _ActiveRequest] = {}
+        self._next_request_id = 1
+        self._key = jax.random.fold_in(key, 1)
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+        # Dedicated single thread for blocking XLA calls: sharing the default
+        # executor starves decode when other components run blocking work.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="engine-xla"
+        )
+
+        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._jit_prefill = jax.jit(
+            self._prefill_fn, donate_argnums=(1,), static_argnums=()
+        )
+
+    # -- XLA programs -----------------------------------------------------
+
+    def _decode_fn(self, params, kv_cache, tokens, positions, samp, key):
+        logits, kv_cache = decode_step(self.mcfg, params, kv_cache, tokens, positions)
+        sampled = sampling.sample(logits, samp, key)
+        return sampled, kv_cache
+
+    def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
+        last_logits, kv_cache = prefill_into_cache(
+            self.mcfg, params, tokens, lengths, kv_cache, slots
+        )
+        first = sampling.sample(last_logits, samp, key)
+        return first, kv_cache
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._running = True
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        # Unblock every in-flight generate() consumer.
+        for state in list(self._requests.values()):
+            state.queue.put_nowait(None)
+        self._executor.shutdown(wait=False)
+
+    # -- public API -------------------------------------------------------
+
+    async def generate(
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_ids: Optional[Tuple[int, ...]] = None,
+    ) -> AsyncIterator[TokenEvent]:
+        """Submit one request; yields TokenEvents as the batch decodes."""
+        if stop_ids is None:
+            stop_ids = (self.tokenizer.eos_id,)
+        rid = self._next_request_id
+        self._next_request_id += 1
+        req = GenRequest(
+            request_id=rid,
+            prompt_ids=list(prompt_ids),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            stop_ids=tuple(stop_ids),
+        )
+        state = _ActiveRequest(
+            queue=asyncio.Queue(), decoder=StreamDecoder(self.tokenizer),
+            t_submit=time.monotonic(),
+        )
+        self._requests[rid] = state
+        self.scheduler.submit(req)
+        global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
+        self._wake.set()
+
+        try:
+            while True:
+                event = await state.queue.get()
+                if event is None:
+                    return
+                yield event
+                if event.finish_reason is not None:
+                    return
+        finally:
+            self._requests.pop(rid, None)
+            self.scheduler.cancel(rid)
+
+    # -- engine loop ------------------------------------------------------
+
+    def _emit(self, run: RunningSlot, token_id: int, evicted: bool) -> None:
+        rid = run.request.request_id
+        state = self._requests.get(rid)
+        if state is None:
+            return  # consumer went away; scheduler cancel happens in generate()
+        if state.first_token_at is None:
+            state.first_token_at = time.monotonic()
+            global_metrics.observe(
+                "engine_ttft_ms", (state.first_token_at - state.t_submit) * 1000.0
+            )
+        global_metrics.inc("engine_tokens_total")
+        is_stop = token_id in run.request.stop_ids
+        finish = None
+        if evicted:
+            finish = "stop" if is_stop else "length"
+        text = "" if is_stop else state.decoder.push(token_id)
+        state.queue.put_nowait(TokenEvent(token_id, text, finish))
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, n: int) -> int:
+        b = self.ecfg.min_prefill_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.ecfg.max_seq)
+
+    def _do_prefill(self, run: RunningSlot) -> int:
+        """Blocking: prefill one admitted prompt into its slot; returns first token."""
+        ids = run.request.prompt_ids
+        t = self._bucket(len(ids))
+        tokens = np.zeros((1, t), np.int32)
+        tokens[0, : len(ids)] = ids
+        samp = sampling.SamplingParams(
+            temperature=jnp.array([run.request.temperature], jnp.float32),
+            top_k=jnp.array([run.request.top_k], jnp.int32),
+            top_p=jnp.array([run.request.top_p], jnp.float32),
+        )
+        first, self.kv_cache = self._jit_prefill(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(tokens),
+            jnp.array([len(ids)], jnp.int32),
+            jnp.array([run.slot], jnp.int32),
+            samp,
+            self._next_key(),
+        )
+        global_metrics.inc("engine_prefill_tokens_total", len(ids))
+        return int(jax.device_get(first)[0])
+
+    def _do_decode(self) -> np.ndarray:
+        """Blocking: one decode step over all slots; returns sampled [B]."""
+        samp = sampling.SamplingParams(
+            temperature=jnp.asarray(self._temp),
+            top_k=jnp.asarray(self._top_k),
+            top_p=jnp.asarray(self._top_p),
+        )
+        sampled, self.kv_cache = self._jit_decode(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(self._last_token),
+            jnp.asarray(self._positions),
+            samp,
+            self._next_key(),
+        )
+        return np.asarray(jax.device_get(sampled))
+
+    def _admit_one(self, run: RunningSlot) -> None:
+        """Set up host slot state after prefill admission."""
+        i = run.slot
+        req = run.request
+        self._active_mask[i] = True
+        self._positions[i] = run.cache_len
+        self._temp[i] = req.temperature
+        self._top_k[i] = req.top_k
+        self._top_p[i] = req.top_p
+
+    async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        log.info(
+            "engine loop started: model=%s slots=%d max_seq=%d",
+            self.mcfg.name, self.ecfg.num_slots, self.ecfg.max_seq,
+        )
+        while self._running:
+            if self.scheduler.idle:
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    continue
+                continue
+
+            # Admission: prefill each newly-admitted prompt into its slot.
+            for run in self.scheduler.admit():
+                first = await loop.run_in_executor(
+                    self._executor, self._do_prefill, run
+                )
+                if self.scheduler.slots[run.slot] is not run:
+                    # Consumer cancelled while the prefill was in flight; the
+                    # slot is already free (or re-used) — drop the result.
+                    continue
+                self._admit_one(run)
+                out = self.scheduler.record_token(run.slot, first)
+                evicted = self.scheduler.slots[run.slot] is None
+                if evicted:
+                    self._active_mask[run.slot] = False
+                else:
+                    self._last_token[run.slot] = first
+                    # The generated token's own position: it is written to the
+                    # cache by the decode step that consumes it.
+                    self._positions[run.slot] = out.cache_len - 1
+                self._emit(out, first, evicted)
+
+            global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
+            global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
+
+            if not any(self._active_mask):
+                continue
+
+            sampled = await loop.run_in_executor(self._executor, self._do_decode)
+            for i in np.nonzero(self._active_mask)[0]:
+                run = self.scheduler.slots[i]
+                if run is None:  # cancelled between steps
+                    self._active_mask[i] = False
+                    continue
+                tok = int(sampled[i])
+                out = self.scheduler.record_token(i, tok)
+                evicted = self.scheduler.slots[i] is None
+                if evicted:
+                    self._active_mask[i] = False
+                else:
+                    self._last_token[i] = tok
+                    self._positions[i] = out.cache_len - 1
+                self._emit(out, tok, evicted)
+            # Yield to the event loop so emitted tokens flush to consumers.
+            await asyncio.sleep(0)
+        log.info("engine loop stopped")

@@ -1,0 +1,69 @@
+"""Jitted token sampling: greedy, temperature, top-k, top-p.
+
+One fixed-shape sampler over the whole slot batch per decode step — sampling
+params are per-slot *arrays*, so mixed requests (different temperatures) batch
+together into a single XLA program, no recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot sampling controls (all [B] arrays inside the engine)."""
+
+    temperature: jnp.ndarray  # 0 → greedy
+    top_k: jnp.ndarray  # 0 → disabled
+    top_p: jnp.ndarray  # 1.0 → disabled
+
+
+def make_params(batch, temperature=0.0, top_k=0, top_p=1.0) -> SamplingParams:
+    return SamplingParams(
+        temperature=jnp.full((batch,), temperature, jnp.float32),
+        top_k=jnp.full((batch,), top_k, jnp.int32),
+        top_p=jnp.full((batch,), top_p, jnp.float32),
+    )
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] fp32
+    params: SamplingParams,
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Sample one token per row. Greedy rows (temperature==0) are exact."""
+    b, v = logits.shape
+
+    # Temperature (guard the greedy rows against div-by-zero).
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Top-k: mask everything below the k-th largest. k==0 disables.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B,V] descending
+    k = jnp.clip(params.top_k, 0, v)
+    kth_idx = jnp.clip(k - 1, 0, v - 1)
+    kth_val = jnp.take_along_axis(sorted_desc, kth_idx[:, None], axis=-1)
+    scaled = jnp.where(
+        (k[:, None] > 0) & (scaled < kth_val), -jnp.inf, scaled
+    )
+
+    # Top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # whose cumulative probability exceeds p. p>=1 disables.
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # token i is kept if the cumulative mass *before* it is < p
+    keep_sorted = (cum - probs_sorted) < params.top_p[:, None]
+    cutoff = jnp.where(
+        keep_sorted, sorted_desc2, jnp.inf
+    ).min(axis=-1, keepdims=True)  # smallest kept logit
+    scaled = jnp.where(
+        (params.top_p[:, None] < 1.0) & (scaled < cutoff), -jnp.inf, scaled
+    )
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)

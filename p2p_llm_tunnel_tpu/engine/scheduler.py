@@ -1,0 +1,122 @@
+"""Continuous-batching slot scheduler (pure logic, no JAX, no IO).
+
+SURVEY.md §7 hard-part #1: map an unbounded set of concurrent streams onto a
+fixed number of static-shape decode slots.  The scheduler owns admission
+(FIFO with slot+capacity checks) and eviction (EOS / token budget / cache
+full); the engine drives it and runs the XLA programs.  Pure and synchronous
+so it is unit-testable against fake streams (tests/test_scheduler.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+
+@dataclass
+class GenRequest:
+    """One generation request as admitted to the batch."""
+
+    request_id: int
+    prompt_ids: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_ids: tuple = ()
+
+    def __post_init__(self) -> None:
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass
+class RunningSlot:
+    request: GenRequest
+    slot: int
+    cache_len: int  # prompt tokens written so far + generated tokens
+    generated: List[int] = field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        if len(self.generated) >= self.request.max_new_tokens:
+            return True
+        return bool(self.generated) and self.generated[-1] in self.request.stop_ids
+
+
+class Scheduler:
+    """Fixed-slot admission/eviction; FIFO among waiting requests."""
+
+    def __init__(self, num_slots: int, max_seq: int):
+        if num_slots < 1:
+            raise ValueError("need at least one slot")
+        self.num_slots = num_slots
+        self.max_seq = max_seq
+        self.waiting: Deque[GenRequest] = deque()
+        self.slots: List[Optional[RunningSlot]] = [None] * num_slots
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> None:
+        if len(req.prompt_ids) >= self.max_seq:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens does not fit max_seq={self.max_seq}"
+            )
+        self.waiting.append(req)
+
+    def admit(self) -> List[RunningSlot]:
+        """Move waiting requests into free slots (FIFO). Returns admissions."""
+        admitted: List[RunningSlot] = []
+        for i in range(self.num_slots):
+            if not self.waiting:
+                break
+            if self.slots[i] is None:
+                req = self.waiting.popleft()
+                run = RunningSlot(req, i, cache_len=len(req.prompt_ids))
+                self.slots[i] = run
+                admitted.append(run)
+        return admitted
+
+    # -- stepping ---------------------------------------------------------
+
+    def active(self) -> List[RunningSlot]:
+        return [s for s in self.slots if s is not None]
+
+    def record_token(self, slot: int, token_id: int) -> RunningSlot:
+        """Account one generated token; evicts the slot if finished."""
+        run = self.slots[slot]
+        assert run is not None, f"token for free slot {slot}"
+        run.generated.append(token_id)
+        run.cache_len += 1
+        if run.done or run.cache_len >= self.max_seq:
+            self.slots[slot] = None
+        return run
+
+    def cancel(self, request_id: int) -> bool:
+        """Drop a request wherever it is (queue or slot)."""
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id:
+                del self.waiting[i]
+                return True
+        for i, run in enumerate(self.slots):
+            if run is not None and run.request.request_id == request_id:
+                self.slots[i] = None
+                return True
+        return False
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def occupancy(self) -> float:
+        return sum(s is not None for s in self.slots) / self.num_slots
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and all(s is None for s in self.slots)

@@ -1,0 +1,97 @@
+"""Tokenizers: a dependency-free byte-level tokenizer for tests/benches and
+an adapter for HuggingFace tokenizers for real checkpoints."""
+
+from __future__ import annotations
+
+from typing import List, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+
+    @property
+    def vocab_size(self) -> int: ...
+
+    def encode(self, text: str) -> List[int]: ...
+
+    def decode(self, ids: List[int]) -> str: ...
+
+    def decode_token(self, token_id: int) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + BOS/EOS/PAD. Deterministic, zero deps, vocab 259.
+
+    ``decode_token`` is incremental-safe for ASCII; multi-byte codepoints are
+    buffered by StreamDecoder below.
+    """
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+
+    bos_id = BOS
+    eos_id = EOS
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", "replace")
+
+    def decode_token(self, token_id: int) -> str:
+        if token_id >= 256:
+            return ""
+        return bytes([token_id]).decode("utf-8", "replace")
+
+
+class StreamDecoder:
+    """Incremental detokenizer that never emits broken UTF-8 mid-codepoint.
+
+    Only the undecoded tail is kept, so each push costs O(pending tokens)
+    (normally 1-4), not O(all tokens so far).
+    """
+
+    def __init__(self, tokenizer) -> None:
+        self._tok = tokenizer
+        self._pending: List[int] = []
+
+    def push(self, token_id: int) -> str:
+        """Feed one token id; returns newly-complete text (may be '')."""
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
+        # A trailing replacement char usually means a split codepoint; hold
+        # the pending ids until the codepoint completes.
+        if text.endswith("�") and len(self._pending) < 8:
+            return ""
+        self._pending.clear()
+        return text
+
+
+class HFTokenizer:
+    """transformers.AutoTokenizer adapter (lazy import; CPU-only dep)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # lazy: big import
+
+        self._t = AutoTokenizer.from_pretrained(name_or_path)
+        self.bos_id = self._t.bos_token_id or 0
+        self.eos_id = self._t.eos_token_id or 0
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._t)
+
+    def encode(self, text: str) -> List[int]:
+        return self._t.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._t.decode(ids, skip_special_tokens=True)
+
+    def decode_token(self, token_id: int) -> str:
+        return self._t.decode([token_id], skip_special_tokens=True)

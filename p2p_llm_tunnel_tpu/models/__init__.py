@@ -1,0 +1,23 @@
+"""Model families: functional JAX decoder-only transformers.
+
+Net-new relative to the reference, which has zero ML code (SURVEY.md §2) —
+this is the in-process replacement for its external HTTP LLM upstream.
+"""
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig, PRESETS, get_config
+from p2p_llm_tunnel_tpu.models.transformer import (
+    init_params,
+    prefill,
+    decode_step,
+    init_kv_cache,
+)
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "get_config",
+    "init_params",
+    "prefill",
+    "decode_step",
+    "init_kv_cache",
+]

@@ -1,0 +1,151 @@
+"""Model architecture configs.
+
+Covers the BASELINE.md graduation ladder: a tiny CPU-testable config, the
+Gemma-2 2B and Llama-3 8B single-chip targets, and Llama-3 70B for
+tensor-parallel v5e-8.  Architectural knobs cover both families:
+
+- llama-style: RMSNorm(w), SwiGLU, GQA, rope, untied head (8B/70B)
+- gemma2-style: RMSNorm(1+w), GeGLU, pre+post norms, logit/attn softcap,
+  alternating sliding-window attention, tied embeddings
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    ffn_dim: int
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    act: str = "silu"  # "silu" (llama SwiGLU) | "gelu" (gemma GeGLU)
+    tie_embeddings: bool = False
+    # gemma2-specific behaviors (all inert when at defaults):
+    post_norms: bool = False  # extra RMSNorm after attn/mlp blocks
+    attn_softcap: Optional[float] = None
+    logit_softcap: Optional[float] = None
+    sliding_window: Optional[int] = None  # applied on alternating layers
+    embed_scale: bool = False  # multiply embeddings by sqrt(dim)
+    # attention score scale; None → 1/sqrt(head_dim)
+    query_scale: Optional[float] = None
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+def tiny(vocab_size: int = 512) -> ModelConfig:
+    """CPU-testable config: compiles in seconds, exercises GQA + rope."""
+    return ModelConfig(
+        name="tiny",
+        vocab_size=vocab_size,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        ffn_dim=128,
+    )
+
+
+def tiny_gemma(vocab_size: int = 512) -> ModelConfig:
+    """Tiny config exercising every gemma2 code path on CPU."""
+    return ModelConfig(
+        name="tiny-gemma",
+        vocab_size=vocab_size,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        ffn_dim=128,
+        act="gelu",
+        tie_embeddings=True,
+        post_norms=True,
+        attn_softcap=50.0,
+        logit_softcap=30.0,
+        sliding_window=8,
+        embed_scale=True,
+    )
+
+
+def gemma2_2b() -> ModelConfig:
+    return ModelConfig(
+        name="gemma2-2b",
+        vocab_size=256128,
+        dim=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=256,
+        ffn_dim=9216,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        act="gelu",
+        tie_embeddings=True,
+        post_norms=True,
+        attn_softcap=50.0,
+        logit_softcap=30.0,
+        sliding_window=4096,
+        embed_scale=True,
+        query_scale=256**-0.5,
+    )
+
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=14336,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+    )
+
+
+def llama3_70b() -> ModelConfig:
+    return ModelConfig(
+        name="llama3-70b",
+        vocab_size=128256,
+        dim=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=28672,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+    )
+
+
+PRESETS = {
+    "tiny": tiny,
+    "tiny-gemma": tiny_gemma,
+    "gemma2-2b": gemma2_2b,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[name]()
+    if overrides:
+        from dataclasses import replace
+
+        cfg = replace(cfg, **overrides)
+    return cfg

@@ -1,0 +1,278 @@
+"""Functional decoder-only transformer (Llama/Gemma families) in pure JAX.
+
+TPU-first design choices:
+- params are pytrees of stacked per-layer arrays; the layer loop is a
+  ``lax.scan`` so an 80-layer model traces/compiles as one small program
+- everything is shape-static: padded prompt batches for prefill, a
+  fixed-slot KV cache written in place for decode (continuous batching
+  slots, SURVEY.md §7 hard-part #1)
+- bf16 params/activations, fp32 softmax/norm accumulations (MXU-friendly)
+- sharding-agnostic: callers place params/cache with NamedSharding and jit;
+  the same functions serve single-chip and tensor-parallel meshes
+
+The reference has no ML code at all (SURVEY.md §2); this module is the
+in-process upstream that replaces its reqwest→Ollama hop (serve.rs:219).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig
+from p2p_llm_tunnel_tpu.ops.attention import cached_attention, causal_attention
+from p2p_llm_tunnel_tpu.ops.norms import rms_norm
+from p2p_llm_tunnel_tpu.ops.rope import apply_rope
+
+Params = Dict[str, jnp.ndarray]
+KVCache = Dict[str, jnp.ndarray]  # {'k','v': [L, B, S, K, D]}
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random init (truncated-normal fan-in); layout matches checkpoint loader."""
+    l, dm, h, kh, hd, f, v = (
+        cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, cfg.ffn_dim, cfg.vocab_size,
+    )
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
+                * (fan_in**-0.5)).astype(dtype)
+
+    blocks = {
+        "attn_norm": jnp.zeros((l, dm), dtype) if cfg.post_norms else jnp.ones((l, dm), dtype),
+        "mlp_norm": jnp.zeros((l, dm), dtype) if cfg.post_norms else jnp.ones((l, dm), dtype),
+        "wq": dense(keys[0], (l, dm, h * hd), dm),
+        "wk": dense(keys[1], (l, dm, kh * hd), dm),
+        "wv": dense(keys[2], (l, dm, kh * hd), dm),
+        "wo": dense(keys[3], (l, h * hd, dm), h * hd),
+        "w_gate": dense(keys[4], (l, dm, f), dm),
+        "w_up": dense(keys[5], (l, dm, f), dm),
+        "w_down": dense(keys[6], (l, f, dm), f),
+    }
+    if cfg.post_norms:
+        blocks["post_attn_norm"] = jnp.zeros((l, dm), dtype)
+        blocks["post_mlp_norm"] = jnp.zeros((l, dm), dtype)
+
+    params: Params = {
+        "embed": dense(keys[7], (v, dm), dm),
+        "blocks": blocks,
+        "final_norm": jnp.zeros((dm,), dtype) if cfg.post_norms else jnp.ones((dm,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(jax.random.fold_in(key, 99), (dm, v), dm)
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_slots: int, max_seq: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.n_layers, num_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+# ---------------------------------------------------------------------------
+# shared block pieces
+# ---------------------------------------------------------------------------
+
+def _norm(cfg: ModelConfig, x, w):
+    return rms_norm(x, w, cfg.norm_eps, plus_one=cfg.post_norms)
+
+
+def _act(cfg: ModelConfig, x):
+    if cfg.act == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _mlp(cfg: ModelConfig, blk, h):
+    gate = _act(cfg, h @ blk["w_gate"]) * (h @ blk["w_up"])
+    return gate @ blk["w_down"]
+
+
+def _qkv(cfg: ModelConfig, blk, h, positions):
+    b, t, _ = h.shape
+    q = (h @ blk["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (h @ blk["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ blk["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _layer_window(cfg: ModelConfig, layer_idx, seq_len):
+    """Per-layer sliding-window size as a traced scalar (gemma-2 alternates
+    local/global layers); None when the config never uses windows."""
+    if cfg.sliding_window is None:
+        return None
+    use = (layer_idx % 2) == 0
+    return jnp.where(use, cfg.sliding_window, seq_len + 1)
+
+
+def _embed(cfg: ModelConfig, params, tokens):
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+    return x
+
+
+def _logits(cfg: ModelConfig, params, x):
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# prefill
+# ---------------------------------------------------------------------------
+
+def prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] right-padded
+    valid: jnp.ndarray,  # [B, T] bool
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full-prompt forward. Returns (logits [B,T,V], k, v [L,B,T,K,D])."""
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = _embed(cfg, params, tokens)
+    layer_idx = jnp.arange(cfg.n_layers)
+
+    def step(x, xs):
+        blk, idx = xs
+        h = _norm(cfg, x, blk["attn_norm"])
+        q, k, v = _qkv(cfg, blk, h, positions)
+        attn = causal_attention(
+            q, k, v, valid,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            window=_layer_window(cfg, idx, t),
+        )
+        attn = attn.reshape(b, t, -1) @ blk["wo"]
+        if cfg.post_norms:
+            attn = _norm(cfg, attn, blk["post_attn_norm"])
+        x = x + attn
+        h = _norm(cfg, x, blk["mlp_norm"])
+        mlp = _mlp(cfg, blk, h)
+        if cfg.post_norms:
+            mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+        x = x + mlp
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(step, x, (params["blocks"], layer_idx))
+    x = _norm(cfg, x, params["final_norm"])
+    return _logits(cfg, params, x), ks, vs
+
+
+def prefill_into_cache(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [Bp, T]
+    lengths: jnp.ndarray,  # [Bp]
+    kv_cache: KVCache,
+    slots: jnp.ndarray,  # [Bp] cache slot per prompt
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Prefill prompts and scatter their KV into cache slots.
+
+    Returns last-real-token logits [Bp, V] and the updated cache.  Positions
+    past a prompt's length hold junk KV, but decode overwrites position
+    ``length + n`` before it ever becomes attendable, so junk is never read.
+    """
+    b, t = tokens.shape
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    logits, ks, vs = prefill(cfg, params, tokens, valid)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]  # [Bp, V]
+
+    # [L,Bp,T,K,D] → scatter over slot axis of [L,Slots,S,K,D]
+    s_max = kv_cache["k"].shape[2]
+    ks = ks[:, :, :s_max]
+    vs = vs[:, :, :s_max]
+    t_w = ks.shape[2]
+    k_new = kv_cache["k"].at[:, slots, :t_w].set(ks)
+    v_new = kv_cache["v"].at[:, slots, :t_w].set(vs)
+    return last, {"k": k_new, "v": v_new}
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def decode_step(
+    cfg: ModelConfig,
+    params: Params,
+    kv_cache: KVCache,
+    tokens: jnp.ndarray,  # [B] one token per slot
+    positions: jnp.ndarray,  # [B] where this token goes in the cache
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One decode step over every slot. Returns (logits [B,V], new cache).
+
+    Static shapes throughout: inactive slots still compute (masked out by the
+    engine when sampling) — the XLA-friendly cost of continuous batching.
+    """
+    b = tokens.shape[0]
+    s = kv_cache["k"].shape[2]
+    x = _embed(cfg, params, tokens[:, None])  # [B,1,Dm]
+    pos2d = positions[:, None]  # [B,1]
+    layer_idx = jnp.arange(cfg.n_layers)
+    slot_ids = jnp.arange(b)
+
+    def step(x, xs):
+        blk, idx, k_cache_l, v_cache_l = xs
+        h = _norm(cfg, x, blk["attn_norm"])
+        q, k, v = _qkv(cfg, blk, h, pos2d)  # q [B,1,H,D], k/v [B,1,K,D]
+        k_cache_l = k_cache_l.at[slot_ids, positions].set(k[:, 0])
+        v_cache_l = v_cache_l.at[slot_ids, positions].set(v[:, 0])
+        attn = cached_attention(
+            q, k_cache_l, v_cache_l, positions,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            window=_layer_window(cfg, idx, s),
+        )
+        attn = attn.reshape(b, 1, -1) @ blk["wo"]
+        if cfg.post_norms:
+            attn = _norm(cfg, attn, blk["post_attn_norm"])
+        x = x + attn
+        h = _norm(cfg, x, blk["mlp_norm"])
+        mlp = _mlp(cfg, blk, h)
+        if cfg.post_norms:
+            mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+        x = x + mlp
+        return x, (k_cache_l, v_cache_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        step, x, (params["blocks"], layer_idx, kv_cache["k"], kv_cache["v"])
+    )
+    x = _norm(cfg, x, params["final_norm"])
+    logits = _logits(cfg, params, x)[:, 0]  # [B,V]
+    return logits, {"k": k_new, "v": v_new}
+
+
+# ---------------------------------------------------------------------------
+# training-style objective (used by __graft_entry__.dryrun_multichip)
+# ---------------------------------------------------------------------------
+
+def loss_fn(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B,T]
+    targets: jnp.ndarray,  # [B,T]
+    valid: jnp.ndarray,  # [B,T]
+) -> jnp.ndarray:
+    logits, _, _ = prefill(cfg, params, tokens, valid)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,13 @@
+"""TPU compute ops: rope, norms, attention (XLA path + Pallas kernels)."""
+
+from p2p_llm_tunnel_tpu.ops.rope import apply_rope, rope_table
+from p2p_llm_tunnel_tpu.ops.norms import rms_norm
+from p2p_llm_tunnel_tpu.ops.attention import causal_attention, cached_attention
+
+__all__ = [
+    "apply_rope",
+    "rope_table",
+    "rms_norm",
+    "causal_attention",
+    "cached_attention",
+]

@@ -1,0 +1,118 @@
+"""Attention ops: batched GQA for prefill (causal) and slot-decode (cached).
+
+XLA reference path — einsums the MXU tiles directly; fp32 softmax; optional
+gemma-2 score softcapping and sliding windows.  The Pallas flash kernel
+(ops/pallas_attention.py) replaces the prefill einsum on TPU for long
+sequences; this module is the always-correct fallback and the decode path.
+
+Shapes (B=batch/slots, T=query len, S=kv len, H=q heads, K=kv heads, G=H/K,
+D=head dim):
+- activations [B, T, H, D]; kv cache [B, S, K, D]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
+
+def _gqa_scores(q: jnp.ndarray, k: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """q [B,T,K,G,D] × k [B,S,K,D] → scores [B,K,G,T,S] in fp32."""
+    return jnp.einsum(
+        "btkgd,bskd->bkgts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+
+
+def _gqa_out(probs: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """probs [B,K,G,T,S] × v [B,S,K,D] → out [B,T,K,G,D]."""
+    return jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Self-attention over one padded prompt batch (prefill).
+
+    q [B,T,H,D], k/v [B,T,K,D], valid [B,T] bool marks real (non-pad) tokens.
+    """
+    b, t, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    if scale is None:
+        scale = d**-0.5
+
+    q5 = q.reshape(b, t, kh, g, d)
+    scores = _gqa_scores(q5, k, scale)  # [B,K,G,T,S]
+    scores = _softcap(scores, softcap)
+
+    i = jnp.arange(t)[:, None]
+    j = jnp.arange(t)[None, :]
+    mask = j <= i  # causal
+    if window is not None:
+        mask &= (i - j) < window
+    mask = mask[None, None, None, :, :] & valid[:, None, None, None, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = _gqa_out(probs, v)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def cached_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """One-token-per-slot decode attention against the full KV cache.
+
+    q [B,1,H,D]; k/v_cache [B,S,K,D]; q_positions [B] = the position of the
+    query token (== cache length written so far minus one).  Cache entries at
+    index j are attendable when j <= q_position (and within the sliding
+    window when set) — the static-shape masking that makes slot-batched
+    continuous decode one fixed XLA program.
+    """
+    b, t, h, d = q.shape
+    assert t == 1, "decode step processes exactly one token per slot"
+    kh = k_cache.shape[2]
+    g = h // kh
+    if scale is None:
+        scale = d**-0.5
+
+    q5 = q.reshape(b, 1, kh, g, d)
+    scores = _gqa_scores(q5, k_cache, scale)  # [B,K,G,1,S]
+    scores = _softcap(scores, softcap)
+
+    s = k_cache.shape[1]
+    j = jnp.arange(s)[None, :]  # [1,S]
+    pos = q_positions[:, None]  # [B,1]
+    mask = j <= pos
+    if window is not None:
+        mask &= (pos - j) < window
+    scores = jnp.where(mask[:, None, None, None, :], scores, _NEG_INF)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = _gqa_out(probs, v_cache)
+    return out.reshape(b, 1, h, d).astype(q.dtype)

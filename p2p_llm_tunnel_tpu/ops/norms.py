@@ -1,0 +1,15 @@
+"""Normalization ops (accumulate in fp32, cast back — MXU-friendly bf16 flow)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float, plus_one: bool = False
+) -> jnp.ndarray:
+    """RMSNorm. ``plus_one`` selects the gemma convention (scale = 1 + w)."""
+    xf = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    scale = (1.0 + weight.astype(jnp.float32)) if plus_one else weight.astype(jnp.float32)
+    return ((xf / rms) * scale).astype(x.dtype)

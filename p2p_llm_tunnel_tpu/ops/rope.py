@@ -1,0 +1,38 @@
+"""Rotary position embeddings.
+
+Computed from explicit position ids (not sequence offsets) so the same
+function serves prefill (positions 0..T-1) and slot-batched decode (each
+slot at its own cache length) — a requirement of the static-shape
+continuous-batching design.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(positions: jnp.ndarray, head_dim: int, theta: float) -> tuple:
+    """(sin, cos) tables for given positions; shapes [..., head_dim/2]."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., D/2]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate q or k. x: [..., seq, heads, head_dim]; positions: [..., seq].
+
+    Uses the interleaved-pair convention (x reshaped to pairs), matching the
+    HF Llama "rotate_half" layout after de-interleave — self-consistent for
+    training-free use and checkpoint loading handles layout conversion.
+    """
+    head_dim = x.shape[-1]
+    sin, cos = rope_table(positions, head_dim, theta)  # [..., seq, D/2]
+    sin = sin[..., None, :]  # broadcast over heads: [..., seq, 1, D/2]
+    cos = cos[..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)  # rotate-half convention
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    out = jnp.concatenate([rx1, rx2], axis=-1)
+    return out.astype(x.dtype)

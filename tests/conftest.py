@@ -3,25 +3,34 @@
 Mirrors how the reference tests "multi-node" behavior with localhost processes
 (SURVEY.md §4): we substitute 8 virtual CPU devices for a TPU slice so every
 sharding/collective path is exercised in CI without TPU hardware.
+
+The driver environment boots every Python process with an 'axon' PJRT plugin
+(the tunneled TPU chip) and force-sets ``jax_platforms="axon,cpu"`` via
+``jax.config.update`` at interpreter start — which overrides the
+JAX_PLATFORMS env var.  Tests must never touch the real chip (slow, single
+grant), so we update the config back to cpu here, before any backend
+initialises.
 """
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA flags are read at backend init; set before anything initialises one.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices

@@ -1,0 +1,107 @@
+"""Continuous-batching scheduler unit tests (pure logic, fake streams)."""
+
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.scheduler import GenRequest, Scheduler
+
+
+def req(rid, prompt_len=4, max_new=8, stop=()):
+    return GenRequest(rid, list(range(1, prompt_len + 1)), max_new, stop_ids=stop)
+
+
+def test_fifo_admission():
+    s = Scheduler(num_slots=2, max_seq=64)
+    for i in range(4):
+        s.submit(req(i))
+    admitted = s.admit()
+    assert [r.request.request_id for r in admitted] == [0, 1]
+    assert s.queue_depth == 2
+    assert s.occupancy == 1.0
+    assert s.admit() == []  # no free slots
+
+
+def test_eviction_on_stop_token():
+    s = Scheduler(1, 64)
+    s.submit(req(7, stop=(99,)))
+    (run,) = s.admit()
+    s.record_token(run.slot, 5)
+    assert s.slots[run.slot] is not None
+    s.record_token(run.slot, 99)  # stop token
+    assert s.slots[run.slot] is None
+
+
+def test_eviction_on_length():
+    s = Scheduler(1, 64)
+    s.submit(req(1, max_new=3))
+    (run,) = s.admit()
+    for tok in (10, 11):
+        s.record_token(run.slot, tok)
+        assert s.slots[run.slot] is not None
+    s.record_token(run.slot, 12)
+    assert s.slots[run.slot] is None
+    assert run.generated == [10, 11, 12]
+
+
+def test_eviction_on_cache_capacity():
+    s = Scheduler(1, max_seq=6)
+    s.submit(req(2, prompt_len=4, max_new=100))
+    (run,) = s.admit()
+    s.record_token(run.slot, 1)  # cache_len 5
+    assert s.slots[run.slot] is not None
+    s.record_token(run.slot, 2)  # cache_len 6 == max_seq → evict
+    assert s.slots[run.slot] is None
+
+
+def test_freed_slot_readmits_from_queue():
+    s = Scheduler(1, 64)
+    s.submit(req(1, max_new=1))
+    s.submit(req(2))
+    (run,) = s.admit()
+    assert run.request.request_id == 1
+    s.record_token(run.slot, 5)  # finishes request 1
+    (run2,) = s.admit()
+    assert run2.request.request_id == 2
+
+
+def test_cancel_waiting_and_active():
+    s = Scheduler(2, 64)
+    s.submit(req(1))
+    s.submit(req(2))
+    s.submit(req(3))
+    s.admit()
+    assert s.cancel(3) is True  # still waiting
+    assert s.cancel(1) is True  # active in a slot
+    assert s.cancel(99) is False
+    assert s.queue_depth == 0
+    assert s.occupancy == 0.5
+
+
+def test_prompt_too_long_rejected():
+    s = Scheduler(1, max_seq=8)
+    with pytest.raises(ValueError):
+        s.submit(req(1, prompt_len=8))
+
+
+def test_invalid_requests_rejected():
+    with pytest.raises(ValueError):
+        GenRequest(1, [], 5)
+    with pytest.raises(ValueError):
+        GenRequest(1, [1], 0)
+
+
+def test_many_requests_through_few_slots():
+    """Simulated drain: 20 requests through 4 slots, random-ish lengths."""
+    s = Scheduler(4, 64)
+    for i in range(20):
+        s.submit(req(i, prompt_len=2 + i % 5, max_new=1 + i % 7))
+    finished = []
+    steps = 0
+    while not s.idle:
+        s.admit()
+        for run in list(s.active()):
+            s.record_token(run.slot, 1000 + steps)
+            if s.slots[run.slot] is None:
+                finished.append(run.request.request_id)
+        steps += 1
+        assert steps < 1000, "scheduler did not drain"
+    assert sorted(finished) == list(range(20))
