@@ -1,0 +1,31 @@
+"""Chip-tier parallelism: device meshes, TP/DP shardings, sharded steps.
+
+The reference has no chip tier at all — its only communication backend is a
+WebRTC data channel between two WAN peers (SURVEY.md §2 parallelism table).
+This package is the TPU-native equivalent of what NCCL/MPI would be in a GPU
+framework: XLA collectives over ICI/DCN, driven by `jax.sharding` — pick a
+Mesh, annotate params/activations with NamedShardings, and let GSPMD insert
+the all-gathers/reduce-scatters.
+
+Axes convention (scaling-book style):
+- ``dp``  — data parallel / batch-slot axis
+- ``tp``  — tensor parallel (megatron column/row split of attn + MLP)
+- ``sp``  — sequence parallel (ring attention KV rotation; ops/ring_attention)
+"""
+
+from p2p_llm_tunnel_tpu.parallel.mesh import best_mesh, make_mesh
+from p2p_llm_tunnel_tpu.parallel.sharding import (
+    kv_cache_pspecs,
+    param_pspecs,
+    shard_kv_cache,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "best_mesh",
+    "param_pspecs",
+    "kv_cache_pspecs",
+    "shard_params",
+    "shard_kv_cache",
+]

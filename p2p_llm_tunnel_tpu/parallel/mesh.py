@@ -1,0 +1,57 @@
+"""Device-mesh construction for TPU slices (and CPU test meshes).
+
+A Mesh here plays the role the NCCL communicator plays in GPU frameworks:
+it names the axes collectives run over.  On a real slice the ``tp`` axis
+should map onto ICI neighbours (jax.devices() order already is torus order
+for TPU backends), with ``dp`` outermost so data-parallel traffic — which is
+per-step gradient/activation-free during inference — crosses DCN if anything
+does.  The reference has no analog (SURVEY.md §5 distributed-communication:
+its only backend is the WebRTC data channel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+AXES = ("dp", "tp", "sp")
+
+
+def make_mesh(
+    tp: int = 1,
+    dp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh with axes (dp, tp, sp) over ``dp*tp*sp`` devices.
+
+    ``tp`` is the fastest-varying axis so tensor-parallel collectives run
+    between adjacent devices (ICI neighbours on a slice).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * tp * sp
+    if len(devices) < n:
+        raise ValueError(f"mesh {dp}x{tp}x{sp} needs {n} devices, have {len(devices)}")
+    grid = np.array(devices[:n]).reshape(dp, sp, tp)
+    # Axis order in memory: dp outermost, tp innermost (contiguous devices).
+    return Mesh(np.transpose(grid, (0, 2, 1)), ("dp", "tp", "sp"))
+
+
+def best_mesh(
+    n_kv_heads: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Single-axis-of-TP mesh using every device, capped by KV-head count.
+
+    TP degree divides n_kv_heads so the KV cache shards cleanly; leftover
+    device count becomes data parallelism.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    tp = 1
+    while tp * 2 <= n and n % (tp * 2) == 0 and n_kv_heads % (tp * 2) == 0:
+        tp *= 2
+    return make_mesh(tp=tp, dp=n // tp, devices=devices)

@@ -1,0 +1,83 @@
+"""Tensor/data-parallel sharding rules for the stacked-layer param pytree.
+
+Megatron-style split expressed as NamedShardings and left to GSPMD:
+- column-parallel: wq/wk/wv/w_gate/w_up shard their OUTPUT feature axis on
+  ``tp`` — each chip computes its own heads / FFN slice with no comms
+- row-parallel: wo/w_down shard their INPUT feature axis on ``tp`` — XLA
+  inserts the one all-reduce (psum over ICI) per block that megatron needs
+- embed shards on vocab; the tied/untied head shards on vocab too, so
+  logits come out vocab-sharded and sampling all-gathers only the winner
+- KV cache shards the kv-head axis on ``tp`` and the slot axis on ``dp``
+
+The reference has no tensor parallelism to mirror (SURVEY.md §2 table:
+"Tensor parallel — Absent"); the design target is BASELINE.json's
+"Llama-3 70B tensor-parallel on v5e-8 (ICI all-gather decode)".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig
+
+Pytree = Any
+
+
+def param_pspecs(cfg: ModelConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init_params' layout (models/transformer.py)."""
+    blocks = {
+        "attn_norm": P(None, None),  # [L, Dm] replicated
+        "mlp_norm": P(None, None),
+        "wq": P(None, None, "tp"),  # [L, Dm, H*hd] column
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),  # [L, H*hd, Dm] row
+        "w_gate": P(None, None, "tp"),  # [L, Dm, F] column
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),  # [L, F, Dm] row
+    }
+    if cfg.post_norms:
+        blocks["post_attn_norm"] = P(None, None)
+        blocks["post_mlp_norm"] = P(None, None)
+    specs: Dict[str, Any] = {
+        "embed": P("tp", None),  # [V, Dm] vocab-sharded
+        "blocks": blocks,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")  # [Dm, V] vocab-sharded output
+    return specs
+
+
+def kv_cache_pspecs() -> Dict[str, P]:
+    """[L, Slots, S, K, D]: slots on dp, kv heads on tp."""
+    spec = P(None, "dp", None, "tp", None)
+    return {"k": spec, "v": spec}
+
+
+def _to_shardings(mesh: Mesh, specs: Pytree) -> Pytree:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Pytree:
+    return _to_shardings(mesh, param_pspecs(cfg))
+
+
+def kv_cache_shardings(mesh: Mesh) -> Pytree:
+    return _to_shardings(mesh, kv_cache_pspecs())
+
+
+def shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
+    """Place a (host or single-device) param pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(cfg, mesh))
+
+
+def shard_kv_cache(kv_cache: Pytree, mesh: Mesh) -> Pytree:
+    return jax.device_put(kv_cache, kv_cache_shardings(mesh))

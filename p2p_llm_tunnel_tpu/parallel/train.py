@@ -1,0 +1,90 @@
+"""Sharded training step: the full dp+tp program for ``dryrun_multichip``.
+
+One jitted function: forward (prefill path), cross-entropy, grads, AdamW
+update — with params/optimizer-state tensor-parallel and the batch
+data-parallel over the same Mesh the inference engine uses.  GSPMD inserts
+the collectives: all-reduce of row-parallel activations over ``tp``
+(ICI), gradient all-reduce over ``dp``.
+
+Net-new vs the reference (it has no training or ML at all — SURVEY.md §2);
+shaped by BASELINE.json's multi-chip configs rather than reference code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig
+from p2p_llm_tunnel_tpu.models.transformer import init_params, loss_fn
+from p2p_llm_tunnel_tpu.parallel.sharding import param_pspecs, param_shardings
+
+Pytree = Any
+
+
+def make_optimizer(lr: float = 1e-3) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
+def make_train_step(
+    cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3
+) -> Tuple[Callable, Callable]:
+    """Returns (init_fn, step_fn), both jitted with mesh shardings.
+
+    - ``init_fn(key) -> (params, opt_state)`` materialises params directly
+      sharded (no host round-trip — each chip initialises only its shard).
+    - ``step_fn(params, opt_state, tokens, targets, valid)
+        -> (params, opt_state, loss)`` is one optimization step.
+    """
+    opt = make_optimizer(lr)
+    pshard = param_shardings(cfg, mesh)
+    batch_shard = NamedSharding(mesh, P("dp", None))
+    replicated = NamedSharding(mesh, P())
+
+    def _init(key):
+        params = init_params(cfg, key, jnp.float32)
+        opt_state = opt.init(params)
+        return params, opt_state
+
+    # Optimizer moments (mu/nu) are param-shaped → inherit the param's spec;
+    # everything else in the state (step count, wd) replicates.  Matching by
+    # shape over an eval_shape trace keeps this agnostic to optax internals.
+    param_shapes = jax.eval_shape(
+        lambda k: init_params(cfg, k, jnp.float32), jax.random.PRNGKey(0)
+    )
+    opt_shapes = jax.eval_shape(lambda: opt.init(param_shapes))
+    shape_to_spec = {
+        tuple(leaf.shape): spec
+        for leaf, spec in zip(
+            jax.tree.leaves(param_shapes),
+            jax.tree.leaves(
+                param_pspecs(cfg), is_leaf=lambda x: isinstance(x, P)
+            ),
+        )
+    }
+    opt_sharding = jax.tree.map(
+        lambda leaf: NamedSharding(mesh, shape_to_spec.get(tuple(leaf.shape), P())),
+        opt_shapes,
+    )
+
+    init_fn = jax.jit(_init, out_shardings=(pshard, opt_sharding))
+
+    def _step(params, opt_state, tokens, targets, valid):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, targets, valid)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step_fn = jax.jit(
+        _step,
+        in_shardings=(pshard, opt_sharding, batch_shard, batch_shard, batch_shard),
+        out_shardings=(pshard, opt_sharding, replicated),
+        donate_argnums=(0, 1),
+    )
+    return init_fn, step_fn

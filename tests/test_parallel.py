@@ -1,0 +1,122 @@
+"""Parallelism tests on the 8-virtual-CPU-device mesh (conftest.py).
+
+Mirrors how the reference substitutes localhost processes for WAN peers
+(SURVEY.md §4): we substitute virtual CPU devices for a TPU slice and assert
+sharded programs match their single-device counterparts numerically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.transformer import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill_into_cache,
+)
+from p2p_llm_tunnel_tpu.parallel import (
+    best_mesh,
+    make_mesh,
+    shard_kv_cache,
+    shard_params,
+)
+from p2p_llm_tunnel_tpu.parallel.train import make_train_step
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # 4 kv heads so tp=4 divides; 8 q heads exercises GQA under TP.
+    return get_config(
+        "tiny", n_heads=8, n_kv_heads=4, dim=64, head_dim=8, vocab_size=512
+    )
+
+
+def test_make_mesh_axes(cpu_devices):
+    mesh = make_mesh(tp=4, dp=2)
+    assert mesh.axis_names == ("dp", "tp", "sp")
+    assert mesh.shape["tp"] == 4 and mesh.shape["dp"] == 2
+    assert mesh.shape["sp"] == 1
+
+
+def test_make_mesh_too_big(cpu_devices):
+    with pytest.raises(ValueError):
+        make_mesh(tp=16, dp=2)
+
+
+def test_best_mesh_caps_tp_at_kv_heads(cpu_devices):
+    mesh = best_mesh(n_kv_heads=4)
+    assert mesh.shape["tp"] == 4
+    assert mesh.shape["dp"] == 2
+    mesh = best_mesh(n_kv_heads=16)
+    assert mesh.shape["tp"] == 8
+
+
+def test_sharded_decode_matches_single_device(cfg, cpu_devices):
+    """TP decode over the mesh must produce the same logits as one device."""
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key, jnp.float32)
+    slots, seq = 4, 32
+    cache = init_kv_cache(cfg, slots, seq, jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    lengths = jnp.array([8], jnp.int32)
+    slot_idx = jnp.array([0], jnp.int32)
+
+    # single-device reference
+    last_ref, cache_ref = jax.jit(
+        lambda p, c: prefill_into_cache(cfg, p, prompt, lengths, c, slot_idx)
+    )(params, cache)
+    tok = jnp.argmax(last_ref, -1).astype(jnp.int32)
+    toks = jnp.zeros((slots,), jnp.int32).at[0].set(tok[0])
+    pos = jnp.zeros((slots,), jnp.int32).at[0].set(8)
+    logits_ref, _ = jax.jit(
+        lambda p, c: decode_step(cfg, p, c, toks, pos)
+    )(params, cache_ref)
+
+    # sharded: tp=4, dp=2
+    mesh = make_mesh(tp=4, dp=2)
+    params_s = shard_params(params, cfg, mesh)
+    cache_s = shard_kv_cache(cache, mesh)
+    last_s, cache_s = jax.jit(
+        lambda p, c: prefill_into_cache(cfg, p, prompt, lengths, c, slot_idx)
+    )(params_s, cache_s)
+    logits_s, _ = jax.jit(
+        lambda p, c: decode_step(cfg, p, c, toks, pos)
+    )(params_s, cache_s)
+
+    np.testing.assert_allclose(
+        np.asarray(last_s), np.asarray(last_ref), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_s), np.asarray(logits_ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_train_step_runs_and_descends(cfg, cpu_devices):
+    """Full dp+tp train step compiles, runs, and reduces loss."""
+    mesh = make_mesh(tp=4, dp=2)
+    init_fn, step_fn = make_train_step(cfg, mesh, lr=1e-2)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+
+    b, t = 8, 16
+    key = jax.random.PRNGKey(2)
+    tokens = jax.random.randint(key, (b, t), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    valid = jnp.ones((b, t), bool)
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step_fn(params, opt_state, tokens, targets, valid)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not descend: {losses}"
+
+
+def test_param_shardings_place_on_mesh(cfg, cpu_devices):
+    mesh = make_mesh(tp=4, dp=2)
+    params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+    wq = params["blocks"]["wq"]
+    # column-parallel: last axis split 4 ways
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(None, None, "tp")
+    assert len(wq.sharding.device_set) == 8
