@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""End-to-end benchmark: tokens/sec and TTFT through the tunnel.
+
+Measures the BASELINE.json metric — decode throughput and p50 time-to-first-
+token for concurrent OpenAI SSE streams, measured at the HTTP client, through
+the full stack:
+
+    client → proxy endpoint → tunnel frames → serve endpoint → JAX engine
+           ← SSE chunks     ← RES_BODY/token ←
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+``vs_baseline`` is against the driver target of 1800 tok/s (BASELINE.md);
+the reference itself publishes no numbers (SURVEY.md §6).
+
+Env knobs: BENCH_MODEL, BENCH_CLIENTS, BENCH_MAX_TOKENS, BENCH_SLOTS,
+BENCH_MAX_SEQ, BENCH_DTYPE, BENCH_DECODE_STEPS (decode burst size — the
+main tok/s lever; see EngineConfig.decode_steps).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+TARGET_TOK_S = 1800.0  # BASELINE.md: Llama-3 8B / v5e-1 target
+
+
+def _default_model() -> str:
+    import jax
+
+    platform = jax.devices()[0].platform
+    # 2B fits v5e-1 HBM comfortably in bf16; CPU runs use the tiny preset.
+    return "gemma2-2b" if platform == "tpu" else "tiny"
+
+
+async def _one_client(
+    port: int, prompt: str, max_tokens: int, results: list, idx: int
+) -> None:
+    from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+
+    body = json.dumps(
+        {
+            "model": "bench",
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "stream": True,
+            "temperature": 0.0,
+            "ignore_eos": True,
+        }
+    ).encode()
+    t0 = time.monotonic()
+    resp = await http_request(
+        "POST",
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        {"content-type": "application/json"},
+        body,
+        timeout=600.0,
+    )
+    assert resp.status == 200, f"client {idx}: HTTP {resp.status}"
+    ttft = None
+    n_tokens = 0
+    buf = b""
+    async for chunk in resp.iter_chunks():
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            if not event.startswith(b"data: "):
+                continue
+            data = event[6:]
+            if data == b"[DONE]":
+                continue
+            payload = json.loads(data)
+            delta = payload["choices"][0]["delta"]
+            if delta.get("content"):
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                n_tokens += 1
+    results.append(
+        {"ttft_s": ttft, "tokens": n_tokens, "wall_s": time.monotonic() - t0}
+    )
+
+
+async def _run_bench() -> dict:
+    from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy
+    from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+    from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
+
+    model = os.environ.get("BENCH_MODEL") or _default_model()
+    clients = int(os.environ.get("BENCH_CLIENTS", "16"))
+    max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "128"))
+    slots = int(os.environ.get("BENCH_SLOTS", "16"))
+    max_seq = int(os.environ.get("BENCH_MAX_SEQ", "512"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
+
+    print(
+        f"bench: model={model} clients={clients} max_tokens={max_tokens} "
+        f"slots={slots} decode_steps={decode_steps}",
+        file=sys.stderr,
+    )
+    engine = InferenceEngine(
+        engine_cfg=EngineConfig(
+            model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
+            decode_steps=decode_steps,
+        )
+    )
+    await engine.start()
+
+    serve_ch, proxy_ch = loopback_pair()
+    serve_task = asyncio.create_task(
+        run_serve(serve_ch, backend=engine_backend(engine, model))
+    )
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    proxy_task = asyncio.create_task(
+        run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready)
+    )
+    port = await asyncio.wait_for(ready, 30.0)
+
+    prompt = "Benchmark this tunnel with a steady stream of tokens, please."
+
+    from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+    try:
+        # Warmup at full concurrency: compiles the batched prefill program
+        # for this bucket and the multi-step decode program.
+        t0 = time.monotonic()
+        warm: list = []
+        await asyncio.gather(
+            *(
+                _one_client(port, f"{prompt} ({i})", 4, warm, -1)
+                for i in range(clients)
+            )
+        )
+        print(f"bench: warmup {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+        results: list = []
+        tokens_before = global_metrics.counter("engine_tokens_total")
+        t_start = time.monotonic()
+        await asyncio.gather(
+            *(
+                _one_client(port, f"{prompt} ({i})", max_tokens, results, i)
+                for i in range(clients)
+            )
+        )
+        wall = time.monotonic() - t_start
+        engine_tokens = global_metrics.counter("engine_tokens_total") - tokens_before
+    finally:
+        serve_task.cancel()
+        proxy_task.cancel()
+        for t in (serve_task, proxy_task):
+            try:
+                await t
+            except (asyncio.CancelledError, RuntimeError):
+                pass
+        await engine.stop()
+
+    # Token count comes from the engine's counter: with random weights the
+    # byte-level SSE stream is mostly invisible UTF-8 fragments, so counting
+    # client-visible deltas would undercount real decoded tokens.  Wall time
+    # and TTFT are still measured at the HTTP client, end to end.
+    visible_tokens = sum(r["tokens"] for r in results)
+    ttfts = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
+    tok_s = engine_tokens / wall if wall > 0 else 0.0
+    ttft_p50_ms = statistics.median(ttfts) * 1000.0 if ttfts else None
+    return {
+        "metric": "e2e_decode_tok_s",
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / TARGET_TOK_S, 4),
+        "ttft_p50_ms": round(ttft_p50_ms, 1) if ttft_p50_ms is not None else None,
+        "model": model,
+        "clients": clients,
+        "engine_tokens": engine_tokens,
+        "visible_tokens": visible_tokens,
+        "wall_s": round(wall, 2),
+    }
+
+
+def main() -> None:
+    try:
+        result = asyncio.run(_run_bench())
+    except Exception as e:  # OOM on small chips etc. — retry on tiny shapes
+        print(f"bench: {type(e).__name__}: {e}; retrying with tiny model",
+              file=sys.stderr)
+        os.environ["BENCH_MODEL"] = "tiny"
+        result = asyncio.run(_run_bench())
+        result["fallback"] = True
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -79,12 +79,15 @@ class EngineAPI:
         temperature = float(body.get("temperature") or 0.0)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
-        return dict(
+        kwargs = dict(
             max_new_tokens=max_tokens,
             temperature=temperature,
             top_k=int(body.get("top_k") or 0),
             top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
         )
+        if body.get("ignore_eos"):  # vLLM-style benchmarking knob
+            kwargs["stop_ids"] = ()
+        return kwargs
 
     def _check_prompt(self, prompt_ids) -> None:
         """Reject unservable prompts eagerly (scheduler would raise lazily,

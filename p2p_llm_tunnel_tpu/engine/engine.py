@@ -47,6 +47,16 @@ class EngineConfig:
     dtype: str = "bfloat16"
     seed: int = 0
     min_prefill_bucket: int = 16
+    # Decode steps per XLA call (lax.scan with on-device sampling feedback).
+    # Host↔device latency dominates per-token cost — measured ~90 ms RTT per
+    # device_get through the tunneled-TPU path — so each fetch must return
+    # num_slots*decode_steps tokens, not num_slots.  Streaming granularity
+    # (SSE burst size) equals decode_steps.
+    decode_steps: int = 8
+    # Fixed row count per batched-prefill call: admissions are chunked and
+    # padded to exactly this many rows so each prompt-length bucket compiles
+    # ONE prefill program (pad rows scatter into the scratch slot).
+    prefill_rows: int = 8
 
 
 @dataclass
@@ -91,16 +101,20 @@ class InferenceEngine:
         self.param_shardings = param_shardings
 
         b, s = self.ecfg.num_slots, self.ecfg.max_seq
-        self.kv_cache = init_kv_cache(self.mcfg, b, s, dtype)
+        # One extra cache row: the scratch slot that padded prefill rows
+        # scatter into, so batched prefill never corrupts a live slot.
+        rows = b + 1
+        self._scratch_slot = b
+        self.kv_cache = init_kv_cache(self.mcfg, rows, s, dtype)
         self.scheduler = Scheduler(b, s)
 
         # Host-side per-slot state driving each decode step.
-        self._last_token = np.zeros((b,), np.int32)
-        self._positions = np.zeros((b,), np.int32)
-        self._active_mask = np.zeros((b,), bool)
-        self._temp = np.zeros((b,), np.float32)
-        self._top_k = np.zeros((b,), np.int32)
-        self._top_p = np.ones((b,), np.float32)
+        self._last_token = np.zeros((rows,), np.int32)
+        self._positions = np.zeros((rows,), np.int32)
+        self._active_mask = np.zeros((rows,), bool)
+        self._temp = np.zeros((rows,), np.float32)
+        self._top_k = np.zeros((rows,), np.int32)
+        self._top_p = np.ones((rows,), np.float32)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         self._next_request_id = 1
@@ -122,9 +136,25 @@ class InferenceEngine:
     # -- XLA programs -----------------------------------------------------
 
     def _decode_fn(self, params, kv_cache, tokens, positions, samp, key):
-        logits, kv_cache = decode_step(self.mcfg, params, kv_cache, tokens, positions)
-        sampled = sampling.sample(logits, samp, key)
-        return sampled, kv_cache
+        """``decode_steps`` chained steps; sampled tokens feed back on-device.
+
+        Returns sampled tokens [B, k] — one device_get per k steps.  Slots
+        that finish mid-scan keep computing (their surplus tokens are
+        discarded by the host loop); cache writes past max_seq are dropped
+        by XLA scatter OOB semantics.
+        """
+
+        def one(carry, step_key):
+            toks, pos, cache = carry
+            logits, cache = decode_step(self.mcfg, params, cache, toks, pos)
+            sampled = sampling.sample(logits, samp, step_key)
+            return (sampled, pos + 1, cache), sampled
+
+        keys = jax.random.split(key, self.ecfg.decode_steps)
+        (_, _, kv_cache), toks = jax.lax.scan(
+            one, (tokens, positions, kv_cache), keys
+        )
+        return toks.T, kv_cache  # [B, k]
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
@@ -227,31 +257,51 @@ class InferenceEngine:
             b *= 2
         return min(b, self.ecfg.max_seq)
 
-    def _do_prefill(self, run: RunningSlot) -> int:
-        """Blocking: prefill one admitted prompt into its slot; returns first token."""
-        ids = run.request.prompt_ids
-        t = self._bucket(len(ids))
-        tokens = np.zeros((1, t), np.int32)
-        tokens[0, : len(ids)] = ids
+    def _do_prefill_batch(self, runs: List[RunningSlot], t: int) -> np.ndarray:
+        """Blocking: prefill one bucket of admitted prompts in ONE XLA call.
+
+        Concurrent arrivals share a single host↔device round trip (the RTT
+        dominates per-call cost through the tunneled-TPU path).  Rows are
+        padded to a power of two to bound compile count; pad rows scatter
+        into the scratch slot.  Returns first sampled token per run.
+        """
+        n = len(runs)
+        nb = max(self.ecfg.prefill_rows, n)
+        tokens = np.zeros((nb, t), np.int32)
+        lengths = np.ones((nb,), np.int32)
+        slots = np.full((nb,), self._scratch_slot, np.int32)
+        temp = np.zeros((nb,), np.float32)
+        top_k = np.zeros((nb,), np.int32)
+        top_p = np.ones((nb,), np.float32)
+        total = 0
+        for i, run in enumerate(runs):
+            ids = run.request.prompt_ids
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+            slots[i] = run.slot
+            temp[i] = run.request.temperature
+            top_k[i] = run.request.top_k
+            top_p[i] = run.request.top_p
+            total += len(ids)
         samp = sampling.SamplingParams(
-            temperature=jnp.array([run.request.temperature], jnp.float32),
-            top_k=jnp.array([run.request.top_k], jnp.int32),
-            top_p=jnp.array([run.request.top_p], jnp.float32),
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
         )
         first, self.kv_cache = self._jit_prefill(
             self.params,
             self.kv_cache,
             jnp.asarray(tokens),
-            jnp.array([len(ids)], jnp.int32),
-            jnp.array([run.slot], jnp.int32),
+            jnp.asarray(lengths),
+            jnp.asarray(slots),
             samp,
             self._next_key(),
         )
-        global_metrics.inc("engine_prefill_tokens_total", len(ids))
-        return int(jax.device_get(first)[0])
+        global_metrics.inc("engine_prefill_tokens_total", total)
+        return np.asarray(jax.device_get(first))[:n]
 
     def _do_decode(self) -> np.ndarray:
-        """Blocking: one decode step over all slots; returns sampled [B]."""
+        """Blocking: ``decode_steps`` steps over all slots; returns [B, k]."""
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(self._temp),
             top_k=jnp.asarray(self._top_k),
@@ -277,6 +327,20 @@ class InferenceEngine:
         self._top_k[i] = req.top_k
         self._top_p[i] = req.top_p
 
+    def _account_token(self, slot: int, tok: int) -> None:
+        """Record one generated token: scheduler accounting, slot-state
+        update for the next decode call, eviction, emission."""
+        out = self.scheduler.record_token(slot, tok)
+        evicted = self.scheduler.slots[slot] is None
+        if evicted:
+            self._active_mask[slot] = False
+        else:
+            self._last_token[slot] = tok
+            # The generated token's own position: it is written to the cache
+            # by the decode step that consumes it.
+            self._positions[slot] = out.cache_len - 1
+        self._emit(out, tok, evicted)
+
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         log.info(
@@ -292,26 +356,30 @@ class InferenceEngine:
                     continue
                 continue
 
-            # Admission: prefill each newly-admitted prompt into its slot.
-            for run in self.scheduler.admit():
-                first = await loop.run_in_executor(
-                    self._executor, self._do_prefill, run
-                )
-                if self.scheduler.slots[run.slot] is not run:
-                    # Consumer cancelled while the prefill was in flight; the
-                    # slot is already free (or re-used) — drop the result.
-                    continue
-                self._admit_one(run)
-                out = self.scheduler.record_token(run.slot, first)
-                evicted = self.scheduler.slots[run.slot] is None
-                if evicted:
-                    self._active_mask[run.slot] = False
-                else:
-                    self._last_token[run.slot] = first
-                    # The generated token's own position: it is written to the
-                    # cache by the decode step that consumes it.
-                    self._positions[run.slot] = out.cache_len - 1
-                self._emit(out, first, evicted)
+            # Admission: batched prefill, one XLA call per prompt-length
+            # bucket, so concurrent arrivals share one device round trip.
+            admitted = self.scheduler.admit()
+            if admitted:
+                groups: Dict[int, List[RunningSlot]] = {}
+                for run in admitted:
+                    t = self._bucket(len(run.request.prompt_ids))
+                    groups.setdefault(t, []).append(run)
+                chunked: List[Tuple[int, List[RunningSlot]]] = []
+                pr = self.ecfg.prefill_rows
+                for t, runs in sorted(groups.items()):
+                    for i in range(0, len(runs), pr):
+                        chunked.append((t, runs[i : i + pr]))
+                for t, runs in chunked:
+                    firsts = await loop.run_in_executor(
+                        self._executor, self._do_prefill_batch, runs, t
+                    )
+                    for run, first in zip(runs, firsts):
+                        if self.scheduler.slots[run.slot] is not run:
+                            # Consumer cancelled while the prefill was in
+                            # flight; the slot is already free — drop it.
+                            continue
+                        self._admit_one(run)
+                        self._account_token(run.slot, int(first))
 
             global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
             global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
@@ -320,20 +388,13 @@ class InferenceEngine:
                 continue
 
             sampled = await loop.run_in_executor(self._executor, self._do_decode)
-            for i in np.nonzero(self._active_mask)[0]:
-                run = self.scheduler.slots[i]
-                if run is None:  # cancelled between steps
-                    self._active_mask[i] = False
-                    continue
-                tok = int(sampled[i])
-                out = self.scheduler.record_token(i, tok)
-                evicted = self.scheduler.slots[i] is None
-                if evicted:
-                    self._active_mask[i] = False
-                else:
-                    self._last_token[i] = tok
-                    self._positions[i] = out.cache_len - 1
-                self._emit(out, tok, evicted)
-            # Yield to the event loop so emitted tokens flush to consumers.
-            await asyncio.sleep(0)
+            for col in range(sampled.shape[1]):
+                for i in np.nonzero(self._active_mask)[0]:
+                    if self.scheduler.slots[i] is None:  # cancelled between steps
+                        self._active_mask[i] = False
+                        continue
+                    self._account_token(int(i), int(sampled[i, col]))
+                # Yield so this column's tokens flush to consumers before the
+                # next burst (keeps SSE pacing smooth within a multi-step).
+                await asyncio.sleep(0)
         log.info("engine loop stopped")
