@@ -1,0 +1,199 @@
+"""The ``tunnel`` CLI: serve / proxy / signal subcommands + retry supervisor.
+
+Reference parity (tunnel/src/cli.rs, tunnel/src/main.rs):
+- flag > env > default precedence for every option (cli.rs:13-68); env names
+  TUNNEL_SIGNAL / TUNNEL_ROOM / TUNNEL_UPSTREAM / TUNNEL_LISTEN kept
+- defaults: signal ``wss://signal-server.fly.dev``, listen ``127.0.0.1:8000``,
+  advertise ``/`` (cli.rs, README table)
+- ``run_with_retry``: infinite reconnect attempts with exponential backoff
+  ``2·2^(attempt-1)`` seconds capped at 60, where Ctrl+C interrupts both the
+  running tunnel and the backoff sleep (main.rs:14-16, :111-159)
+
+Beyond the reference: ``--backend tpu`` runs the in-process JAX engine
+instead of forwarding to an HTTP upstream (the BASELINE.json north star),
+``--transport udp|tcp`` picks the P2P data plane, and ``signal`` hosts the
+rendezvous server (the reference keeps that in TypeScript; ours is also
+importable in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+from typing import Optional
+
+from p2p_llm_tunnel_tpu.utils.logging import get_logger, init_logging
+
+log = get_logger(__name__)
+
+INITIAL_BACKOFF = 2.0  # main.rs:14
+MAX_BACKOFF = 60.0  # main.rs:16
+
+DEFAULT_SIGNAL = os.environ.get("TUNNEL_SIGNAL", "wss://signal-server.fly.dev")
+DEFAULT_LISTEN = os.environ.get("TUNNEL_LISTEN", "127.0.0.1:8000")
+DEFAULT_UPSTREAM = os.environ.get("TUNNEL_UPSTREAM", "http://127.0.0.1:11434")
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tunnel", description="P2P LLM tunnel, TPU-native edition"
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def common(p: argparse.ArgumentParser) -> None:
+        p.add_argument("--signal", default=DEFAULT_SIGNAL,
+                       help="signaling server URL (env TUNNEL_SIGNAL)")
+        p.add_argument("--room", default=_env("TUNNEL_ROOM"),
+                       help="rendezvous room name (env TUNNEL_ROOM)")
+        p.add_argument("--transport", choices=("udp", "tcp"), default="udp",
+                       help="P2P data plane (default udp hole-punch)")
+
+    serve = sub.add_parser("serve", help="provider peer: expose an LLM")
+    common(serve)
+    serve.add_argument("--upstream", default=DEFAULT_UPSTREAM,
+                       help="upstream LLM base URL (env TUNNEL_UPSTREAM)")
+    serve.add_argument("--advertise", default=_env("TUNNEL_ADVERTISE", "/"),
+                       help="path prefix advertised to the peer (default /)")
+    serve.add_argument("--backend", choices=("http", "tpu"), default="http",
+                       help="http = forward to --upstream; tpu = in-process JAX engine")
+    serve.add_argument("--model", default=_env("TUNNEL_MODEL", "tiny"),
+                       help="model preset for --backend tpu")
+    serve.add_argument("--slots", type=int, default=8,
+                       help="continuous-batching slots (tpu backend)")
+    serve.add_argument("--max-seq", type=int, default=512,
+                       help="max context length (tpu backend)")
+    serve.add_argument("--decode-steps", type=int, default=8,
+                       help="decode steps per device call (tpu backend)")
+
+    proxy = sub.add_parser("proxy", help="consumer peer: local HTTP port")
+    common(proxy)
+    proxy.add_argument("--listen", default=DEFAULT_LISTEN,
+                       help="local HTTP listen addr (env TUNNEL_LISTEN)")
+
+    sig = sub.add_parser("signal", help="run the rendezvous server")
+    sig.add_argument("--listen", default="127.0.0.1")
+    sig.add_argument("--port", type=int, default=8787)
+    return ap
+
+
+# ---------------------------------------------------------------------------
+# retry supervisor (main.rs:111-159)
+# ---------------------------------------------------------------------------
+
+async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0) -> None:
+    """Run ``attempt_fn()`` forever, reconnecting with capped backoff.
+
+    ``max_attempts=0`` means infinite (the reference hardcodes infinite).
+    Cancellation (SIGINT) aborts both the running attempt and the backoff
+    sleep — matching main.rs:119-125, :148-155.
+    """
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            log.info("%s: connecting (attempt %d)", name, attempt)
+            await attempt_fn()
+            log.info("%s ended cleanly", name)
+            return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("%s failed: %s", name, e)
+        if max_attempts and attempt >= max_attempts:
+            raise RuntimeError(f"{name}: giving up after {attempt} attempts")
+        backoff = min(INITIAL_BACKOFF * (2 ** (attempt - 1)), MAX_BACKOFF)
+        log.info("%s: reconnecting in %.0fs", name, backoff)
+        await asyncio.sleep(backoff)  # CancelledError propagates → Ctrl+C
+
+
+# ---------------------------------------------------------------------------
+# subcommand bodies
+# ---------------------------------------------------------------------------
+
+async def _serve_once(args) -> None:
+    from p2p_llm_tunnel_tpu.endpoints.serve import http_backend, run_serve
+    from p2p_llm_tunnel_tpu.transport import connect
+
+    backend = None
+    if args.backend == "tpu":
+        backend = await _engine_backend(args)
+    channel, signaling = await connect(args.signal, args.room, args.transport)
+    try:
+        if backend is not None:
+            await run_serve(channel, backend=backend)
+        else:
+            await run_serve(channel, args.upstream, args.advertise)
+    finally:
+        channel.close()
+        await signaling.close()
+
+
+_ENGINE = None
+
+
+async def _engine_backend(args):
+    """Start (once) the in-process engine and return its request handler."""
+    global _ENGINE
+    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    if _ENGINE is None:
+        log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
+        _ENGINE = InferenceEngine(
+            engine_cfg=EngineConfig(
+                model=args.model,
+                num_slots=args.slots,
+                max_seq=args.max_seq,
+                decode_steps=args.decode_steps,
+            )
+        )
+        await _ENGINE.start()
+    return engine_backend(_ENGINE, args.model)
+
+
+async def _proxy_once(args) -> None:
+    from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy
+    from p2p_llm_tunnel_tpu.transport import connect
+
+    host, _, port = args.listen.rpartition(":")
+    channel, signaling = await connect(args.signal, args.room, args.transport)
+    try:
+        await run_proxy(channel, host or "127.0.0.1", int(port))
+    finally:
+        channel.close()
+        await signaling.close()
+
+
+async def _amain(args) -> None:
+    if args.command == "signal":
+        from p2p_llm_tunnel_tpu.signaling.server import SignalServer
+
+        await SignalServer(args.listen, args.port).serve_forever()
+        return
+
+    if not args.room:
+        raise SystemExit("--room (or TUNNEL_ROOM) is required")
+    if args.command == "serve":
+        await run_with_retry("serve", lambda: _serve_once(args))
+    elif args.command == "proxy":
+        await run_with_retry("proxy", lambda: _proxy_once(args))
+
+
+def main(argv: Optional[list] = None) -> None:
+    init_logging()
+    args = build_parser().parse_args(argv)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        log.info("interrupted, shutting down")
+        sys.exit(130)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""The rendezvous server: WebSocket rooms of two, relaying handshake JSON.
+
+Behavioral contract from the reference signal server
+(signal-server/src/index.ts):
+- ``join {room}`` → assigns a UUID peer id, replies ``joined {peerId, peers}``
+  with the ids already present, and notifies the existing peer with
+  ``peer-joined {peerId}`` (index.ts:112-154)
+- rooms hold at most TWO peers; a third join gets ``error "room is full"``
+  (index.ts:35, :126-129)
+- ``offer`` / ``answer`` / ``candidate`` are relayed VERBATIM to the other
+  peer in the room, with ``from`` set (index.ts:156-193)
+- ``bye``, socket close, or socket error → remove the peer and send
+  ``peer-left`` to the survivor (index.ts:56-78, :195-220)
+- the server never carries tunnel traffic — handshake metadata only
+
+Run standalone: ``python -m p2p_llm_tunnel_tpu.signaling.server --port 8787``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+import websockets
+from websockets.asyncio.server import ServerConnection, serve
+
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MAX_ROOM_SIZE = 2  # index.ts:35
+
+RELAYED_TYPES = {"offer", "answer", "candidate"}
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    room: str
+    ws: ServerConnection
+
+
+@dataclass
+class SignalServer:
+    """In-process signal server; also usable as the standalone entry point."""
+
+    host: str = "127.0.0.1"
+    port: int = 8787
+    rooms: Dict[str, Set[str]] = field(default_factory=dict)
+    peers: Dict[str, _Peer] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._server = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> int:
+        """Bind and serve; returns the bound port (for port 0)."""
+        self._server = await serve(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("signal server listening on ws://%s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        await asyncio.Future()
+
+    # -- helpers ----------------------------------------------------------
+
+    def _other_peer(self, peer: _Peer) -> Optional[_Peer]:
+        """The other occupant of the peer's room (index.ts:45-54)."""
+        for pid in self.rooms.get(peer.room, ()):  # at most 2 entries
+            if pid != peer.peer_id:
+                return self.peers.get(pid)
+        return None
+
+    async def _send(self, peer: _Peer, obj: dict) -> None:
+        try:
+            await peer.ws.send(json.dumps(obj))
+        except websockets.ConnectionClosed:
+            pass
+
+    async def _remove_peer(self, peer: _Peer) -> None:
+        """Drop a peer and tell the survivor (index.ts:56-78)."""
+        if self.peers.pop(peer.peer_id, None) is None:
+            return
+        room = self.rooms.get(peer.room)
+        if room is not None:
+            room.discard(peer.peer_id)
+            if not room:
+                del self.rooms[peer.room]
+        other = self._other_peer(peer)
+        if other is not None:
+            await self._send(other, {"type": "peer-left", "peerId": peer.peer_id})
+        log.info("[signal] peer %s left room %r", peer.peer_id[:8], peer.room)
+
+    # -- connection handler ------------------------------------------------
+
+    async def _handle(self, ws: ServerConnection) -> None:
+        peer: Optional[_Peer] = None
+        try:
+            async for raw in ws:
+                try:
+                    msg = json.loads(raw)
+                except (json.JSONDecodeError, TypeError):
+                    await ws.send(json.dumps({"type": "error", "message": "invalid JSON"}))
+                    continue
+                mtype = msg.get("type")
+
+                if mtype == "join":
+                    if peer is not None:
+                        await ws.send(json.dumps(
+                            {"type": "error", "message": "already joined"}))
+                        continue
+                    room_name = msg.get("room")
+                    if not isinstance(room_name, str) or not room_name:
+                        await ws.send(json.dumps(
+                            {"type": "error", "message": "room required"}))
+                        continue
+                    occupants = self.rooms.setdefault(room_name, set())
+                    if len(occupants) >= MAX_ROOM_SIZE:
+                        # index.ts:126-129
+                        await ws.send(json.dumps(
+                            {"type": "error", "message": "room is full"}))
+                        continue
+                    peer = _Peer(str(uuid.uuid4()), room_name, ws)
+                    existing = list(occupants)
+                    occupants.add(peer.peer_id)
+                    self.peers[peer.peer_id] = peer
+                    # ``observed`` is this server's view of the peer's address
+                    # — a built-in STUN-lite so peers can advertise their
+                    # NAT-external IP as a candidate (extension field; the
+                    # reference schema ignores unknown keys).
+                    remote = ws.remote_address
+                    await self._send(peer, {
+                        "type": "joined", "peerId": peer.peer_id, "peers": existing,
+                        "observed": list(remote[:2]) if remote else None,
+                    })
+                    for pid in existing:
+                        other = self.peers.get(pid)
+                        if other is not None:
+                            await self._send(other, {
+                                "type": "peer-joined", "peerId": peer.peer_id,
+                            })
+                    log.info("[signal] peer %s joined room %r (%d occupant(s))",
+                             peer.peer_id[:8], room_name, len(occupants))
+
+                elif mtype in RELAYED_TYPES:
+                    if peer is None:
+                        await ws.send(json.dumps(
+                            {"type": "error", "message": "join a room first"}))
+                        continue
+                    other = self._other_peer(peer)
+                    if other is None:
+                        await self._send(peer, {
+                            "type": "error", "message": "no peer in room"})
+                        continue
+                    relay = dict(msg)
+                    relay["from"] = peer.peer_id
+                    await self._send(other, relay)
+
+                elif mtype == "bye":
+                    if peer is not None:
+                        await self._remove_peer(peer)
+                        peer = None
+
+                else:
+                    await ws.send(json.dumps(
+                        {"type": "error", "message": f"unknown type {mtype!r}"}))
+        except websockets.ConnectionClosed:
+            pass
+        finally:
+            if peer is not None:
+                await self._remove_peer(peer)
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="tunnel signal server")
+    ap.add_argument("--listen", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8787)
+    args = ap.parse_args(argv)
+    from p2p_llm_tunnel_tpu.utils.logging import init_logging
+
+    init_logging()
+    try:
+        asyncio.run(SignalServer(args.listen, args.port).serve_forever())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -105,7 +105,8 @@ def main(argv: List[str] | None = None) -> None:
         server = await start_http_server(
             create_mock_llm_handler(pace_s=args.pace), args.host, args.port
         )
-        print(f"Mock LLM server running on :{args.port}", flush=True)
+        bound = server.sockets[0].getsockname()[1]
+        print(f"Mock LLM server running on http://{args.host}:{bound}", flush=True)
         async with server:
             await server.serve_forever()
 

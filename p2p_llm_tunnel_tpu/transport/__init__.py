@@ -5,9 +5,24 @@ The contract (`Channel`) mirrors the reference's DataChannelPair semantics
 received raw frames, and connected/disconnected events.  Implementations:
 
 - ``loopback_pair()`` — in-process pair for tests and same-process stacks.
+- ``TcpChannel`` — encrypted message framing over one TCP connection.
+- ``UdpChannel`` — hole-punched encrypted reliable UDP (the P2P data plane).
+- ``connect()`` — full rendezvous: signaling, role election, key exchange,
+  candidate punch — returns an established Channel (rtc.rs:463-514 analog).
 """
 
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.transport.connect import ConnectError, connect
 from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
+from p2p_llm_tunnel_tpu.transport.tcp import TcpChannel
+from p2p_llm_tunnel_tpu.transport.udp import UdpChannel
 
-__all__ = ["Channel", "ChannelClosed", "loopback_pair"]
+__all__ = [
+    "Channel",
+    "ChannelClosed",
+    "loopback_pair",
+    "TcpChannel",
+    "UdpChannel",
+    "connect",
+    "ConnectError",
+]

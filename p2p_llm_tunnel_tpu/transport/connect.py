@@ -1,0 +1,242 @@
+"""P2P connection establishment: signaling dance → established Channel.
+
+The rtc.rs:463-514 equivalent, with the same observable semantics:
+- role election: the first peer in the room waits for ``peer-joined`` and
+  becomes the OFFERER; a peer that finds the room occupied answers
+  (rtc.rs:471-505)
+- the offer/answer carry this stack's "SDP": the transport kind, an
+  ephemeral X25519 public key, and gathered candidates
+- candidates arriving before the remote description are handled naturally
+  (our candidates ride inside the offer/answer, so the reference's
+  buffering subtlety at rtc.rs:194-223 collapses; late trickled candidates
+  are also accepted while punching)
+- failure exits — peer-left, signaling error, socket loss, punch timeout —
+  raise, feeding the supervisor retry loop (rtc.rs:224-232)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+from typing import List, Optional, Tuple
+
+from p2p_llm_tunnel_tpu.signaling.client import (
+    Answer,
+    Candidate,
+    Joined,
+    Offer,
+    PeerJoined,
+    PeerLeft,
+    SignalError,
+    SignalingClient,
+)
+from p2p_llm_tunnel_tpu.transport.base import Channel
+from p2p_llm_tunnel_tpu.transport.crypto import HandshakeKeys
+from p2p_llm_tunnel_tpu.transport.tcp import TcpChannel
+from p2p_llm_tunnel_tpu.transport.udp import UdpChannel
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+CONNECT_TIMEOUT = 30.0
+PUNCH_TIMEOUT = 10.0
+
+
+class ConnectError(Exception):
+    """Connection establishment failed; the supervisor should retry."""
+
+
+def _local_addresses() -> List[str]:
+    """Candidate local IPs: loopback, hostname lookups, default-route trick."""
+    addrs = {"127.0.0.1"}
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET):
+            addrs.add(info[4][0])
+    except socket.gaierror:
+        pass
+    # UDP-connect trick: the OS picks the default-route source address.
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            addrs.add(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    return sorted(addrs)
+
+
+async def connect(
+    signal_url: str,
+    room: str,
+    transport: str = "udp",
+    timeout: float = CONNECT_TIMEOUT,
+) -> Tuple[Channel, SignalingClient]:
+    """Rendezvous in ``room`` and return an established data channel.
+
+    The caller owns both returned objects; close the signaling client once
+    the channel is up if trickle candidates are no longer needed.
+    """
+    try:
+        return await asyncio.wait_for(
+            _connect_inner(signal_url, room, transport), timeout
+        )
+    except asyncio.TimeoutError:
+        raise ConnectError(f"connect timed out after {timeout}s")
+
+
+async def _connect_inner(
+    signal_url: str, room: str, transport: str
+) -> Tuple[Channel, SignalingClient]:
+    signaling = await SignalingClient.connect(signal_url, room)
+    try:
+        joined = await _expect(signaling, Joined)
+        observed_ip: Optional[str] = (
+            joined.observed[0] if joined.observed else None
+        )
+        if not joined.peers:
+            log.info("room %r empty; waiting for a peer (offerer role)", room)
+            await _expect(signaling, PeerJoined)
+            channel = await _establish(signaling, room, observed_ip, transport,
+                                       offerer=True)
+        else:
+            log.info("room %r occupied; answering", room)
+            channel = await _establish(signaling, room, observed_ip, transport,
+                                       offerer=False)
+        return channel, signaling
+    except BaseException:
+        await signaling.close()
+        raise
+
+
+async def _expect(signaling: SignalingClient, kind):
+    """Wait for one message of ``kind``; error/peer-left/EOF raise."""
+    while True:
+        msg = await signaling.recv()
+        if msg is None:
+            raise ConnectError("signaling socket closed")
+        if isinstance(msg, kind):
+            return msg
+        if isinstance(msg, SignalError):
+            raise ConnectError(f"signaling error: {msg.message}")
+        if isinstance(msg, PeerLeft):
+            raise ConnectError("peer left during establishment")
+        log.debug("ignoring %s while waiting for %s", type(msg).__name__, kind.__name__)
+
+
+def _udp_candidates(port: int, observed_ip: Optional[str]) -> List[List]:
+    cands = [[ip, port] for ip in _local_addresses()]
+    if observed_ip and all(ip != observed_ip for ip, _ in cands):
+        # NAT-external guess: same port as bound (works for cone NATs that
+        # preserve ports; a TURN-style relay is the escape hatch, not built).
+        cands.append([observed_ip, port])
+    return cands
+
+
+async def _establish(
+    signaling: SignalingClient,
+    room: str,
+    observed_ip: Optional[str],
+    transport: str,
+    offerer: bool,
+) -> Channel:
+    keys = HandshakeKeys()
+
+    if transport == "udp":
+        channel = await UdpChannel.bind()
+        sdp = {
+            "kind": "udp",
+            "pubkey": keys.public_bytes.hex(),
+            "candidates": _udp_candidates(channel.local_port, observed_ip),
+        }
+    elif transport == "tcp":
+        if offerer:
+            listener_ref: List = []
+            server = await asyncio.start_server(
+                lambda r, w: listener_ref.append((r, w)), "0.0.0.0", 0
+            )
+            port = server.sockets[0].getsockname()[1]
+            sdp = {
+                "kind": "tcp",
+                "pubkey": keys.public_bytes.hex(),
+                "candidates": _udp_candidates(port, observed_ip),
+            }
+        else:
+            sdp = {"kind": "tcp", "pubkey": keys.public_bytes.hex(), "candidates": []}
+    else:
+        raise ConnectError(f"unknown transport {transport!r}")
+
+    # -- SDP exchange ------------------------------------------------------
+    if offerer:
+        await signaling.send_offer(sdp)
+        answer = await _expect(signaling, Answer)
+        remote = answer.sdp
+    else:
+        offer = await _expect(signaling, Offer)
+        remote = offer.sdp
+        await signaling.send_answer(sdp)
+
+    if remote.get("kind") != transport:
+        raise ConnectError(
+            f"transport mismatch: we={transport} peer={remote.get('kind')}"
+        )
+    try:
+        peer_pub = bytes.fromhex(remote["pubkey"])
+    except (KeyError, ValueError):
+        raise ConnectError("peer offer/answer missing a valid pubkey")
+    box = keys.derive(peer_pub, offerer=offerer, room=room)
+    remote_cands = [tuple(c) for c in remote.get("candidates", [])]
+
+    # -- transport establishment ------------------------------------------
+    if transport == "udp":
+        channel.set_session(box)
+        punch_list = [(str(h), int(p)) for h, p in remote_cands]
+        trickle = asyncio.create_task(_accept_trickle(signaling, punch_list))
+        try:
+            await channel.punch(punch_list, PUNCH_TIMEOUT)
+        except TimeoutError as e:
+            raise ConnectError(str(e))
+        finally:
+            trickle.cancel()
+        return channel
+
+    # tcp
+    if offerer:
+        try:
+            async with asyncio.timeout(PUNCH_TIMEOUT):
+                while not listener_ref:
+                    await asyncio.sleep(0.05)
+        except TimeoutError:
+            server.close()
+            raise ConnectError("tcp peer never dialed")
+        server.close()
+        reader, writer = listener_ref[0]
+        return TcpChannel(reader, writer, box)
+    last_err: Optional[Exception] = None
+    for host, port in remote_cands:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(str(host), int(port)), 3.0
+            )
+            return TcpChannel(reader, writer, box)
+        except (OSError, asyncio.TimeoutError) as e:
+            last_err = e
+    raise ConnectError(f"could not reach any tcp candidate: {last_err}")
+
+
+async def _accept_trickle(
+    signaling: SignalingClient, cands: List[Tuple]
+) -> None:
+    """Collect late candidates while punching (reference trickles ICE)."""
+    while True:
+        msg = await signaling.recv()
+        if msg is None:
+            return
+        if isinstance(msg, Candidate):
+            c = msg.candidate
+            if c.get("ip") is None or c.get("port") is None:
+                continue
+            pair = (str(c["ip"]), int(c["port"]))
+            if pair not in cands:
+                cands.append(pair)

@@ -1,0 +1,93 @@
+#!/usr/bin/env bash
+# Hermetic offline integration test (reference scripts/test-local.sh:34-133):
+# mock upstream → local signal server → serve peer → proxy peer → curl
+# assertions through the tunnel, with trap-based cleanup and log dumps on
+# failure.  Everything runs on localhost; the P2P path is the real encrypted
+# UDP hole-punch between two separate processes.
+set -u
+cd "$(dirname "$0")/.."
+
+LOGDIR=$(mktemp -d)
+ROOM="test-$$-$(date +%s)"
+SIG_PORT=${SIG_PORT:-18787}
+MOCK_PORT=${MOCK_PORT:-13001}
+PROXY_PORT=${PROXY_PORT:-18000}
+PIDS=()
+
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+  wait 2>/dev/null || true
+}
+trap cleanup EXIT
+
+fail() {
+  echo "FAIL: $1"
+  echo "--- mock ---";   tail -5 "$LOGDIR/mock.log" 2>/dev/null
+  echo "--- signal ---"; tail -5 "$LOGDIR/signal.log" 2>/dev/null
+  echo "--- serve ---";  tail -20 "$LOGDIR/serve.log" 2>/dev/null
+  echo "--- proxy ---";  tail -20 "$LOGDIR/proxy.log" 2>/dev/null
+  exit 1
+}
+
+echo "[1/5] mock upstream on :$MOCK_PORT"
+python -m p2p_llm_tunnel_tpu.testing.mock_llm --port "$MOCK_PORT" --pace 0.05 \
+  > "$LOGDIR/mock.log" 2>&1 &
+PIDS+=($!)
+
+echo "[2/5] signal server on :$SIG_PORT"
+python -m p2p_llm_tunnel_tpu.cli signal --port "$SIG_PORT" \
+  > "$LOGDIR/signal.log" 2>&1 &
+PIDS+=($!)
+sleep 1
+
+echo "[3/5] serve peer (room $ROOM)"
+python -m p2p_llm_tunnel_tpu.cli serve \
+  --signal "ws://127.0.0.1:$SIG_PORT" --room "$ROOM" \
+  --upstream "http://127.0.0.1:$MOCK_PORT" \
+  > "$LOGDIR/serve.log" 2>&1 &
+PIDS+=($!)
+sleep 1
+
+echo "[4/5] proxy peer on :$PROXY_PORT"
+python -m p2p_llm_tunnel_tpu.cli proxy \
+  --signal "ws://127.0.0.1:$SIG_PORT" --room "$ROOM" \
+  --listen "127.0.0.1:$PROXY_PORT" \
+  > "$LOGDIR/proxy.log" 2>&1 &
+PIDS+=($!)
+
+echo "[5/5] waiting for tunnel readiness"
+ready=0
+for _ in $(seq 1 30); do
+  if curl -sf "http://127.0.0.1:$PROXY_PORT/health" >/dev/null 2>&1; then
+    ready=1; break
+  fi
+  sleep 0.5
+done
+[ "$ready" = 1 ] || fail "tunnel never became ready"
+
+# --- assertions (reference test-local.sh asserts model name + health body) ---
+body=$(curl -s "http://127.0.0.1:$PROXY_PORT/health")
+[ "$body" = "ok" ] || fail "/health returned: $body"
+
+models=$(curl -s "http://127.0.0.1:$PROXY_PORT/v1/models")
+echo "$models" | grep -q "test-model" || fail "/v1/models missing test-model: $models"
+
+# SSE through the tunnel — a gap even the reference's scripts never cover
+# (SURVEY.md §4: "no SSE assertion in any script").
+sse=$(curl -sN -X POST "http://127.0.0.1:$PROXY_PORT/v1/chat/completions" \
+  -H 'content-type: application/json' \
+  -d '{"messages":[{"role":"user","content":"hi"}],"stream":true}')
+echo "$sse" | grep -q 'data: \[DONE\]' || fail "SSE stream missing [DONE]: $sse"
+n_events=$(echo "$sse" | grep -c '^data: ')
+[ "$n_events" -ge 5 ] || fail "expected >=5 SSE events, got $n_events"
+
+# Concurrency: 8 simultaneous requests multiplexed over one data channel.
+for i in $(seq 1 8); do
+  curl -s "http://127.0.0.1:$PROXY_PORT/v1/models" > "$LOGDIR/conc.$i" &
+done
+wait $(jobs -p | tail -8) 2>/dev/null
+for i in $(seq 1 8); do
+  grep -q "test-model" "$LOGDIR/conc.$i" || fail "concurrent request $i failed"
+done
+
+echo "PASS: tunnel e2e (health, models, SSE x$n_events events, 8-way concurrency)"

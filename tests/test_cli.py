@@ -1,0 +1,92 @@
+"""CLI + supervisor tests: arg precedence and retry/backoff semantics."""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu import cli
+
+
+def test_parser_defaults():
+    args = cli.build_parser().parse_args(["serve", "--room", "r"])
+    assert args.signal == "wss://signal-server.fly.dev"  # cli.rs default
+    assert args.advertise == "/"
+    assert args.backend == "http"
+    assert args.transport == "udp"
+    args = cli.build_parser().parse_args(["proxy", "--room", "r"])
+    assert args.listen == "127.0.0.1:8000"  # cli.rs default
+
+
+def test_parser_flag_over_env(monkeypatch):
+    # flag > env > default (cli.rs:13-68): env seen at import time feeds the
+    # default; an explicit flag must still win.
+    args = cli.build_parser().parse_args(
+        ["serve", "--room", "r", "--signal", "ws://flag:1"]
+    )
+    assert args.signal == "ws://flag:1"
+
+
+def test_run_with_retry_backoff_and_recovery():
+    calls = []
+    sleeps = []
+
+    async def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        return  # third attempt ends cleanly
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    async def main():
+        real_sleep = asyncio.sleep
+        asyncio.sleep = fake_sleep
+        try:
+            await cli.run_with_retry("test", flaky)
+        finally:
+            asyncio.sleep = real_sleep
+
+    asyncio.run(main())
+    assert len(calls) == 3
+    # backoff = 2*2^(attempt-1): 2s then 4s (main.rs:142)
+    assert sleeps == [2.0, 4.0]
+
+
+def test_run_with_retry_caps_at_60s():
+    sleeps = []
+
+    async def always_fails():
+        raise RuntimeError("nope")
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    async def main():
+        real_sleep = asyncio.sleep
+        asyncio.sleep = fake_sleep
+        try:
+            with pytest.raises(RuntimeError, match="giving up"):
+                await cli.run_with_retry("test", always_fails, max_attempts=8)
+        finally:
+            asyncio.sleep = real_sleep
+
+    asyncio.run(main())
+    assert sleeps[-1] == 60.0  # capped (main.rs:16)
+    assert sleeps[:3] == [2.0, 4.0, 8.0]
+
+
+def test_run_with_retry_cancellable_during_backoff():
+    """Ctrl+C (cancellation) interrupts the backoff sleep (main.rs:148-155)."""
+
+    async def always_fails():
+        raise RuntimeError("nope")
+
+    async def main():
+        task = asyncio.ensure_future(cli.run_with_retry("test", always_fails))
+        await asyncio.sleep(0.05)  # inside the first 2 s backoff now
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(asyncio.wait_for(main(), 5))

@@ -1,0 +1,141 @@
+"""Signaling server + client tests: in-process rendezvous.
+
+Asserts the reference room semantics (signal-server/src/index.ts:112-220):
+join/joined/peer-joined, verbatim relay with `from`, room-full error,
+peer-left on disconnect, bye handling.
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.signaling import SignalServer, SignalingClient
+from p2p_llm_tunnel_tpu.signaling.client import (
+    Answer,
+    Candidate,
+    Joined,
+    Offer,
+    PeerJoined,
+    PeerLeft,
+    SignalError,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+async def _start_server():
+    server = SignalServer(port=0)
+    port = await server.start()
+    return server, f"ws://127.0.0.1:{port}"
+
+
+def test_join_and_peer_joined():
+    async def main():
+        server, url = await _start_server()
+        a = await SignalingClient.connect(url, "room1")
+        joined_a = await a.recv(5)
+        assert isinstance(joined_a, Joined) and joined_a.peers == []
+
+        b = await SignalingClient.connect(url, "room1")
+        joined_b = await b.recv(5)
+        assert isinstance(joined_b, Joined)
+        assert joined_b.peers == [joined_a.peer_id]
+
+        notify = await a.recv(5)
+        assert isinstance(notify, PeerJoined)
+        assert notify.peer_id == joined_b.peer_id
+
+        await a.close()
+        await b.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_offer_answer_candidate_relay():
+    async def main():
+        server, url = await _start_server()
+        a = await SignalingClient.connect(url, "r")
+        await a.recv(5)  # joined
+        b = await SignalingClient.connect(url, "r")
+        await b.recv(5)  # joined
+        await a.recv(5)  # peer-joined
+
+        sdp = {"type": "offer", "sdp": "v=0 fake"}
+        await a.send_offer(sdp)
+        got = await b.recv(5)
+        assert isinstance(got, Offer) and got.sdp == sdp and got.sender
+
+        await b.send_answer({"type": "answer", "sdp": "v=0 reply"})
+        got = await a.recv(5)
+        assert isinstance(got, Answer) and got.sdp["sdp"] == "v=0 reply"
+
+        cand = {"candidate": "udp 1.2.3.4 5", "sdpMid": "0"}
+        await b.send_candidate(cand)
+        got = await a.recv(5)
+        assert isinstance(got, Candidate) and got.candidate == cand
+
+        await a.close()
+        await b.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_room_full():
+    async def main():
+        server, url = await _start_server()
+        a = await SignalingClient.connect(url, "full")
+        await a.recv(5)
+        b = await SignalingClient.connect(url, "full")
+        await b.recv(5)
+        c = await SignalingClient.connect(url, "full")
+        got = await c.recv(5)
+        assert isinstance(got, SignalError) and "full" in got.message
+
+        for cl in (a, b, c):
+            await cl.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_peer_left_on_disconnect():
+    async def main():
+        server, url = await _start_server()
+        a = await SignalingClient.connect(url, "x")
+        ja = await a.recv(5)
+        b = await SignalingClient.connect(url, "x")
+        await b.recv(5)
+        await a.recv(5)  # peer-joined
+
+        await b.close()  # sends bye
+        got = await a.recv(5)
+        assert isinstance(got, PeerLeft)
+
+        # Room now has one occupant; a third join succeeds again.
+        c = await SignalingClient.connect(url, "x")
+        jc = await c.recv(5)
+        assert isinstance(jc, Joined) and jc.peers == [ja.peer_id]
+
+        await a.close()
+        await c.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_relay_without_peer_errors():
+    async def main():
+        server, url = await _start_server()
+        a = await SignalingClient.connect(url, "solo")
+        await a.recv(5)
+        await a.send_offer({"sdp": "nobody home"})
+        got = await a.recv(5)
+        assert isinstance(got, SignalError)
+        await a.close()
+        await server.stop()
+
+    run(main())

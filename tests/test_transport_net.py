@@ -1,0 +1,186 @@
+"""Network transport tests: crypto, TCP channel, reliable UDP, full connect.
+
+Real sockets over loopback stand in for WAN peers, mirroring how the
+reference tests P2P with localhost processes (SURVEY.md §4).
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.signaling import SignalServer
+from p2p_llm_tunnel_tpu.transport import ChannelClosed, connect
+from p2p_llm_tunnel_tpu.transport.crypto import CryptoError, HandshakeKeys, SecureBox
+from p2p_llm_tunnel_tpu.transport.tcp import TcpChannel
+from p2p_llm_tunnel_tpu.transport.udp import UdpChannel
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+# -- crypto -----------------------------------------------------------------
+
+def test_handshake_derives_matching_boxes():
+    a, b = HandshakeKeys(), HandshakeKeys()
+    box_a = a.derive(b.public_bytes, offerer=True, room="r")
+    box_b = b.derive(a.public_bytes, offerer=False, room="r")
+    wire = box_a.seal(b"hello tunnel")
+    assert box_b.open(wire) == b"hello tunnel"
+    back = box_b.seal(b"reply")
+    assert box_a.open(back) == b"reply"
+
+
+def test_tampered_ciphertext_rejected():
+    a, b = HandshakeKeys(), HandshakeKeys()
+    box_a = a.derive(b.public_bytes, True, "r")
+    box_b = b.derive(a.public_bytes, False, "r")
+    wire = bytearray(box_a.seal(b"payload"))
+    wire[-1] ^= 0xFF
+    with pytest.raises(CryptoError):
+        box_b.open(bytes(wire))
+
+
+def test_wrong_room_means_wrong_keys():
+    a, b = HandshakeKeys(), HandshakeKeys()
+    box_a = a.derive(b.public_bytes, True, "room-one")
+    box_b = b.derive(a.public_bytes, False, "room-two")
+    with pytest.raises(CryptoError):
+        box_b.open(box_a.seal(b"x"))
+
+
+# -- tcp channel ------------------------------------------------------------
+
+async def _tcp_pair():
+    a_keys, b_keys = HandshakeKeys(), HandshakeKeys()
+    box_a = a_keys.derive(b_keys.public_bytes, True, "t")
+    box_b = b_keys.derive(a_keys.public_bytes, False, "t")
+    accepted = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await accepted.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    r_b, w_b = await asyncio.open_connection("127.0.0.1", port)
+    r_a, w_a = await accepted.get()
+    server.close()
+    return TcpChannel(r_a, w_a, box_a), TcpChannel(r_b, w_b, box_b)
+
+
+def test_tcp_roundtrip_and_boundaries():
+    async def main():
+        a, b = await _tcp_pair()
+        await a.send(b"one")
+        await a.send(b"two" * 10000)  # 30 KB frame
+        await b.send(b"back")
+        assert await b.recv() == b"one"
+        assert await b.recv() == b"two" * 10000
+        assert await a.recv() == b"back"
+        a.close()
+        b.close()
+
+    run(main())
+
+
+def test_tcp_close_propagates():
+    async def main():
+        a, b = await _tcp_pair()
+        a.close()
+        with pytest.raises(ChannelClosed):
+            # b sees EOF and raises once drained
+            for _ in range(10):
+                await asyncio.wait_for(b.recv(), 5)
+        assert b.disconnected.is_set()
+
+    run(main())
+
+
+# -- udp channel ------------------------------------------------------------
+
+async def _udp_pair():
+    a_keys, b_keys = HandshakeKeys(), HandshakeKeys()
+    a = await UdpChannel.bind("127.0.0.1")
+    b = await UdpChannel.bind("127.0.0.1")
+    a.set_session(a_keys.derive(b_keys.public_bytes, True, "u"))
+    b.set_session(b_keys.derive(a_keys.public_bytes, False, "u"))
+    await asyncio.gather(
+        a.punch([("127.0.0.1", b.local_port)]),
+        b.punch([("127.0.0.1", a.local_port)]),
+    )
+    return a, b
+
+
+def test_udp_roundtrip_order_and_fragmentation():
+    async def main():
+        a, b = await _udp_pair()
+        msgs = [bytes([i]) * (i * 500) for i in range(1, 8)]  # up to 3.5 KB
+        for m in msgs:
+            await a.send(m)
+        for m in msgs:
+            assert await asyncio.wait_for(b.recv(), 10) == m
+        # big frame: 64 KiB → 55 fragments, must reassemble exactly
+        big = bytes(range(256)) * 256
+        await b.send(big)
+        assert await asyncio.wait_for(a.recv(), 10) == big
+        a.close()
+        b.close()
+
+    run(main())
+
+
+def test_udp_close_notifies_peer():
+    async def main():
+        a, b = await _udp_pair()
+        a.close()
+        await asyncio.wait_for(b.disconnected.wait(), 10)
+
+    run(main())
+
+
+def test_udp_punch_timeout():
+    async def main():
+        keys = HandshakeKeys()
+        peer = HandshakeKeys()
+        ch = await UdpChannel.bind("127.0.0.1")
+        ch.set_session(keys.derive(peer.public_bytes, True, "x"))
+        with pytest.raises(TimeoutError):
+            # port 1 on loopback: nothing answers
+            await ch.punch([("127.0.0.1", 1)], timeout=1.0)
+
+    run(main())
+
+
+# -- full connect flow ------------------------------------------------------
+
+@pytest.mark.parametrize("transport", ["udp", "tcp"])
+def test_connect_end_to_end(transport):
+    async def main():
+        server = SignalServer(port=0)
+        port = await server.start()
+        url = f"ws://127.0.0.1:{port}"
+
+        async def peer_a():
+            ch, sig = await connect(url, "e2e-" + transport, transport)
+            await ch.send(b"from-a")
+            got = await asyncio.wait_for(ch.recv(), 10)
+            await sig.close()
+            ch.close()
+            return got
+
+        async def peer_b():
+            await asyncio.sleep(0.2)  # let A join first → A is offerer
+            ch, sig = await connect(url, "e2e-" + transport, transport)
+            got = await asyncio.wait_for(ch.recv(), 10)
+            await ch.send(b"from-b")
+            await asyncio.sleep(0.5)  # let the frame flush before close
+            await sig.close()
+            ch.close()
+            return got
+
+        got_a, got_b = await asyncio.gather(peer_a(), peer_b())
+        assert got_a == b"from-b"
+        assert got_b == b"from-a"
+        await server.stop()
+
+    run(main())
