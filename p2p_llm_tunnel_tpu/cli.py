@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="max context length (tpu backend)")
     serve.add_argument("--decode-steps", type=int, default=8,
                        help="decode steps per device call (tpu backend)")
+    serve.add_argument("--tp", type=int, default=1,
+                       help="tensor-parallel degree over the device mesh")
+    serve.add_argument("--ckpt", default=_env("TUNNEL_CKPT"),
+                       help="orbax checkpoint path (default: random init)")
 
     proxy = sub.add_parser("proxy", help="consumer peer: local HTTP port")
     common(proxy)
@@ -93,9 +97,12 @@ async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0) -> Non
     Cancellation (SIGINT) aborts both the running attempt and the backoff
     sleep — matching main.rs:119-125, :148-155.
     """
+    import time as _time
+
     attempt = 0
     while True:
         attempt += 1
+        started = _time.monotonic()
         try:
             log.info("%s: connecting (attempt %d)", name, attempt)
             await attempt_fn()
@@ -105,6 +112,10 @@ async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0) -> Non
             raise
         except Exception as e:
             log.warning("%s failed: %s", name, e)
+        if _time.monotonic() - started > MAX_BACKOFF:
+            # The session ran healthily before dying — treat the next
+            # reconnect as fresh rather than compounding hours-old failures.
+            attempt = 1
         if max_attempts and attempt >= max_attempts:
             raise RuntimeError(f"{name}: giving up after {attempt} attempts")
         backoff = min(INITIAL_BACKOFF * (2 ** (attempt - 1)), MAX_BACKOFF)
@@ -151,6 +162,8 @@ async def _engine_backend(args):
                 num_slots=args.slots,
                 max_seq=args.max_seq,
                 decode_steps=args.decode_steps,
+                tp=args.tp,
+                ckpt_path=args.ckpt,
             )
         )
         await _ENGINE.start()

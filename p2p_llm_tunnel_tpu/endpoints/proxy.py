@@ -29,7 +29,6 @@ from p2p_llm_tunnel_tpu.endpoints.http11 import (
     start_http_server,
 )
 from p2p_llm_tunnel_tpu.protocol.frames import (
-    MAX_BODY_CHUNK,
     Agree,
     Hello,
     MessageType,
@@ -37,7 +36,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     RequestHeaders,
     ResponseHeaders,
     TunnelMessage,
-    iter_body_chunks,
+    encode_body_frames,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
@@ -172,8 +171,8 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                 RequestHeaders(stream_id, req.method, req.path, dict(req.headers))
             ).encode()
         )
-        for chunk in iter_body_chunks(req.body, MAX_BODY_CHUNK):
-            await channel.send(TunnelMessage.req_body(stream_id, chunk).encode())
+        for frame in encode_body_frames(MessageType.REQ_BODY, stream_id, req.body):
+            await channel.send(frame)
         await channel.send(TunnelMessage.req_end(stream_id).encode())
     except ChannelClosed:
         state.pending.pop(stream_id, None)

@@ -22,7 +22,6 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from p2p_llm_tunnel_tpu.endpoints import http11
 from p2p_llm_tunnel_tpu.protocol.frames import (
-    MAX_BODY_CHUNK,
     Agree,
     Hello,
     MessageType,
@@ -30,7 +29,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     RequestHeaders,
     ResponseHeaders,
     TunnelMessage,
-    iter_body_chunks,
+    encode_body_frames,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
@@ -116,8 +115,8 @@ async def _handle_request_inner(
     )
     try:
         async for chunk in chunks:
-            for sub in iter_body_chunks(chunk, MAX_BODY_CHUNK):
-                await channel.send(TunnelMessage.res_body(stream_id, sub).encode())
+            for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
+                await channel.send(frame)
     except Exception as e:
         # Upstream dropped mid-stream — truncate with an ERROR frame
         # (serve.rs:278-284); the proxy ends the HTTP body without an error.

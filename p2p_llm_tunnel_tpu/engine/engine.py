@@ -57,6 +57,12 @@ class EngineConfig:
     # padded to exactly this many rows so each prompt-length bucket compiles
     # ONE prefill program (pad rows scatter into the scratch slot).
     prefill_rows: int = 8
+    # Tensor-parallel degree: shards params/KV-heads over a tp-axis Mesh
+    # (parallel/sharding.py); 1 = single chip.  GSPMD inserts the ICI
+    # collectives — the decode all-gather path of BASELINE config 4.
+    tp: int = 1
+    # Optional orbax checkpoint to load instead of random init.
+    ckpt_path: Optional[str] = None
 
 
 @dataclass
@@ -94,10 +100,32 @@ class InferenceEngine:
         dtype = jnp.dtype(self.ecfg.dtype)
         key = jax.random.PRNGKey(self.ecfg.seed)
         if params is None:
-            log.info("initialising random params for %s", self.mcfg.name)
-            params = init_params(self.mcfg, key, dtype)
-        self.params = params
+            if self.ecfg.ckpt_path:
+                from p2p_llm_tunnel_tpu.models.checkpoint import load_checkpoint
+
+                log.info("loading checkpoint from %s", self.ecfg.ckpt_path)
+                like = jax.eval_shape(
+                    lambda k: init_params(self.mcfg, k, dtype), key
+                )
+                params = load_checkpoint(self.ecfg.ckpt_path, like=like)
+            else:
+                log.info("initialising random params for %s", self.mcfg.name)
+                params = init_params(self.mcfg, key, dtype)
+        if mesh is None and self.ecfg.tp > 1:
+            from p2p_llm_tunnel_tpu.parallel import make_mesh
+
+            mesh = make_mesh(tp=self.ecfg.tp, dp=1)
         self.mesh = mesh
+        if mesh is not None:
+            from p2p_llm_tunnel_tpu.parallel.sharding import (
+                param_shardings as _pshard,
+                shard_params,
+            )
+
+            log.info("sharding params over mesh %s", dict(mesh.shape))
+            params = shard_params(params, self.mcfg, mesh)
+            param_shardings = _pshard(self.mcfg, mesh)
+        self.params = params
         self.param_shardings = param_shardings
 
         b, s = self.ecfg.num_slots, self.ecfg.max_seq
@@ -106,6 +134,12 @@ class InferenceEngine:
         rows = b + 1
         self._scratch_slot = b
         self.kv_cache = init_kv_cache(self.mcfg, rows, s, dtype)
+        if self.mesh is not None:
+            from p2p_llm_tunnel_tpu.parallel.sharding import shard_kv_cache
+
+            # tp shards the kv-head axis; the slot axis stays whole (the
+            # engine's dp axis is 1 — replica routing is a layer above).
+            self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
         self.scheduler = Scheduler(b, s)
 
         # Host-side per-slot state driving each decode step.

@@ -1,0 +1,171 @@
+"""Checkpoint save/load (orbax) + HuggingFace weight conversion.
+
+The reference has NO checkpointing — it is a stateless tunnel (SURVEY.md §5
+checkpoint bullet).  The TPU engine adds it: model weights persist via
+orbax (sharding-aware, async-capable), and real Llama/Gemma checkpoints
+load through a converter from HF per-layer naming to this framework's
+stacked-layer pytree (models/transformer.py init_params layout: every
+block tensor is [n_layers, ...] so the layer loop is a lax.scan).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# orbax save / load
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(path: str, params: Params) -> None:
+    """Write a param pytree with orbax (atomic, resumable)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    log.info("saved checkpoint to %s", path)
+
+
+def load_checkpoint(
+    path: str,
+    like: Optional[Params] = None,
+    shardings: Optional[Any] = None,
+) -> Params:
+    """Load a param pytree.
+
+    ``like`` (an abstract or concrete pytree) pins dtypes/shapes; pass
+    ``shardings`` (a NamedSharding pytree) to restore directly onto a mesh
+    without a host copy per chip.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        if shardings is not None:
+            abstract = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                like,
+                shardings,
+            )
+        else:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), like
+            )
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# HF state-dict conversion
+# ---------------------------------------------------------------------------
+
+def _stack(tensors, dtype) -> jnp.ndarray:
+    return jnp.asarray(np.stack([np.asarray(t) for t in tensors]), dtype)
+
+
+def convert_hf_llama(
+    state: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF Llama layout → stacked pytree.
+
+    HF stores per-layer ``model.layers.{i}.self_attn.q_proj.weight`` with
+    shape [out, in]; our matmuls are ``x @ W`` so every projection is
+    transposed, then stacked on a leading layer axis.
+    """
+    l = cfg.n_layers
+
+    def w(name: str, i: int) -> np.ndarray:
+        return np.asarray(state[f"model.layers.{i}.{name}.weight"])
+
+    blocks = {
+        "attn_norm": _stack([w("input_layernorm", i) for i in range(l)], dtype),
+        "mlp_norm": _stack(
+            [w("post_attention_layernorm", i) for i in range(l)], dtype
+        ),
+        "wq": _stack([w("self_attn.q_proj", i).T for i in range(l)], dtype),
+        "wk": _stack([w("self_attn.k_proj", i).T for i in range(l)], dtype),
+        "wv": _stack([w("self_attn.v_proj", i).T for i in range(l)], dtype),
+        "wo": _stack([w("self_attn.o_proj", i).T for i in range(l)], dtype),
+        "w_gate": _stack([w("mlp.gate_proj", i).T for i in range(l)], dtype),
+        "w_up": _stack([w("mlp.up_proj", i).T for i in range(l)], dtype),
+        "w_down": _stack([w("mlp.down_proj", i).T for i in range(l)], dtype),
+    }
+    params: Params = {
+        "embed": jnp.asarray(np.asarray(state["model.embed_tokens.weight"]), dtype),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(np.asarray(state["model.norm.weight"]), dtype),
+    }
+    if not cfg.tie_embeddings:
+        head = state.get("lm_head.weight")
+        if head is None:  # some exports tie implicitly
+            head = state["model.embed_tokens.weight"]
+        params["lm_head"] = jnp.asarray(np.asarray(head).T, dtype)
+    return params
+
+
+def convert_hf_gemma2(
+    state: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF Gemma-2 layout → stacked pytree.
+
+    Same projection transposes as llama; gemma2 additionally has pre/post
+    norms per sub-block (mapped to attn/mlp norm + post_* norms) and tied
+    embeddings (no lm_head).
+    """
+    l = cfg.n_layers
+
+    def w(name: str, i: int) -> np.ndarray:
+        return np.asarray(state[f"model.layers.{i}.{name}.weight"])
+
+    blocks = {
+        "attn_norm": _stack([w("input_layernorm", i) for i in range(l)], dtype),
+        "post_attn_norm": _stack(
+            [w("post_attention_layernorm", i) for i in range(l)], dtype
+        ),
+        "mlp_norm": _stack(
+            [w("pre_feedforward_layernorm", i) for i in range(l)], dtype
+        ),
+        "post_mlp_norm": _stack(
+            [w("post_feedforward_layernorm", i) for i in range(l)], dtype
+        ),
+        "wq": _stack([w("self_attn.q_proj", i).T for i in range(l)], dtype),
+        "wk": _stack([w("self_attn.k_proj", i).T for i in range(l)], dtype),
+        "wv": _stack([w("self_attn.v_proj", i).T for i in range(l)], dtype),
+        "wo": _stack([w("self_attn.o_proj", i).T for i in range(l)], dtype),
+        "w_gate": _stack([w("mlp.gate_proj", i).T for i in range(l)], dtype),
+        "w_up": _stack([w("mlp.up_proj", i).T for i in range(l)], dtype),
+        "w_down": _stack([w("mlp.down_proj", i).T for i in range(l)], dtype),
+    }
+    return {
+        "embed": jnp.asarray(np.asarray(state["model.embed_tokens.weight"]), dtype),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(np.asarray(state["model.norm.weight"]), dtype),
+    }
+
+
+CONVERTERS = {
+    "llama": convert_hf_llama,
+    "gemma2": convert_hf_gemma2,
+}
+
+
+def convert_hf(family: str, state: Mapping[str, Any], cfg: ModelConfig,
+               dtype=jnp.bfloat16) -> Params:
+    if family not in CONVERTERS:
+        raise KeyError(f"unknown family {family!r}; have {sorted(CONVERTERS)}")
+    return CONVERTERS[family](state, cfg, dtype)

@@ -1,0 +1,152 @@
+"""Ring attention: causal self-attention over a sequence-sharded mesh axis.
+
+Long-context prefill beyond one chip's HBM (SURVEY.md §5 long-context
+bullet — entirely net-new; the reference has no attention at all).  The
+sequence axis is sharded over the ``sp`` mesh axis; each device keeps its
+local Q block resident while K/V blocks rotate around the ICI ring via
+``jax.lax.ppermute``, accumulating output with an online (flash-style)
+softmax so the full score matrix never materialises.
+
+Per ring step each device holds one K/V block and updates:
+    m_new = max(m, rowmax(scores))
+    acc   = acc * exp(m - m_new) + exp(scores - m_new) @ V
+    l     = l * exp(m - m_new) + rowsum(exp(scores - m_new))
+Causality is enforced with global positions, so blocks that lie entirely in
+the future contribute nothing (their scores mask to -inf).
+
+Communication cost: (sp-1) ppermute hops of the local K/V block per layer —
+bandwidth-optimal for the ring topology TPU ICI provides.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_scores(
+    q: jnp.ndarray,  # [B, Tq, K, G, D]
+    k: jnp.ndarray,  # [B, Tk, K, D]
+    scale: float,
+    softcap: Optional[float],
+    q_pos: jnp.ndarray,  # [Tq] global positions
+    k_pos: jnp.ndarray,  # [Tk] global positions
+) -> jnp.ndarray:
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
+    mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk] causal
+    return jnp.where(mask[None, None, None], scores, _NEG_INF)
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,  # [B, Tq, H, D] this device's query block
+    k: jnp.ndarray,  # [B, Tk, K, D] this device's initial key block
+    v: jnp.ndarray,  # [B, Tk, K, D]
+    *,
+    axis_name: str,
+    scale: float,
+    softcap: Optional[float],
+) -> jnp.ndarray:
+    """The per-device program (runs inside shard_map)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    kh = k.shape[2]
+    g = h // kh
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    q5 = q.reshape(b, tq, kh, g, d)
+    q_pos = my_idx * tq + jnp.arange(tq)
+
+    # pvary: the accumulators start as constants but the scan makes them
+    # device-varying over the ring axis; their carry types must match.
+    acc0 = jax.lax.pvary(jnp.zeros((b, kh, g, tq, d), jnp.float32), (axis_name,))
+    m0 = jax.lax.pvary(jnp.full((b, kh, g, tq), _NEG_INF, jnp.float32), (axis_name,))
+    l0 = jax.lax.pvary(jnp.zeros((b, kh, g, tq), jnp.float32), (axis_name,))
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, _):
+        acc, m, l, k_blk, v_blk, src = carry
+        k_pos = src * tk + jnp.arange(tk)
+        s = _block_scores(q5, k_blk, scale, softcap, q_pos, k_pos)  # [B,K,G,Tq,Tk]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # All-masked rows keep m == -inf; exp(-inf - -inf) would be NaN, so
+        # clamp the correction for rows that have seen nothing yet.
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s == _NEG_INF, 0.0, p)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bkgts,bskd->bkgtd", p, v_blk.astype(jnp.float32)
+        )
+        l = l * corr + p.sum(axis=-1)
+        m = m_new
+        # rotate K/V (and their source index) one hop around the ring
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = jax.lax.ppermute(src, axis_name, perm)
+        return (acc, m, l, k_blk, v_blk, src), None
+
+    init = (acc0, m0, l0, k, v, my_idx)
+    (acc, m, l, _, _, _), _ = jax.lax.scan(step, init, None, length=n)
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)  # [B,K,G,Tq,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, tq, h, d).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+):
+    """Build a jittable ring-attention fn over ``mesh``'s sequence axis.
+
+    Returned fn takes GLOBAL arrays q [B,T,H,D], k/v [B,T,K,D] (sequence
+    dense, causal) and returns [B,T,H,D]; under jit the inputs/outputs are
+    sequence-sharded over ``axis_name`` and the K/V rotation rides the ring.
+    """
+
+    def fn(q, k, v):
+        d = q.shape[-1]
+        s = scale if scale is not None else d**-0.5
+        local = functools.partial(
+            _ring_attention_local, axis_name=axis_name, scale=s, softcap=softcap
+        )
+        seq_spec = P(None, axis_name, None, None)
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec),
+            out_specs=seq_spec,
+        )
+        return sharded(q, k, v)
+
+    return fn
+
+
+def ring_attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *, scale: Optional[float] = None, softcap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-device causal GQA attention — the numerics oracle for tests."""
+    b, t, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    s = scale if scale is not None else d**-0.5
+    q5 = q.reshape(b, t, kh, g, d)
+    pos = jnp.arange(t)
+    scores = _block_scores(q5, k, s, softcap, pos, pos)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, h, d).astype(q.dtype)

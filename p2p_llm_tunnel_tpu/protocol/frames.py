@@ -293,3 +293,23 @@ def iter_body_chunks(data: bytes, chunk_size: int = MAX_BODY_CHUNK):
     """Split a body into frame-sized chunks. Yields nothing for empty bodies."""
     for i in range(0, len(data), chunk_size):
         yield data[i : i + chunk_size]
+
+
+def encode_body_frames(
+    msg_type: MessageType, stream_id: int, data: bytes,
+    chunk_size: int = MAX_BODY_CHUNK,
+) -> List[bytes]:
+    """Chunk + encode a body into ready-to-send frames in one step.
+
+    Uses the native C++ codec (protocol/native.py) when built — this is the
+    per-token hot path on the serve side — falling back to the Python codec.
+    """
+    from p2p_llm_tunnel_tpu.protocol import native
+
+    frames = native.chunk_body(int(msg_type), stream_id, data, chunk_size)
+    if frames is not None:
+        return frames
+    return [
+        TunnelMessage(msg_type, stream_id, c).encode()
+        for c in iter_body_chunks(data, chunk_size)
+    ]

@@ -142,87 +142,103 @@ async def _establish(
     offerer: bool,
 ) -> Channel:
     keys = HandshakeKeys()
+    channel: Optional[UdpChannel] = None
+    server: Optional[asyncio.AbstractServer] = None
+    accepted: "Optional[asyncio.Future]" = None
 
-    if transport == "udp":
-        channel = await UdpChannel.bind()
-        sdp = {
-            "kind": "udp",
-            "pubkey": keys.public_bytes.hex(),
-            "candidates": _udp_candidates(channel.local_port, observed_ip),
-        }
-    elif transport == "tcp":
-        if offerer:
-            listener_ref: List = []
-            server = await asyncio.start_server(
-                lambda r, w: listener_ref.append((r, w)), "0.0.0.0", 0
-            )
-            port = server.sockets[0].getsockname()[1]
-            sdp = {
-                "kind": "tcp",
-                "pubkey": keys.public_bytes.hex(),
-                "candidates": _udp_candidates(port, observed_ip),
-            }
-        else:
-            sdp = {"kind": "tcp", "pubkey": keys.public_bytes.hex(), "candidates": []}
-    else:
-        raise ConnectError(f"unknown transport {transport!r}")
-
-    # -- SDP exchange ------------------------------------------------------
-    if offerer:
-        await signaling.send_offer(sdp)
-        answer = await _expect(signaling, Answer)
-        remote = answer.sdp
-    else:
-        offer = await _expect(signaling, Offer)
-        remote = offer.sdp
-        await signaling.send_answer(sdp)
-
-    if remote.get("kind") != transport:
-        raise ConnectError(
-            f"transport mismatch: we={transport} peer={remote.get('kind')}"
-        )
+    # Any exit before the channel is handed to the caller — signaling
+    # failure, mismatch, punch timeout, or cancellation from the outer
+    # connect() deadline — must release the bound socket/listener, or the
+    # supervisor's infinite retries leak one fd per attempt.
     try:
-        peer_pub = bytes.fromhex(remote["pubkey"])
-    except (KeyError, ValueError):
-        raise ConnectError("peer offer/answer missing a valid pubkey")
-    box = keys.derive(peer_pub, offerer=offerer, room=room)
-    remote_cands = [tuple(c) for c in remote.get("candidates", [])]
+        if transport == "udp":
+            channel = await UdpChannel.bind()
+            sdp = {
+                "kind": "udp",
+                "pubkey": keys.public_bytes.hex(),
+                "candidates": _udp_candidates(channel.local_port, observed_ip),
+            }
+        elif transport == "tcp":
+            if offerer:
+                accepted = asyncio.get_running_loop().create_future()
 
-    # -- transport establishment ------------------------------------------
-    if transport == "udp":
-        channel.set_session(box)
-        punch_list = [(str(h), int(p)) for h, p in remote_cands]
-        trickle = asyncio.create_task(_accept_trickle(signaling, punch_list))
-        try:
-            await channel.punch(punch_list, PUNCH_TIMEOUT)
-        except TimeoutError as e:
-            raise ConnectError(str(e))
-        finally:
-            trickle.cancel()
-        return channel
+                def on_conn(r, w, fut=accepted):
+                    if not fut.done():
+                        fut.set_result((r, w))
+                    else:
+                        w.close()
 
-    # tcp
-    if offerer:
-        try:
-            async with asyncio.timeout(PUNCH_TIMEOUT):
-                while not listener_ref:
-                    await asyncio.sleep(0.05)
-        except TimeoutError:
-            server.close()
-            raise ConnectError("tcp peer never dialed")
-        server.close()
-        reader, writer = listener_ref[0]
-        return TcpChannel(reader, writer, box)
-    last_err: Optional[Exception] = None
-    for host, port in remote_cands:
-        try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(str(host), int(port)), 3.0
+                server = await asyncio.start_server(on_conn, "0.0.0.0", 0)
+                port = server.sockets[0].getsockname()[1]
+                sdp = {
+                    "kind": "tcp",
+                    "pubkey": keys.public_bytes.hex(),
+                    "candidates": _udp_candidates(port, observed_ip),
+                }
+            else:
+                sdp = {"kind": "tcp", "pubkey": keys.public_bytes.hex(),
+                       "candidates": []}
+        else:
+            raise ConnectError(f"unknown transport {transport!r}")
+
+        # -- SDP exchange --------------------------------------------------
+        if offerer:
+            await signaling.send_offer(sdp)
+            answer = await _expect(signaling, Answer)
+            remote = answer.sdp
+        else:
+            offer = await _expect(signaling, Offer)
+            remote = offer.sdp
+            await signaling.send_answer(sdp)
+
+        if remote.get("kind") != transport:
+            raise ConnectError(
+                f"transport mismatch: we={transport} peer={remote.get('kind')}"
             )
+        try:
+            peer_pub = bytes.fromhex(remote["pubkey"])
+        except (KeyError, ValueError):
+            raise ConnectError("peer offer/answer missing a valid pubkey")
+        box = keys.derive(peer_pub, offerer=offerer, room=room)
+        remote_cands = [tuple(c) for c in remote.get("candidates", [])]
+
+        # -- transport establishment --------------------------------------
+        if transport == "udp":
+            channel.set_session(box)
+            punch_list = [(str(h), int(p)) for h, p in remote_cands]
+            trickle = asyncio.create_task(_accept_trickle(signaling, punch_list))
+            try:
+                await channel.punch(punch_list, PUNCH_TIMEOUT)
+            except TimeoutError as e:
+                raise ConnectError(str(e))
+            finally:
+                trickle.cancel()
+            out, channel = channel, None  # ownership passes to the caller
+            return out
+
+        if offerer:
+            try:
+                reader, writer = await asyncio.wait_for(accepted, PUNCH_TIMEOUT)
+            except asyncio.TimeoutError:
+                raise ConnectError("tcp peer never dialed")
             return TcpChannel(reader, writer, box)
-        except (OSError, asyncio.TimeoutError) as e:
-            last_err = e
-    raise ConnectError(f"could not reach any tcp candidate: {last_err}")
+        last_err: Optional[Exception] = None
+        for host, port in remote_cands:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(str(host), int(port)), 3.0
+                )
+                return TcpChannel(reader, writer, box)
+            except (OSError, asyncio.TimeoutError) as e:
+                last_err = e
+        raise ConnectError(f"could not reach any tcp candidate: {last_err}")
+    finally:
+        if channel is not None:
+            channel.close()
+        if server is not None:
+            # close() stops the listener; do NOT wait_closed() — on 3.12 it
+            # blocks until accepted connections (the live tunnel!) close.
+            server.close()
 
 
 async def _accept_trickle(

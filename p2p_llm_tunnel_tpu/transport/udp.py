@@ -86,6 +86,7 @@ class UdpChannel(Channel):
         self._partial = bytearray()
 
         self._last_heard = time.monotonic()
+        self._last_sent = time.monotonic()
         self._maint_task: Optional[asyncio.Task] = None
 
     # -- setup ------------------------------------------------------------
@@ -141,6 +142,7 @@ class UdpChannel(Channel):
             return
         try:
             self._transport.sendto(self._box.seal(plaintext), addr)
+            self._last_sent = time.monotonic()
         except OSError as e:
             log.debug("udp sendto failed: %s", e)
 
@@ -253,8 +255,11 @@ class UdpChannel(Channel):
                         if now - sent_at >= rto:
                             self._unacked[seq] = (pkt, now, tries + 1)
                             self._send_raw(pkt, self._peer_addr)
-                    if now - self._last_heard > KEEPALIVE_INTERVAL:
-                        self._send_control(PT_PUNCH_ACK)
+                    # Keepalive gates on time-since-last-SENT and uses PUNCH
+                    # (which elicits a PUNCH_ACK), so an idle-but-healthy
+                    # channel keeps both peers' last-heard clocks fresh.
+                    if now - self._last_sent > KEEPALIVE_INTERVAL:
+                        self._send_control(PT_PUNCH)
         except asyncio.CancelledError:
             pass
 

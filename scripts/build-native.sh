@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Build the native C++ tunnel libraries into native/build/.
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+mkdir -p build
+g++ -O2 -Wall -Wextra -shared -fPIC tunnel_frames.cc -o build/libtunnelframes.so
+echo "built native/build/libtunnelframes.so"

@@ -1,0 +1,116 @@
+"""Checkpoint roundtrips (orbax) and HF layout conversion on tiny models."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_tunnel_tpu.models.checkpoint import (
+    convert_hf,
+    load_checkpoint,
+    save_checkpoint,
+)
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+
+
+def test_orbax_roundtrip(tmp_path, cpu_devices):
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    restored = load_checkpoint(path, like=params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        restored,
+    )
+
+
+def _fake_hf_llama_state(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    state = {
+        "model.embed_tokens.weight": t(cfg.vocab_size, cfg.dim),
+        "model.norm.weight": np.ones(cfg.dim, np.float32),
+        "lm_head.weight": t(cfg.vocab_size, cfg.dim),
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        state[p + "input_layernorm.weight"] = np.ones(cfg.dim, np.float32)
+        state[p + "post_attention_layernorm.weight"] = np.ones(cfg.dim, np.float32)
+        state[p + "self_attn.q_proj.weight"] = t(cfg.n_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.k_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.v_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.o_proj.weight"] = t(cfg.dim, cfg.n_heads * cfg.head_dim)
+        state[p + "mlp.gate_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
+        state[p + "mlp.up_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
+        state[p + "mlp.down_proj.weight"] = t(cfg.dim, cfg.ffn_dim)
+    return state
+
+
+def test_convert_hf_llama_shapes_and_forward(cpu_devices):
+    cfg = get_config("tiny")
+    state = _fake_hf_llama_state(cfg)
+    params = convert_hf("llama", state, cfg, jnp.float32)
+
+    ref = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    ref_shapes = jax.tree.map(lambda x: x.shape, ref)
+    got_shapes = jax.tree.map(lambda x: x.shape, params)
+    assert ref_shapes == got_shapes
+
+    # converted params must run the real forward pass
+    tokens = jnp.array([[1, 2, 3, 4]])
+    valid = jnp.ones_like(tokens, bool)
+    logits, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(params)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_convert_hf_llama_transposes_projections(cpu_devices):
+    """x @ wq must equal HF's q_proj(x) = x @ W_q^T."""
+    cfg = get_config("tiny")
+    state = _fake_hf_llama_state(cfg)
+    params = convert_hf("llama", state, cfg, jnp.float32)
+    x = np.random.default_rng(1).standard_normal(cfg.dim).astype(np.float32)
+    got = np.asarray(x @ np.asarray(params["blocks"]["wq"][0]))
+    want = np.asarray(state["model.layers.0.self_attn.q_proj.weight"]) @ x
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_convert_hf_gemma2_shapes(cpu_devices):
+    cfg = get_config("tiny-gemma")
+    rng = np.random.default_rng(0)
+
+    def t(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.02
+
+    state = {
+        "model.embed_tokens.weight": t(cfg.vocab_size, cfg.dim),
+        "model.norm.weight": np.zeros(cfg.dim, np.float32),
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        for norm in ("input_layernorm", "post_attention_layernorm",
+                     "pre_feedforward_layernorm", "post_feedforward_layernorm"):
+            state[p + norm + ".weight"] = np.zeros(cfg.dim, np.float32)
+        state[p + "self_attn.q_proj.weight"] = t(cfg.n_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.k_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.v_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
+        state[p + "self_attn.o_proj.weight"] = t(cfg.dim, cfg.n_heads * cfg.head_dim)
+        state[p + "mlp.gate_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
+        state[p + "mlp.up_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
+        state[p + "mlp.down_proj.weight"] = t(cfg.dim, cfg.ffn_dim)
+
+    params = convert_hf("gemma2", state, cfg, jnp.float32)
+    ref = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    assert jax.tree.map(lambda x: x.shape, ref) == jax.tree.map(lambda x: x.shape, params)
+
+
+def test_convert_unknown_family():
+    with pytest.raises(KeyError):
+        convert_hf("mystery", {}, get_config("tiny"))

@@ -138,6 +138,28 @@ def test_udp_close_notifies_peer():
     run(main())
 
 
+def test_udp_idle_channel_stays_alive(monkeypatch):
+    """Keepalives must keep an idle-but-healthy channel open past the
+    dead-peer timeout (regression: keepalive was gated on last-HEARD and
+    never elicited a reply, so idle tunnels died every DEAD_TIMEOUT)."""
+    from p2p_llm_tunnel_tpu.transport import udp as udp_mod
+
+    monkeypatch.setattr(udp_mod, "KEEPALIVE_INTERVAL", 0.2)
+    monkeypatch.setattr(udp_mod, "DEAD_TIMEOUT", 1.0)
+
+    async def main():
+        a, b = await _udp_pair()
+        await asyncio.sleep(3.0)  # 3x the dead timeout, fully idle
+        assert not a.is_closed and not b.is_closed
+        # still functional after the idle period
+        await a.send(b"post-idle")
+        assert await asyncio.wait_for(b.recv(), 10) == b"post-idle"
+        a.close()
+        b.close()
+
+    run(main())
+
+
 def test_udp_punch_timeout():
     async def main():
         keys = HandshakeKeys()
