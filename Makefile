@@ -1,0 +1,32 @@
+# Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
+# equivalent available in this toolchain.
+
+.PHONY: native test test-unit test-local bench serve proxy signal multichip
+
+native:            ## build the C++ frame codec
+	scripts/build-native.sh
+
+test: test-unit test-local
+
+test-unit:         ## full pytest suite on the virtual CPU mesh
+	python -m pytest tests/ -q
+
+test-local:        ## hermetic 4-process end-to-end over real sockets
+	scripts/test-local.sh
+
+bench:             ## end-to-end tok/s + TTFT through the tunnel
+	python bench.py
+
+multichip:         ## harness dryrun: dp+tp train step on a virtual mesh
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python __graft_entry__.py
+
+signal:            ## run the rendezvous server
+	python -m p2p_llm_tunnel_tpu.cli signal --port 8787
+
+serve:             ## provider peer with the in-process TPU engine
+	python -m p2p_llm_tunnel_tpu.cli serve --signal ws://127.0.0.1:8787 \
+		--room $${TUNNEL_ROOM:-dev} --backend tpu --model tiny
+
+proxy:             ## consumer peer on 127.0.0.1:8000
+	python -m p2p_llm_tunnel_tpu.cli proxy --signal ws://127.0.0.1:8787 \
+		--room $${TUNNEL_ROOM:-dev}

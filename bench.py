@@ -13,8 +13,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 the reference itself publishes no numbers (SURVEY.md §6).
 
 Env knobs: BENCH_MODEL, BENCH_CLIENTS, BENCH_MAX_TOKENS, BENCH_SLOTS,
-BENCH_MAX_SEQ, BENCH_DTYPE, BENCH_DECODE_STEPS (decode burst size — the
-main tok/s lever; see EngineConfig.decode_steps).
+BENCH_MAX_SEQ, BENCH_DTYPE, BENCH_DECODE_STEPS (decode burst size),
+BENCH_QUANT (default int8 — weight-only quantization; "none" for bf16).
 """
 
 from __future__ import annotations
@@ -98,16 +98,17 @@ async def _run_bench() -> dict:
     max_seq = int(os.environ.get("BENCH_MAX_SEQ", "512"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
+    quant = os.environ.get("BENCH_QUANT", "int8")
 
     print(
         f"bench: model={model} clients={clients} max_tokens={max_tokens} "
-        f"slots={slots} decode_steps={decode_steps}",
+        f"slots={slots} decode_steps={decode_steps} quant={quant}",
         file=sys.stderr,
     )
     engine = InferenceEngine(
         engine_cfg=EngineConfig(
             model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
-            decode_steps=decode_steps,
+            decode_steps=decode_steps, quant=quant,
         )
     )
     await engine.start()
@@ -183,6 +184,7 @@ async def _run_bench() -> dict:
         "ttft_p50_ms": round(ttft_p50_ms, 1) if ttft_p50_ms is not None else None,
         "engine_ttft_p50_ms": round(engine_ttft_p50_ms, 1),
         "model": model,
+        "quant": quant,
         "clients": clients,
         "engine_tokens": engine_tokens,
         "visible_tokens": visible_tokens,

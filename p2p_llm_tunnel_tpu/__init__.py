@@ -5,12 +5,22 @@ capabilities (binary framing, signaling rendezvous, P2P data channel, serve/
 proxy endpoints) with the external HTTP LLM upstream replaced by an
 in-process JAX/XLA inference engine.
 
-Subpackages (implemented):
+Subpackages:
 - ``protocol``  — wire-compatible frame codec + HELLO/AGREE negotiation
-- ``transport`` — channel contract, loopback pair, network transports
+  (optional native C++ fast path, ``native/``)
+- ``signaling`` — WebSocket rendezvous server + typed client
+- ``transport`` — channel contract; loopback, encrypted TCP, hole-punched
+  reliable UDP; role-elected ``connect()``
 - ``endpoints`` — serve (provider) and proxy (consumer) + HTTP/1.1 runtime
+- ``engine``    — continuous-batching JAX engine, OpenAI/Ollama APIs,
+  DP replica router
+- ``models``    — Llama/Gemma transformers, checkpoints, int8 quant
+- ``ops``       — attention (XLA + Pallas flash), ring attention, rope, norms
+- ``parallel``  — device meshes, TP shardings, sharded train step
 - ``testing``   — mock LLM upstream fixture (SSE-paced)
 - ``utils``     — env-filtered logging, observability counters
+
+CLI: ``tunnel serve|proxy|signal`` (cli.py).
 """
 
 __version__ = "0.2.0"

@@ -51,7 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
                        help="signaling server URL (env TUNNEL_SIGNAL)")
         p.add_argument("--room", default=_env("TUNNEL_ROOM"),
                        help="rendezvous room name (env TUNNEL_ROOM)")
-        p.add_argument("--transport", choices=("udp", "tcp"), default="udp",
+        p.add_argument("--transport", choices=("udp", "tcp"),
+                       default=_env("TUNNEL_TRANSPORT", "udp"),
                        help="P2P data plane (default udp hole-punch)")
 
     serve = sub.add_parser("serve", help="provider peer: expose an LLM")
@@ -60,20 +61,34 @@ def build_parser() -> argparse.ArgumentParser:
                        help="upstream LLM base URL (env TUNNEL_UPSTREAM)")
     serve.add_argument("--advertise", default=_env("TUNNEL_ADVERTISE", "/"),
                        help="path prefix advertised to the peer (default /)")
-    serve.add_argument("--backend", choices=("http", "tpu"), default="http",
+    serve.add_argument("--backend", choices=("http", "tpu"),
+                       default=_env("TUNNEL_BACKEND", "http"),
                        help="http = forward to --upstream; tpu = in-process JAX engine")
     serve.add_argument("--model", default=_env("TUNNEL_MODEL", "tiny"),
                        help="model preset for --backend tpu")
-    serve.add_argument("--slots", type=int, default=8,
+    serve.add_argument("--slots", type=int,
+                       default=int(_env("TUNNEL_SLOTS", "8")),
                        help="continuous-batching slots (tpu backend)")
-    serve.add_argument("--max-seq", type=int, default=512,
+    serve.add_argument("--max-seq", type=int,
+                       default=int(_env("TUNNEL_MAX_SEQ", "512")),
                        help="max context length (tpu backend)")
-    serve.add_argument("--decode-steps", type=int, default=8,
+    serve.add_argument("--decode-steps", type=int,
+                       default=int(_env("TUNNEL_DECODE_STEPS", "8")),
                        help="decode steps per device call (tpu backend)")
-    serve.add_argument("--tp", type=int, default=1,
+    serve.add_argument("--tp", type=int, default=int(_env("TUNNEL_TP", "1")),
                        help="tensor-parallel degree over the device mesh")
     serve.add_argument("--ckpt", default=_env("TUNNEL_CKPT"),
                        help="orbax checkpoint path (default: random init)")
+    serve.add_argument("--quant", choices=("none", "int8"),
+                       default=_env("TUNNEL_QUANT", "none"),
+                       help="weight quantization (int8 halves HBM traffic)")
+    serve.add_argument("--tokenizer", default=_env("TUNNEL_TOKENIZER"),
+                       help="HF tokenizer path for real checkpoints "
+                            "(default: byte-level)")
+    serve.add_argument("--replicas", type=int,
+                       default=int(_env("TUNNEL_REPLICAS", "1")),
+                       help="data-parallel engine replicas behind a router, "
+                            "one per device round-robin")
 
     proxy = sub.add_parser("proxy", help="consumer peer: local HTTP port")
     common(proxy)
@@ -145,29 +160,66 @@ async def _serve_once(args) -> None:
         await signaling.close()
 
 
-_ENGINE = None
+_BACKEND = None
 
 
 async def _engine_backend(args):
-    """Start (once) the in-process engine and return its request handler."""
-    global _ENGINE
-    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    """Start (once) the in-process engine(s) and return the request handler.
+
+    The engine outlives individual tunnel sessions: reconnects re-use the
+    warm engine (weights + compiled programs) rather than re-initialising.
+    """
+    global _BACKEND
+    if _BACKEND is not None:
+        return _BACKEND
     from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 
-    if _ENGINE is None:
-        log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
-        _ENGINE = InferenceEngine(
-            engine_cfg=EngineConfig(
-                model=args.model,
-                num_slots=args.slots,
-                max_seq=args.max_seq,
-                decode_steps=args.decode_steps,
-                tp=args.tp,
-                ckpt_path=args.ckpt,
+    tokenizer = None
+    if args.tokenizer:
+        from p2p_llm_tunnel_tpu.engine.tokenizer import HFTokenizer
+
+        tokenizer = HFTokenizer(args.tokenizer)
+
+    import jax
+
+    devices = jax.devices()
+
+    def make_engine(seed: int) -> InferenceEngine:
+        # Replica i lives on device i (round-robin): its params/KV arrays
+        # are created committed there, so jit dispatch follows.
+        with jax.default_device(devices[seed % len(devices)]):
+            return InferenceEngine(
+                tokenizer=tokenizer,
+                engine_cfg=EngineConfig(
+                    model=args.model,
+                    num_slots=args.slots,
+                    max_seq=args.max_seq,
+                    decode_steps=args.decode_steps,
+                    tp=args.tp,
+                    ckpt_path=args.ckpt,
+                    quant=args.quant,
+                    seed=seed,
+                )
             )
+
+    if args.replicas > 1:
+        from p2p_llm_tunnel_tpu.engine.router import ReplicaRouter, router_backend
+
+        log.info("starting %d engine replicas: model=%s slots=%d",
+                 args.replicas, args.model, args.slots)
+        router = ReplicaRouter(
+            [make_engine(i) for i in range(args.replicas)], args.model
         )
-        await _ENGINE.start()
-    return engine_backend(_ENGINE, args.model)
+        await router.start()
+        _BACKEND = router_backend(router)
+    else:
+        from p2p_llm_tunnel_tpu.engine.api import engine_backend
+
+        log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
+        engine = make_engine(0)
+        await engine.start()
+        _BACKEND = engine_backend(engine, args.model)
+    return _BACKEND
 
 
 async def _proxy_once(args) -> None:

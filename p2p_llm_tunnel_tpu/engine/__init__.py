@@ -7,5 +7,7 @@ continuous batching, and OpenAI/Ollama-shaped streaming APIs.
 
 from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 from p2p_llm_tunnel_tpu.engine.api import engine_backend
+from p2p_llm_tunnel_tpu.engine.router import ReplicaRouter, router_backend
 
-__all__ = ["EngineConfig", "InferenceEngine", "engine_backend"]
+__all__ = ["EngineConfig", "InferenceEngine", "engine_backend",
+           "ReplicaRouter", "router_backend"]

@@ -216,6 +216,12 @@ class EngineAPI:
 
         if method == "GET" and path == "/health":
             return 200, {"content-type": "text/plain"}, _once(b"ok")
+        if method == "GET" and path == "/metrics":
+            # First-class counters (SURVEY.md §5: the reference greps logs;
+            # we expose tok/s, TTFT, queue depth, occupancy directly).
+            from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+            return _json_response(200, global_metrics.snapshot())
         if method == "GET" and path == "/v1/models":
             return _json_response(200, self._models_payload())
         if method == "GET" and path == "/api/tags":

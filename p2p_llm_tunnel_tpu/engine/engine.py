@@ -63,6 +63,9 @@ class EngineConfig:
     tp: int = 1
     # Optional orbax checkpoint to load instead of random init.
     ckpt_path: Optional[str] = None
+    # Weight quantization: "none" | "int8" (weight-only, per-channel).
+    # Halves decode HBM traffic and fits 8B-class models on a 16 GB chip.
+    quant: str = "none"
 
 
 @dataclass
@@ -108,20 +111,44 @@ class InferenceEngine:
                     lambda k: init_params(self.mcfg, k, dtype), key
                 )
                 params = load_checkpoint(self.ecfg.ckpt_path, like=like)
+            elif self.ecfg.quant == "int8":
+                # Random init directly in int8 on-device: the bf16 tree
+                # (2x a v5e's HBM for 8B) never exists anywhere.
+                from p2p_llm_tunnel_tpu.models.quant import init_params_quantized
+
+                log.info("initialising %s directly in int8", self.mcfg.name)
+                params = init_params_quantized(self.mcfg, key)
             else:
                 log.info("initialising random params for %s", self.mcfg.name)
                 params = init_params(self.mcfg, key, dtype)
+        if self.ecfg.quant == "int8":
+            from p2p_llm_tunnel_tpu.models.quant import QTensor, quantize_params
+
+            if self.ecfg.tp > 1 or mesh is not None:
+                # QTensor leaves need rank-aware PartitionSpecs; not wired yet.
+                raise NotImplementedError("int8 quantization with tp>1")
+            if not isinstance(params["blocks"]["wq"], QTensor):
+                # Loaded/injected bf16 weights: quantize once at startup.
+                log.info("quantizing weights to int8 (per-channel, weight-only)")
+                params = quantize_params(params)
+        elif self.ecfg.quant not in ("none", ""):
+            raise ValueError(f"unknown quant mode {self.ecfg.quant!r}")
         if mesh is None and self.ecfg.tp > 1:
             from p2p_llm_tunnel_tpu.parallel import make_mesh
 
             mesh = make_mesh(tp=self.ecfg.tp, dp=1)
         self.mesh = mesh
         if mesh is not None:
+            from dataclasses import replace as _replace
+
             from p2p_llm_tunnel_tpu.parallel.sharding import (
                 param_shardings as _pshard,
                 shard_params,
             )
 
+            # pallas_call is not auto-partitioned by GSPMD; use the einsum
+            # attention path when the model runs sharded.
+            self.mcfg = _replace(self.mcfg, flash=False)
             log.info("sharding params over mesh %s", dict(mesh.shape))
             params = shard_params(params, self.mcfg, mesh)
             param_shardings = _pshard(self.mcfg, mesh)
@@ -162,21 +189,38 @@ class InferenceEngine:
             max_workers=1, thread_name_prefix="engine-xla"
         )
 
-        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._jit_decode = jax.jit(self._decode_fn, donate_argnums=(1, 2, 3))
         self._jit_prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1,), static_argnums=()
         )
 
+        # Device-side decode carry (created lazily) + host override patch.
+        self._dev_tokens = None
+        self._dev_positions = None
+        self._ov_mask = np.zeros((rows,), bool)
+
     # -- XLA programs -----------------------------------------------------
 
-    def _decode_fn(self, params, kv_cache, tokens, positions, samp, key):
+    def _decode_fn(
+        self, params, kv_cache, tokens, positions, ov_mask, ov_tok, ov_pos,
+        samp, key,
+    ):
         """``decode_steps`` chained steps; sampled tokens feed back on-device.
 
-        Returns sampled tokens [B, k] — one device_get per k steps.  Slots
-        that finish mid-scan keep computing (their surplus tokens are
-        discarded by the host loop); cache writes past max_seq are dropped
-        by XLA scatter OOB semantics.
+        ``tokens``/``positions`` are the DEVICE-side carry from the previous
+        call — the host never needs to read them, which is what lets the
+        next burst dispatch while the previous burst's sampled block is
+        still in flight back to the host (~90 ms on the tunneled chip).
+        ``ov_*`` patch slots the host changed since (admissions): where
+        ov_mask is set, the carry is overridden before stepping.
+
+        Returns (sampled [B,k], tokens', positions', cache').  Slots that
+        finish mid-scan keep computing (their surplus tokens are discarded
+        by the host loop); cache writes past max_seq are dropped by XLA
+        scatter OOB semantics.
         """
+        tokens = jnp.where(ov_mask, ov_tok, tokens)
+        positions = jnp.where(ov_mask, ov_pos, positions)
 
         def one(carry, step_key):
             toks, pos, cache = carry
@@ -185,10 +229,10 @@ class InferenceEngine:
             return (sampled, pos + 1, cache), sampled
 
         keys = jax.random.split(key, self.ecfg.decode_steps)
-        (_, _, kv_cache), toks = jax.lax.scan(
+        (tokens, positions, kv_cache), toks = jax.lax.scan(
             one, (tokens, positions, kv_cache), keys
         )
-        return toks.T, kv_cache  # [B, k]
+        return toks.T, tokens, positions, kv_cache  # [B, k]
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
@@ -334,22 +378,43 @@ class InferenceEngine:
         global_metrics.inc("engine_prefill_tokens_total", total)
         return np.asarray(jax.device_get(first))[:n]
 
-    def _do_decode(self) -> np.ndarray:
-        """Blocking: ``decode_steps`` steps over all slots; returns [B, k]."""
+    def _dispatch_decode(self):
+        """Non-blocking: dispatch one k-step burst; returns (sampled_device,
+        per-row request-id snapshot).
+
+        The carry (tokens/positions) stays on device between calls, so this
+        returns in ~1 ms while the previous burst's sampled block is still
+        in flight to the host — the pipelining that hides the ~90 ms
+        device_get RTT of the tunneled-TPU path.
+        """
+        rows = self.ecfg.num_slots + 1
+        if self._dev_tokens is None:
+            self._dev_tokens = jnp.zeros((rows,), jnp.int32)
+            self._dev_positions = jnp.zeros((rows,), jnp.int32)
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(self._temp),
             top_k=jnp.asarray(self._top_k),
             top_p=jnp.asarray(self._top_p),
         )
-        sampled, self.kv_cache = self._jit_decode(
-            self.params,
-            self.kv_cache,
-            jnp.asarray(self._last_token),
-            jnp.asarray(self._positions),
-            samp,
-            self._next_key(),
+        sampled, self._dev_tokens, self._dev_positions, self.kv_cache = (
+            self._jit_decode(
+                self.params,
+                self.kv_cache,
+                self._dev_tokens,
+                self._dev_positions,
+                jnp.asarray(self._ov_mask),
+                jnp.asarray(self._last_token),
+                jnp.asarray(self._positions),
+                samp,
+                self._next_key(),
+            )
         )
-        return np.asarray(jax.device_get(sampled))
+        self._ov_mask[:] = False  # patch consumed by this dispatch
+        assign = [
+            run.request.request_id if run is not None else None
+            for run in self.scheduler.slots
+        ] + [None]  # scratch row
+        return sampled, assign
 
     def _admit_one(self, run: RunningSlot) -> None:
         """Set up host slot state after prefill admission."""
@@ -360,6 +425,9 @@ class InferenceEngine:
         self._temp[i] = req.temperature
         self._top_k[i] = req.top_k
         self._top_p[i] = req.top_p
+        # The device-side carry knows nothing about this slot yet; patch it
+        # in at the next dispatch.
+        self._ov_mask[i] = True
 
     def _account_token(self, slot: int, tok: int) -> None:
         """Record one generated token: scheduler accounting, slot-state
@@ -375,14 +443,63 @@ class InferenceEngine:
             self._positions[slot] = out.cache_len - 1
         self._emit(out, tok, evicted)
 
+    async def _admit_pending(self, loop) -> None:
+        """Batched prefill: one XLA call per prompt-length bucket, so
+        concurrent arrivals share one device round trip."""
+        admitted = self.scheduler.admit()
+        if not admitted:
+            return
+        groups: Dict[int, List[RunningSlot]] = {}
+        for run in admitted:
+            t = self._bucket(len(run.request.prompt_ids))
+            groups.setdefault(t, []).append(run)
+        chunked: List[Tuple[int, List[RunningSlot]]] = []
+        pr = self.ecfg.prefill_rows
+        for t, runs in sorted(groups.items()):
+            for i in range(0, len(runs), pr):
+                chunked.append((t, runs[i : i + pr]))
+        for t, runs in chunked:
+            firsts = await loop.run_in_executor(
+                self._executor, self._do_prefill_batch, runs, t
+            )
+            for run, first in zip(runs, firsts):
+                if self.scheduler.slots[run.slot] is not run:
+                    # Consumer cancelled while the prefill was in flight;
+                    # the slot is already free — drop it.
+                    continue
+                self._admit_one(run)
+                self._account_token(run.slot, int(first))
+
+    async def _process_burst(self, sampled: np.ndarray, assign: List) -> None:
+        """Account one fetched token block [R, k] against current occupants.
+
+        ``assign`` snapshots which request held each row at dispatch time:
+        rows that were freed or re-admitted since (pipelining lag) carry
+        junk tokens for the *old* occupant and are skipped.
+        """
+        for col in range(sampled.shape[1]):
+            for i in np.nonzero(self._active_mask)[0]:
+                run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
+                if run is None:  # cancelled/evicted since dispatch
+                    self._active_mask[i] = False
+                    continue
+                if run.request.request_id != assign[i]:
+                    continue  # re-admitted: its tokens come from the next burst
+                self._account_token(int(i), int(sampled[i, col]))
+            # Yield so this column's tokens flush to consumers before the
+            # next (keeps SSE pacing smooth within a burst).
+            await asyncio.sleep(0)
+
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         log.info(
-            "engine loop started: model=%s slots=%d max_seq=%d",
+            "engine loop started: model=%s slots=%d max_seq=%d decode_steps=%d",
             self.mcfg.name, self.ecfg.num_slots, self.ecfg.max_seq,
+            self.ecfg.decode_steps,
         )
+        in_flight = None  # (sampled device array, request-id snapshot)
         while self._running:
-            if self.scheduler.idle:
+            if self.scheduler.idle and in_flight is None:
                 self._wake.clear()
                 try:
                     await asyncio.wait_for(self._wake.wait(), timeout=0.5)
@@ -390,45 +507,22 @@ class InferenceEngine:
                     continue
                 continue
 
-            # Admission: batched prefill, one XLA call per prompt-length
-            # bucket, so concurrent arrivals share one device round trip.
-            admitted = self.scheduler.admit()
-            if admitted:
-                groups: Dict[int, List[RunningSlot]] = {}
-                for run in admitted:
-                    t = self._bucket(len(run.request.prompt_ids))
-                    groups.setdefault(t, []).append(run)
-                chunked: List[Tuple[int, List[RunningSlot]]] = []
-                pr = self.ecfg.prefill_rows
-                for t, runs in sorted(groups.items()):
-                    for i in range(0, len(runs), pr):
-                        chunked.append((t, runs[i : i + pr]))
-                for t, runs in chunked:
-                    firsts = await loop.run_in_executor(
-                        self._executor, self._do_prefill_batch, runs, t
-                    )
-                    for run, first in zip(runs, firsts):
-                        if self.scheduler.slots[run.slot] is not run:
-                            # Consumer cancelled while the prefill was in
-                            # flight; the slot is already free — drop it.
-                            continue
-                        self._admit_one(run)
-                        self._account_token(run.slot, int(first))
+            await self._admit_pending(loop)
 
             global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
             global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
 
-            if not any(self._active_mask):
-                continue
-
-            sampled = await loop.run_in_executor(self._executor, self._do_decode)
-            for col in range(sampled.shape[1]):
-                for i in np.nonzero(self._active_mask)[0]:
-                    if self.scheduler.slots[i] is None:  # cancelled between steps
-                        self._active_mask[i] = False
-                        continue
-                    self._account_token(int(i), int(sampled[i, col]))
-                # Yield so this column's tokens flush to consumers before the
-                # next burst (keeps SSE pacing smooth within a multi-step).
-                await asyncio.sleep(0)
+            # Pipeline: dispatch burst n (returns immediately; carry stays
+            # on device), THEN fetch+process burst n-1 — the ~90 ms RTT of
+            # the fetch overlaps with burst n computing.
+            current = (
+                self._dispatch_decode() if any(self._active_mask) else None
+            )
+            if in_flight is not None:
+                sampled_dev, assign = in_flight
+                sampled = await loop.run_in_executor(
+                    self._executor, lambda: np.asarray(jax.device_get(sampled_dev))
+                )
+                await self._process_burst(sampled, assign)
+            in_flight = current
         log.info("engine loop stopped")

@@ -37,6 +37,10 @@ class ModelConfig:
     embed_scale: bool = False  # multiply embeddings by sqrt(dim)
     # attention score scale; None → 1/sqrt(head_dim)
     query_scale: Optional[float] = None
+    # Use the Pallas flash kernel for prefill attention when the backend is
+    # TPU and shapes tile (T%128==0, head_dim%128==0).  Engines disable it
+    # for sharded meshes (GSPMD does not auto-partition pallas_call).
+    flash: bool = True
 
     @property
     def q_per_kv(self) -> int:

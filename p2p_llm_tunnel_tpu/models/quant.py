@@ -1,0 +1,150 @@
+"""Int8 weight-only quantization: halve HBM traffic, fit 8B on one v5e.
+
+Decode is HBM-bandwidth-bound (every step streams all weights once), so
+weight-only int8 is a ~2x decode-throughput lever and the difference between
+Llama-3-8B fitting a 16 GB v5e chip (8 GB int8) or not (16 GB bf16).
+
+Scheme: symmetric per-output-channel.  Each matmul weight W[in, out] stores
+``q`` (int8) + ``scale`` (f32 [out]); the dequant multiply runs AFTER the
+matmul (y = (x @ q) * scale), so XLA reads int8 from HBM and fuses the
+int8→bf16 convert into the dot's operand load.  The embedding keeps
+per-row scales, which serve both the gather (x = q[ids] * scale[ids]) and
+the tied logits head (logits = (x @ q.T) * scale).
+
+Net-new vs the reference (no ML code there at all, SURVEY.md §2); sized by
+BASELINE.md's "Llama-3 8B on v5e-1" config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QTensor:
+    """Int8 weight + per-output-channel scale; a pytree leaf pair."""
+
+    q: jnp.ndarray  # int8, same shape as the original weight
+    scale: jnp.ndarray  # f32, original shape with the contracted axis dropped
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def _quantize(w: jnp.ndarray, axis: int) -> QTensor:
+    """Symmetric int8 over ``axis`` (the contracted/input axis)."""
+    a = jnp.abs(w.astype(jnp.float32)).max(axis=axis, keepdims=True)
+    scale = jnp.maximum(a, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale.astype(jnp.float32).squeeze(axis))
+
+
+def mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for plain arrays or QTensors (dequant after the dot)."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(embed, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Row gather for a plain or quantized embedding table."""
+    if isinstance(embed, QTensor):
+        rows = embed.q[tokens].astype(dtype)
+        return rows * embed.scale[tokens][..., None].astype(dtype)
+    return embed[tokens]
+
+
+def head_matmul(x: jnp.ndarray, embed) -> jnp.ndarray:
+    """Tied-head logits: x @ embed.T with per-vocab-row dequant after."""
+    if isinstance(embed, QTensor):
+        logits = x @ embed.q.T.astype(x.dtype)
+        return logits * embed.scale[None, :].astype(x.dtype)
+    return x @ embed.T.astype(x.dtype)
+
+
+def init_params_quantized(cfg, key: jax.Array) -> Params:
+    """Random-init directly in int8 on-device.
+
+    For benchmarks/tests of big models: the bf16 tree (2x the chip's HBM
+    for 8B on v5e) never exists anywhere — int8 leaves are generated
+    straight on the accelerator.  Checkpoint loads use quantize_params.
+    """
+    import jax.numpy as jnp
+
+    l, dm, h, kh, hd, f, v = (
+        cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, cfg.ffn_dim, cfg.vocab_size,
+    )
+    keys = jax.random.split(key, 8)
+
+    def qdense(k, shape, fan_in, scale_shape):
+        q = jax.random.randint(k, shape, -127, 128, jnp.int8)
+        # scale ≈ (fan_in^-0.5)/127 reproduces the bf16 init's magnitude
+        scale = jnp.full(scale_shape, (fan_in**-0.5) / 127.0, jnp.float32)
+        return QTensor(q=q, scale=scale)
+
+    dtype = jnp.bfloat16
+    blocks = {
+        "attn_norm": jnp.zeros((l, dm), dtype) if cfg.post_norms else jnp.ones((l, dm), dtype),
+        "mlp_norm": jnp.zeros((l, dm), dtype) if cfg.post_norms else jnp.ones((l, dm), dtype),
+        "wq": qdense(keys[0], (l, dm, h * hd), dm, (l, h * hd)),
+        "wk": qdense(keys[1], (l, dm, kh * hd), dm, (l, kh * hd)),
+        "wv": qdense(keys[2], (l, dm, kh * hd), dm, (l, kh * hd)),
+        "wo": qdense(keys[3], (l, h * hd, dm), h * hd, (l, dm)),
+        "w_gate": qdense(keys[4], (l, dm, f), dm, (l, f)),
+        "w_up": qdense(keys[5], (l, dm, f), dm, (l, f)),
+        "w_down": qdense(keys[6], (l, f, dm), f, (l, dm)),
+    }
+    if cfg.post_norms:
+        blocks["post_attn_norm"] = jnp.zeros((l, dm), dtype)
+        blocks["post_mlp_norm"] = jnp.zeros((l, dm), dtype)
+    params: Params = {
+        "embed": qdense(keys[7], (v, dm), dm, (v,)),  # per-row: gather + tied head
+        "blocks": blocks,
+        "final_norm": jnp.zeros((dm,), dtype) if cfg.post_norms else jnp.ones((dm,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = qdense(jax.random.fold_in(key, 99), (dm, v), dm, (v,))
+    return params
+
+
+def quantize_params(params: Params, cfg=None) -> Params:
+    """Quantize every matmul weight; norms stay in their original dtype.
+
+    Block weights are stacked [L, in, out]: the contracted axis is 1, so
+    scales are per (layer, out-channel).  The embedding quantizes per row
+    (axis=1 over dim), serving gather and tied head alike.
+    """
+    del cfg
+    blocks = params["blocks"]
+    qblocks = dict(blocks)
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        qblocks[name] = _quantize(blocks[name], axis=1)
+    out: Params = {
+        "embed": _quantize(params["embed"], axis=1),
+        "blocks": qblocks,
+        "final_norm": params["final_norm"],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = _quantize(params["lm_head"], axis=0)
+    return out

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from p2p_llm_tunnel_tpu.models.config import ModelConfig
+from p2p_llm_tunnel_tpu.models.quant import embed_lookup, head_matmul, mm
 from p2p_llm_tunnel_tpu.ops.attention import cached_attention, causal_attention
 from p2p_llm_tunnel_tpu.ops.norms import rms_norm
 from p2p_llm_tunnel_tpu.ops.rope import apply_rope
@@ -96,15 +97,15 @@ def _act(cfg: ModelConfig, x):
 
 
 def _mlp(cfg: ModelConfig, blk, h):
-    gate = _act(cfg, h @ blk["w_gate"]) * (h @ blk["w_up"])
-    return gate @ blk["w_down"]
+    gate = _act(cfg, mm(h, blk["w_gate"])) * mm(h, blk["w_up"])
+    return mm(gate, blk["w_down"])
 
 
 def _qkv(cfg: ModelConfig, blk, h, positions):
     b, t, _ = h.shape
-    q = (h @ blk["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = (h @ blk["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ blk["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = mm(h, blk["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = mm(h, blk["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = mm(h, blk["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
@@ -120,15 +121,21 @@ def _layer_window(cfg: ModelConfig, layer_idx, seq_len):
 
 
 def _embed(cfg: ModelConfig, params, tokens):
-    x = params["embed"][tokens]
+    embed = params["embed"]
+    dtype = embed.q.dtype if hasattr(embed, "q") else embed.dtype
+    if dtype == jnp.int8:
+        dtype = jnp.bfloat16
+    x = embed_lookup(embed, tokens, dtype)
     if cfg.embed_scale:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
     return x
 
 
 def _logits(cfg: ModelConfig, params, x):
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        logits = head_matmul(x, params["embed"]).astype(jnp.float32)
+    else:
+        logits = mm(x, params["lm_head"]).astype(jnp.float32)
     if cfg.logit_softcap is not None:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
     return logits
@@ -150,17 +157,37 @@ def prefill(
     x = _embed(cfg, params, tokens)
     layer_idx = jnp.arange(cfg.n_layers)
 
+    use_flash = (
+        cfg.flash
+        and jax.default_backend() == "tpu"
+        and t % 128 == 0
+        and cfg.head_dim % 128 == 0
+    )
+
     def step(x, xs):
         blk, idx = xs
         h = _norm(cfg, x, blk["attn_norm"])
         q, k, v = _qkv(cfg, blk, h, positions)
-        attn = causal_attention(
-            q, k, v, valid,
-            scale=cfg.query_scale,
-            softcap=cfg.attn_softcap,
-            window=_layer_window(cfg, idx, t),
-        )
-        attn = attn.reshape(b, t, -1) @ blk["wo"]
+        if use_flash:
+            from p2p_llm_tunnel_tpu.ops.pallas_attention import (
+                flash_causal_attention,
+            )
+
+            window = _layer_window(cfg, idx, t)
+            attn = flash_causal_attention(
+                q, k, v, valid,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=window,
+            )
+        else:
+            attn = causal_attention(
+                q, k, v, valid,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=_layer_window(cfg, idx, t),
+            )
+        attn = mm(attn.reshape(b, t, -1), blk["wo"])
         if cfg.post_norms:
             attn = _norm(cfg, attn, blk["post_attn_norm"])
         x = x + attn
@@ -242,7 +269,7 @@ def decode_step(
             softcap=cfg.attn_softcap,
             window=_layer_window(cfg, idx, s),
         )
-        attn = attn.reshape(b, 1, -1) @ blk["wo"]
+        attn = mm(attn.reshape(b, 1, -1), blk["wo"])
         if cfg.post_norms:
             attn = _norm(cfg, attn, blk["post_attn_norm"])
         x = x + attn

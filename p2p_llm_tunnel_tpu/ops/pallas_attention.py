@@ -1,0 +1,165 @@
+"""Pallas flash-attention kernel for TPU prefill.
+
+Blocked causal attention that never materialises the [T, T] score matrix:
+each grid program owns one (batch, head, Q-block) and streams K/V blocks
+through VMEM with the online-softmax update
+
+    m' = max(m, rowmax(s));  p = exp(s - m')
+    acc = acc * exp(m - m') + p @ V;  l = l * exp(m - m') + rowsum(p)
+
+stopping at the causal frontier (K blocks entirely in the future are never
+read — half the FLOPs and HBM traffic of the dense path).  GQA maps query
+head h to KV head h // (H/K) in the BlockSpec index maps, so no KV
+duplication ever hits VMEM.
+
+This is the prefill hot path (ops/attention.py's einsum path remains the
+numerics oracle and the CPU/decode fallback).  Kernel playbook per
+/opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _flash_kernel(
+    win_ref,  # SMEM (1,1) int32: sliding window (T+1 = disabled)
+    q_ref,  # [BLOCK_Q, D]
+    k_ref,  # [T, D] this (batch, kv-head)'s full keys
+    v_ref,  # [T, D]
+    valid_ref,  # [1, T] int32 (1 = real token; batch dim squeezed)
+    o_ref,  # [BLOCK_Q, D]
+    *,
+    scale: float,
+    softcap: Optional[float],
+    seq_len: int,
+    out_dtype,
+):
+    qi = pl.program_id(2)
+    d = q_ref.shape[-1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, 1), 0)
+    window = win_ref[0, 0]
+
+    m0 = jnp.full((BLOCK_Q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q, 1), jnp.float32)
+    acc0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        k_pos = j * BLOCK_K + jax.lax.broadcasted_iota(
+            jnp.int32, (1, BLOCK_K), 1
+        )
+        ok = valid_ref[0, pl.ds(j * BLOCK_K, BLOCK_K)][None, :] > 0
+        mask = (k_pos <= q_pos) & ((q_pos - k_pos) < window) & ok
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        # rows that have seen nothing stay at -inf; avoid exp(-inf - -inf)
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s == _NEG_INF, 0.0, p)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        l = l * corr + p.sum(axis=-1, keepdims=True)
+        return m_new, l, acc
+
+    # causal frontier: K block j can matter only while j*BK <= last q_pos
+    n_blocks = jnp.minimum(qi + 1, pl.cdiv(seq_len, BLOCK_K))
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(out_dtype)
+
+
+def flash_causal_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, K, D]
+    v: jnp.ndarray,  # [B, T, K, D]
+    valid: jnp.ndarray,  # [B, T] bool
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window=None,  # None | int | traced int scalar
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash prefill attention; drop-in for ops.attention.causal_attention.
+
+    Requires T % 128 == 0 (use the einsum path otherwise — the model layer
+    picks).  ``window`` may be a traced scalar (gemma-2 alternates windows
+    across scanned layers), delivered to the kernel through SMEM.
+    """
+    b, t, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    if scale is None:
+        scale = d**-0.5
+    if t % BLOCK_Q != 0:
+        raise ValueError(f"flash attention needs T % {BLOCK_Q} == 0, got {t}")
+
+    win = jnp.asarray(
+        t + 1 if window is None else window, jnp.int32
+    ).reshape(1, 1)
+    valid_i = valid.astype(jnp.int32)[:, None, :]  # [B, 1, T] (tileable)
+
+    # Head-major layouts so every block's trailing dims are (seq, head_dim)
+    # — the (8, 128)-tileable pair Pallas requires.
+    q_hm = q.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    k_hm = k.transpose(0, 2, 1, 3)  # [B, K, T, D]
+    v_hm = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        softcap=softcap,
+        seq_len=t,
+        out_dtype=q.dtype,
+    )
+    grid = (b, h, t // BLOCK_Q)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda bi, hi, qi: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec(
+                    (None, None, BLOCK_Q, d),
+                    lambda bi, hi, qi: (bi, hi, qi, 0),
+                ),
+                pl.BlockSpec(
+                    (None, None, t, d),
+                    lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (None, None, t, d),
+                    lambda bi, hi, qi, g=g: (bi, hi // g, 0, 0),
+                ),
+                pl.BlockSpec((None, 1, t), lambda bi, hi, qi: (bi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (None, None, BLOCK_Q, d),
+                lambda bi, hi, qi: (bi, hi, qi, 0),
+            ),
+        ),
+        interpret=interpret,
+    )(win, q_hm, k_hm, v_hm, valid_i)
+    return out.transpose(0, 2, 1, 3)  # [B, T, H, D]

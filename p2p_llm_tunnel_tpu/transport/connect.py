@@ -145,6 +145,7 @@ async def _establish(
     channel: Optional[UdpChannel] = None
     server: Optional[asyncio.AbstractServer] = None
     accepted: "Optional[asyncio.Future]" = None
+    handed_off = False  # set once a channel is returned to the caller
 
     # Any exit before the channel is handed to the caller — signaling
     # failure, mismatch, punch timeout, or cancellation from the outer
@@ -221,6 +222,7 @@ async def _establish(
                 reader, writer = await asyncio.wait_for(accepted, PUNCH_TIMEOUT)
             except asyncio.TimeoutError:
                 raise ConnectError("tcp peer never dialed")
+            handed_off = True
             return TcpChannel(reader, writer, box)
         last_err: Optional[Exception] = None
         for host, port in remote_cands:
@@ -228,6 +230,7 @@ async def _establish(
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(str(host), int(port)), 3.0
                 )
+                handed_off = True
                 return TcpChannel(reader, writer, box)
             except (OSError, asyncio.TimeoutError) as e:
                 last_err = e
@@ -239,6 +242,12 @@ async def _establish(
             # close() stops the listener; do NOT wait_closed() — on 3.12 it
             # blocks until accepted connections (the live tunnel!) close.
             server.close()
+        if (not handed_off and accepted is not None and accepted.done()
+                and not accepted.cancelled() and accepted.exception() is None):
+            # The peer dialed but establishment failed afterwards — release
+            # the accepted socket or infinite retries leak one fd each.
+            _, w = accepted.result()
+            w.close()
 
 
 async def _accept_trickle(

@@ -1,0 +1,53 @@
+"""Flash attention kernel vs the einsum oracle (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.ops.attention import causal_attention
+from p2p_llm_tunnel_tpu.ops.pallas_attention import flash_causal_attention
+
+
+def _qkv(key, b, t, h, kh, d):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), jnp.float32),
+        jax.random.normal(kk, (b, t, kh, d), jnp.float32),
+        jax.random.normal(kv, (b, t, kh, d), jnp.float32),
+    )
+
+
+def test_flash_matches_dense(cpu_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(0), b=2, t=256, h=4, kh=2, d=64)
+    valid = jnp.ones((2, 256), bool)
+    want = causal_attention(q, k, v, valid)
+    got = flash_causal_attention(q, k, v, valid, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_respects_padding(cpu_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=1, t=128, h=2, kh=2, d=32)
+    valid = jnp.arange(128)[None, :] < 70  # padded prompt
+    want = causal_attention(q, k, v, valid)
+    got = flash_causal_attention(q, k, v, valid, interpret=True)
+    # only the real positions matter; padded queries attend garbage either way
+    np.testing.assert_allclose(
+        np.asarray(got)[:, :70], np.asarray(want)[:, :70], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_softcap_and_window(cpu_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=1, t=256, h=2, kh=1, d=32)
+    valid = jnp.ones((1, 256), bool)
+    want = causal_attention(q, k, v, valid, softcap=30.0, window=64)
+    got = flash_causal_attention(
+        q, k, v, valid, softcap=30.0, window=64, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_rejects_ragged_t(cpu_devices):
+    q, k, v = _qkv(jax.random.PRNGKey(3), b=1, t=100, h=2, kh=2, d=32)
+    with pytest.raises(ValueError):
+        flash_causal_attention(q, k, v, jnp.ones((1, 100), bool), interpret=True)

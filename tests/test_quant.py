@@ -1,0 +1,87 @@
+"""Int8 weight-only quantization: structure, accuracy, engine integration."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.quant import QTensor, mm, quantize_params
+from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+
+
+def test_qtensor_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    from p2p_llm_tunnel_tpu.models.quant import _quantize
+
+    qt = _quantize(w, axis=0)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (128,)
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale)[None, :]
+    err = np.abs(deq - np.asarray(w)).max()
+    # max error per channel is scale/2 = absmax/254
+    assert err <= np.abs(np.asarray(w)).max() / 127
+
+
+def test_mm_matches_dequant():
+    w = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 32), jnp.float32)
+    from p2p_llm_tunnel_tpu.models.quant import _quantize
+
+    qt = _quantize(w, axis=0)
+    got = np.asarray(mm(x, qt))
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale)[None, :]
+    want = np.asarray(x) @ deq
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def _logit_agreement(cfg, params, qparams):
+    tokens = jnp.arange(24)[None, :] % cfg.vocab_size
+    valid = jnp.ones_like(tokens, bool)
+    ref, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(params)
+    got, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(qparams)
+    return np.asarray(ref), np.asarray(got)
+
+
+def test_quantized_forward_tracks_fp32_llama(cpu_devices):
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params)
+    ref, got = _logit_agreement(cfg, params, qparams)
+    # int8 weight-only should keep argmax mostly identical on random weights
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.9, f"argmax agreement too low: {agree}"
+    # and logits numerically close in an absolute sense
+    assert np.abs(ref - got).mean() < 0.05
+
+
+def test_quantized_forward_tracks_fp32_gemma(cpu_devices):
+    cfg = get_config("tiny-gemma")
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    qparams = quantize_params(params)
+    ref, got = _logit_agreement(cfg, params, qparams)
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.9, f"argmax agreement too low: {agree}"
+
+
+def test_engine_with_int8(cpu_devices):
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2, quant="int8")
+    )
+    assert isinstance(eng.params["blocks"]["wq"], QTensor)
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"quantized"), max_new_tokens=6,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 6

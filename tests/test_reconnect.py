@@ -1,0 +1,106 @@
+"""Failure/recovery: the supervisor reconnects peers after a transport kill.
+
+Exercises SURVEY.md §3.5 — transport dies → endpoints raise → run_with_retry
+re-runs connect() → fresh channel, fresh handshake — which even the
+reference only covers manually (its scripts never fault-inject).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from p2p_llm_tunnel_tpu import cli
+from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+from p2p_llm_tunnel_tpu.signaling import SignalServer
+from p2p_llm_tunnel_tpu.transport import connect
+
+
+def test_tunnel_reconnects_after_channel_kill(monkeypatch):
+    # shrink backoff so the test is fast (formula still 2*2^(n-1), capped)
+    monkeypatch.setattr(cli, "INITIAL_BACKOFF", 0.1)
+    monkeypatch.setattr(cli, "MAX_BACKOFF", 0.5)
+
+    async def main():
+        server = SignalServer(port=0)
+        sig_port = await server.start()
+        url = f"ws://127.0.0.1:{sig_port}"
+        room = "reconnect-test"
+
+        live = {}  # current serve-side channel, so the test can kill it
+        proxy_port = {}
+
+        async def upstream(req, body):
+            async def chunks():
+                yield b"pong"
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        async def serve_once():
+            ch, sig = await connect(url, room, "udp")
+            live["serve"] = ch
+            try:
+                await run_serve(ch, backend=upstream)
+            finally:
+                ch.close()
+                await sig.close()
+
+        async def proxy_once():
+            ch, sig = await connect(url, room, "udp")
+            try:
+                ready = asyncio.get_running_loop().create_future()
+                task = asyncio.ensure_future(run_proxy(ch, "127.0.0.1", 0, ready=ready))
+                proxy_port["port"] = await ready
+                proxy_port["event"] = True
+                await task
+            finally:
+                ch.close()
+                await sig.close()
+
+        serve_task = asyncio.ensure_future(
+            cli.run_with_retry("serve", serve_once)
+        )
+        proxy_task = asyncio.ensure_future(
+            cli.run_with_retry("proxy", proxy_once)
+        )
+
+        async def wait_ok(timeout=20.0):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                try:
+                    r = await http_request(
+                        "GET", f"http://127.0.0.1:{proxy_port['port']}/x",
+                        timeout=2.0,
+                    )
+                    if r.status == 200 and await r.read_all() == b"pong":
+                        return
+                except Exception:
+                    pass
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("tunnel never became usable")
+                await asyncio.sleep(0.3)
+
+        try:
+            # phase 1: up
+            while "port" not in proxy_port:
+                await asyncio.sleep(0.1)
+            await wait_ok()
+
+            # phase 2: kill the serve-side channel (transport failure)
+            live["serve"].close()
+
+            # phase 3: both supervisors reconnect; tunnel usable again.
+            # (the proxy may rebind a new port on reconnect)
+            await asyncio.sleep(1.0)
+            await wait_ok()
+        finally:
+            serve_task.cancel()
+            proxy_task.cancel()
+            for t in (serve_task, proxy_task):
+                with pytest.raises((asyncio.CancelledError, Exception)):
+                    await t
+            await server.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 60))
