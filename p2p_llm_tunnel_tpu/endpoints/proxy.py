@@ -29,6 +29,7 @@ from p2p_llm_tunnel_tpu.endpoints.http11 import (
     start_http_server,
 )
 from p2p_llm_tunnel_tpu.protocol.frames import (
+    CREDIT_BATCH,
     Agree,
     Hello,
     MessageType,
@@ -79,6 +80,7 @@ class ProxyState:
     def __init__(self, channel: Channel):
         self.channel = channel
         self.tunnel_ready = False
+        self.flow_enabled = False  # set from the AGREE feature list
         self._next_stream_id = 1
         self.pending: Dict[int, asyncio.Queue[_StreamEvent]] = {}
 
@@ -210,6 +212,7 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
 
     async def body_stream() -> AsyncIterator[bytes]:
         first = True
+        ungranted = 0  # bytes relayed since the last FLOW grant
         try:
             while True:
                 event = await events.get()
@@ -221,6 +224,19 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                         first = False
                     global_metrics.inc("proxy_body_bytes_total", len(event.data))
                     yield event.data
+                    # The chunk reached the HTTP client (yield resumes after
+                    # the writer drains) — replenish the serve side's credit
+                    # in CREDIT_BATCH steps.
+                    if state.flow_enabled:
+                        ungranted += len(event.data)
+                        if ungranted >= CREDIT_BATCH:
+                            try:
+                                await channel.send(
+                                    TunnelMessage.flow(stream_id, ungranted).encode()
+                                )
+                                ungranted = 0
+                            except ChannelClosed:
+                                return
                 elif isinstance(event, (_End, _Error)):
                     # ERROR mid-stream truncates the body silently
                     # (proxy.rs:408-412) — HTTP status already went out.
@@ -269,6 +285,7 @@ async def run_proxy(
         raise RuntimeError(f"expected AGREE, got {agree_msg.msg_type.name}")
     agree = Agree.from_json(agree_msg.payload)
     log.info("received AGREE: version=%d features=%s", agree.version, agree.features)
+    state.flow_enabled = "flow" in agree.features
     state.tunnel_ready = True
 
     async def keepalive() -> None:

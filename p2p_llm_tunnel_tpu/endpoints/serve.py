@@ -22,6 +22,7 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from p2p_llm_tunnel_tpu.endpoints import http11
 from p2p_llm_tunnel_tpu.protocol.frames import (
+    INITIAL_CREDIT,
     Agree,
     Hello,
     MessageType,
@@ -48,6 +49,50 @@ Backend = Callable[
 ]
 
 _HOP_BY_HOP = {"host", "connection", "transfer-encoding"}
+
+
+class FlowControl:
+    """Per-stream response-body credit (the negotiated "flow" feature).
+
+    The serve side starts each stream with INITIAL_CREDIT bytes and blocks
+    body emission when exhausted; the proxy replenishes with FLOW frames as
+    its HTTP client consumes.  Bounds serve→proxy buffering — the
+    backpressure the reference lacks entirely (SURVEY.md §7 hard-part #3:
+    a TPU engine at 1800+ tok/s into a slow WAN client would otherwise
+    buffer without limit).  Disabled (no-op) unless both peers negotiated
+    the feature.
+    """
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self._streams: Dict[int, list] = {}  # sid → [credit, wake-event]
+
+    def open(self, stream_id: int) -> None:
+        if self.enabled:
+            self._streams[stream_id] = [INITIAL_CREDIT, asyncio.Event()]
+
+    def close(self, stream_id: int) -> None:
+        entry = self._streams.pop(stream_id, None)
+        if entry is not None:
+            entry[1].set()  # release any blocked sender
+
+    def grant(self, stream_id: int, credit: int) -> None:
+        entry = self._streams.get(stream_id)
+        if entry is not None:
+            entry[0] += credit
+            entry[1].set()
+
+    async def consume(self, stream_id: int, n: int) -> None:
+        """Debit ``n`` bytes, waiting while the stream is out of credit."""
+        if not self.enabled:
+            return
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            return
+        while entry[0] <= 0 and stream_id in self._streams:
+            entry[1].clear()
+            await entry[1].wait()
+        entry[0] -= n
 
 
 def build_upstream_url(upstream_base: str, advertise_prefix: str, request_path: str) -> str:
@@ -80,17 +125,22 @@ def http_backend(upstream_url: str, advertise_prefix: str = "/") -> Backend:
 
 
 async def _handle_request(
-    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes
+    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
+    flow: FlowControl,
 ) -> None:
     try:
-        await _handle_request_inner(channel, backend, req, body)
+        flow.open(req.stream_id)
+        await _handle_request_inner(channel, backend, req, body, flow)
     except ChannelClosed:
         # Tunnel died while responding; the serve loop notices separately.
         log.debug("channel closed while responding to stream %d", req.stream_id)
+    finally:
+        flow.close(req.stream_id)
 
 
 async def _handle_request_inner(
-    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes
+    channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
+    flow: FlowControl,
 ) -> None:
     stream_id = req.stream_id
     global_metrics.inc("serve_requests_total")
@@ -115,6 +165,7 @@ async def _handle_request_inner(
     )
     try:
         async for chunk in chunks:
+            await flow.consume(stream_id, len(chunk))
             for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
                 await channel.send(frame)
     except Exception as e:
@@ -154,7 +205,9 @@ async def run_serve(
     hello = Hello.from_json(hello_msg.payload)
     agree = Agree.from_hello(hello)
     await channel.send(TunnelMessage.agree(agree).encode())
-    log.info("sent AGREE, tunnel ready")
+    flow = FlowControl("flow" in agree.features)
+    log.info("sent AGREE, tunnel ready (flow control %s)",
+             "on" if flow.enabled else "off")
 
     pending: Dict[int, Tuple[RequestHeaders, bytearray]] = {}
     request_tasks: set[asyncio.Task] = set()
@@ -199,10 +252,15 @@ async def run_serve(
                 if entry is not None:
                     req, body = entry
                     task = asyncio.create_task(
-                        _handle_request(channel, backend, req, bytes(body))
+                        _handle_request(channel, backend, req, bytes(body), flow)
                     )
                     request_tasks.add(task)
                     task.add_done_callback(request_tasks.discard)
+            elif msg.msg_type == MessageType.FLOW:
+                try:
+                    flow.grant(msg.stream_id, msg.flow_credit())
+                except ProtocolError as e:
+                    log.warning("bad FLOW frame: %s", e)
             elif msg.msg_type == MessageType.PING:
                 try:
                     await channel.send(TunnelMessage.pong().encode())

@@ -33,7 +33,10 @@ class ModelConfig:
     post_norms: bool = False  # extra RMSNorm after attn/mlp blocks
     attn_softcap: Optional[float] = None
     logit_softcap: Optional[float] = None
-    sliding_window: Optional[int] = None  # applied on alternating layers
+    sliding_window: Optional[int] = None
+    # "alternating" (gemma-2: even layers local) or "all" (mistral: every
+    # layer windowed); ignored when sliding_window is None.
+    window_pattern: str = "alternating"
     embed_scale: bool = False  # multiply embeddings by sqrt(dim)
     # attention score scale; None → 1/sqrt(head_dim)
     query_scale: Optional[float] = None
@@ -120,6 +123,41 @@ def llama3_8b() -> ModelConfig:
     )
 
 
+def mistral_7b() -> ModelConfig:
+    """Mistral-7B-v0.1: llama-style with a 4096 sliding window on EVERY
+    layer (the arch that popularised windowed attention for serving)."""
+    return ModelConfig(
+        name="mistral-7b",
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=14336,
+        rope_theta=10000.0,
+        norm_eps=1e-5,
+        sliding_window=4096,
+        window_pattern="all",
+    )
+
+
+def qwen2_7b() -> ModelConfig:
+    """Qwen2-7B: llama-style blocks, large vocab, tied=false, theta=1e6."""
+    return ModelConfig(
+        name="qwen2-7b",
+        vocab_size=152064,
+        dim=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        head_dim=128,
+        ffn_dim=18944,
+        rope_theta=1000000.0,
+        norm_eps=1e-6,
+    )
+
+
 def llama3_70b() -> ModelConfig:
     return ModelConfig(
         name="llama3-70b",
@@ -141,6 +179,8 @@ PRESETS = {
     "gemma2-2b": gemma2_2b,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
+    "mistral-7b": mistral_7b,
+    "qwen2-7b": qwen2_7b,
 }
 
 

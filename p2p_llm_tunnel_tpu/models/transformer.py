@@ -112,10 +112,13 @@ def _qkv(cfg: ModelConfig, blk, h, positions):
 
 
 def _layer_window(cfg: ModelConfig, layer_idx, seq_len):
-    """Per-layer sliding-window size as a traced scalar (gemma-2 alternates
-    local/global layers); None when the config never uses windows."""
+    """Per-layer sliding-window size as a traced scalar; None when the
+    config never uses windows.  gemma-2 alternates local/global layers;
+    mistral windows every layer."""
     if cfg.sliding_window is None:
         return None
+    if cfg.window_pattern == "all":
+        return jnp.asarray(cfg.sliding_window)
     use = (layer_idx % 2) == 0
     return jnp.where(use, cfg.sliding_window, seq_len + 1)
 

@@ -37,8 +37,19 @@ MAX_FRAME_SIZE = 64 * 1024
 #: header + transport overhead (reference protocol.rs:12).
 MAX_BODY_CHUNK = MAX_FRAME_SIZE - 128
 
-#: Features this implementation supports (reference protocol.rs:67).
-SUPPORTED_FEATURES = ["sse"]
+#: Features this implementation supports.  "sse" is the reference's only
+#: feature (protocol.rs:67); "flow" is our per-stream credit flow control —
+#: the protocol-v2 extension the reference's HELLO/AGREE negotiation was
+#: designed to allow (SURVEY.md §7 hard-part #3: the reference has no
+#: backpressure).  Reference peers never offer "flow", so the intersection
+#: disables it and the wire stays reference-compatible.
+SUPPORTED_FEATURES = ["sse", "flow"]
+
+#: Initial per-stream credit a serve peer assumes when "flow" is agreed;
+#: the proxy replenishes with FLOW frames as its client consumes.
+INITIAL_CREDIT = 256 * 1024
+#: Proxy grants more credit once it has relayed this many bytes.
+CREDIT_BATCH = 64 * 1024
 
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE
 
@@ -64,6 +75,7 @@ class MessageType(enum.IntEnum):
     RES_HEADERS = 20
     RES_BODY = 21
     RES_END = 22
+    FLOW = 30  # per-stream credit grant: payload = u32 BE byte count
     ERROR = 99
 
     @classmethod
@@ -287,6 +299,16 @@ class TunnelMessage:
     def error(cls, stream_id: int, msg: str) -> "TunnelMessage":
         # ERROR payload is plain UTF-8 text (reference protocol.rs:240-246).
         return cls(MessageType.ERROR, stream_id, msg.encode())
+
+    @classmethod
+    def flow(cls, stream_id: int, credit: int) -> "TunnelMessage":
+        """Grant ``credit`` more response-body bytes for one stream."""
+        return cls(MessageType.FLOW, stream_id, struct.pack(">I", credit))
+
+    def flow_credit(self) -> int:
+        if len(self.payload) < 4:
+            raise ProtocolError("FLOW payload must be a u32 credit")
+        return struct.unpack_from(">I", self.payload)[0]
 
 
 def iter_body_chunks(data: bytes, chunk_size: int = MAX_BODY_CHUNK):

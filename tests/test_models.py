@@ -162,3 +162,32 @@ def test_loss_fn_finite(model):
     valid = jnp.ones((2, 8), bool)
     loss = loss_fn(cfg, params, tokens, targets, valid)
     assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def test_window_pattern_all_isolates_every_layer():
+    """Mistral-style: sliding_window applies to EVERY layer, so distant
+    tokens cannot influence late positions through any depth."""
+    from dataclasses import replace
+
+    cfg = replace(tiny(), sliding_window=4, window_pattern="all")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    t = 16
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (1, t), 0, cfg.vocab_size)
+    valid = jnp.ones((1, t), bool)
+    base, _, _ = prefill(cfg, params, tokens, valid)
+    tokens_b = tokens.at[0, 0].set((tokens[0, 0] + 1) % cfg.vocab_size)
+    pert, _, _ = prefill(cfg, params, tokens_b, valid)
+    # with window=4 and depth=2, info from position 0 can reach at most
+    # position ~2*(4-1); the last position (15) must be unaffected
+    np.testing.assert_allclose(
+        np.asarray(base[0, -1]), np.asarray(pert[0, -1]), rtol=1e-5, atol=1e-5
+    )
+    # sanity: position 1 (inside the window of position 0) IS affected
+    assert not np.allclose(np.asarray(base[0, 1]), np.asarray(pert[0, 1]))
+
+
+def test_new_presets_instantiate():
+    for name in ("mistral-7b", "qwen2-7b"):
+        cfg = get_config(name)
+        assert cfg.n_heads % cfg.n_kv_heads == 0
+        assert cfg.dim  # smoke: fields populated
