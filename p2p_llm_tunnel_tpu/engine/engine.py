@@ -391,10 +391,16 @@ class InferenceEngine:
         if self._dev_tokens is None:
             self._dev_tokens = jnp.zeros((rows,), jnp.int32)
             self._dev_positions = jnp.zeros((rows,), jnp.int32)
+        # jnp.array (copy=True) — NOT jnp.asarray — for every persistent host
+        # array at the dispatch boundary: on the CPU backend asarray zero-copy
+        # ALIASES numpy buffers, so mutating them after dispatch (_ov_mask
+        # reset below, _account_token while the burst is still queued) would
+        # corrupt what the XLA program reads — a load-dependent
+        # nondeterminism (verified empirically; r2 flake).
         samp = sampling.SamplingParams(
-            temperature=jnp.asarray(self._temp),
-            top_k=jnp.asarray(self._top_k),
-            top_p=jnp.asarray(self._top_p),
+            temperature=jnp.array(self._temp),
+            top_k=jnp.array(self._top_k),
+            top_p=jnp.array(self._top_p),
         )
         sampled, self._dev_tokens, self._dev_positions, self.kv_cache = (
             self._jit_decode(
@@ -402,9 +408,9 @@ class InferenceEngine:
                 self.kv_cache,
                 self._dev_tokens,
                 self._dev_positions,
-                jnp.asarray(self._ov_mask),
-                jnp.asarray(self._last_token),
-                jnp.asarray(self._positions),
+                jnp.array(self._ov_mask),
+                jnp.array(self._last_token),
+                jnp.array(self._positions),
                 samp,
                 self._next_key(),
             )

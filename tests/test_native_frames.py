@@ -56,6 +56,17 @@ def test_decode_rejects_bad_input():
         native.decode_frame(b"\x01" + b"\x00" * (frames.MAX_FRAME_SIZE + 10))
 
 
+def test_flow_frame_byte_parity():
+    """FLOW (type 30) roundtrips through BOTH codecs identically — the one
+    frame type we added over the reference wire format (ADVICE r2 low #3)."""
+    py = frames.TunnelMessage.flow(11, 65536)
+    wire = py.encode()
+    assert native.encode_frame(int(frames.MessageType.FLOW), 11, py.payload) == wire
+    mt, sid, payload = native.decode_frame(wire)
+    assert (mt, sid, payload) == (30, 11, py.payload)
+    assert frames.TunnelMessage.decode(wire).flow_credit() == 65536
+
+
 def test_decode_error_frame_is_valid():
     mt, sid, payload = native.decode_frame(b"\x63" + b"\x00\x00\x00\x01" + b"oops")
     assert mt == 99 and sid == 1 and payload == b"oops"
