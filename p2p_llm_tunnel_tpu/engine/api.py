@@ -129,7 +129,15 @@ class EngineAPI:
             ).encode()
 
         finish_reason = "stop"
+        first = True
         async for ev in self.engine.generate(prompt_ids, **kwargs):
+            if first:
+                # OpenAI streams open with a role-only delta chunk; emitting
+                # it when the FIRST token lands (not at accept) also gives
+                # clients an honest time-to-first-token signal even when the
+                # token's text is empty (mid-codepoint byte, special id).
+                yield chunk({"role": "assistant"}, None)
+                first = False
             if ev.text:
                 yield chunk({"content": ev.text}, None)
             if ev.finish_reason is not None:

@@ -124,9 +124,6 @@ class InferenceEngine:
         if self.ecfg.quant == "int8":
             from p2p_llm_tunnel_tpu.models.quant import QTensor, quantize_params
 
-            if self.ecfg.tp > 1 or mesh is not None:
-                # QTensor leaves need rank-aware PartitionSpecs; not wired yet.
-                raise NotImplementedError("int8 quantization with tp>1")
             if not isinstance(params["blocks"]["wq"], QTensor):
                 # Loaded/injected bf16 weights: quantize once at startup.
                 log.info("quantizing weights to int8 (per-channel, weight-only)")
@@ -151,7 +148,7 @@ class InferenceEngine:
             self.mcfg = _replace(self.mcfg, flash=False)
             log.info("sharding params over mesh %s", dict(mesh.shape))
             params = shard_params(params, self.mcfg, mesh)
-            param_shardings = _pshard(self.mcfg, mesh)
+            param_shardings = _pshard(self.mcfg, mesh, params)
         self.params = params
         self.param_shardings = param_shardings
 
@@ -366,6 +363,7 @@ class InferenceEngine:
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
         )
+        t0 = time.monotonic()
         first, self.kv_cache = self._jit_prefill(
             self.params,
             self.kv_cache,
@@ -375,8 +373,14 @@ class InferenceEngine:
             samp,
             self._next_key(),
         )
+        out = np.asarray(jax.device_get(first))[:n]
+        # Wall time of the full prefill round trip (dispatch → result on
+        # host), the per-phase timing SURVEY §5 asks for.
+        global_metrics.observe(
+            "engine_prefill_ms", (time.monotonic() - t0) * 1000.0
+        )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return np.asarray(jax.device_get(first))[:n]
+        return out
 
     def _dispatch_decode(self):
         """Non-blocking: dispatch one k-step burst; returns (sampled_device,
@@ -526,8 +530,15 @@ class InferenceEngine:
             )
             if in_flight is not None:
                 sampled_dev, assign = in_flight
+                t0 = time.monotonic()
                 sampled = await loop.run_in_executor(
                     self._executor, lambda: np.asarray(jax.device_get(sampled_dev))
+                )
+                # Decode-phase stall: how long the host waited for the
+                # previous burst after dispatching the next one (0 ≈ the
+                # RTT is fully hidden by pipelining).
+                global_metrics.observe(
+                    "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                 )
                 await self._process_burst(sampled, assign)
             in_flight = current

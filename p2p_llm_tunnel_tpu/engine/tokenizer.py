@@ -25,6 +25,10 @@ class ByteTokenizer:
 
     ``decode_token`` is incremental-safe for ASCII; multi-byte codepoints are
     buffered by StreamDecoder below.
+
+    ``vocab_size`` can be widened (e.g. to a real model's full vocabulary so
+    a benchmark exercises the true embed/lm_head shapes); ids >= 256 decode
+    to "" and encode never produces them.
     """
 
     PAD = 256
@@ -34,9 +38,12 @@ class ByteTokenizer:
     bos_id = BOS
     eos_id = EOS
 
+    def __init__(self, vocab_size: int = 259):
+        self._vocab_size = max(int(vocab_size), 259)
+
     @property
     def vocab_size(self) -> int:
-        return 259
+        return self._vocab_size
 
     def encode(self, text: str) -> List[int]:
         return list(text.encode("utf-8"))

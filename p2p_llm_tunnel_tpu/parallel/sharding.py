@@ -16,18 +16,52 @@ The reference has no tensor parallelism to mirror (SURVEY.md §2 table:
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_llm_tunnel_tpu.models.config import ModelConfig
+from p2p_llm_tunnel_tpu.models.quant import QTensor
 
 Pytree = Any
 
+#: Contracted (quantization) axis per weight name — mirrors
+#: models/quant.py quantize_params: the scale drops exactly this axis.
+_QUANT_AXIS = {
+    "wq": 1, "wk": 1, "wv": 1, "wo": 1,
+    "w_gate": 1, "w_up": 1, "w_down": 1,
+    "embed": 1, "lm_head": 0,
+}
 
-def param_pspecs(cfg: ModelConfig) -> Dict[str, Any]:
-    """PartitionSpec pytree matching init_params' layout (models/transformer.py)."""
+
+def _qspec(weight_spec: P, name: str) -> QTensor:
+    """Spec pair for a QTensor leaf: ``q`` shards exactly like the bf16
+    weight would; ``scale`` (the weight's shape minus the contracted axis)
+    keeps the remaining axes' placements — so a column-parallel weight gets
+    a tp-sharded scale and a row-parallel weight a replicated one.
+    Composability required by BASELINE config 4 (70B int8 on v5e-8);
+    VERDICT r2 item 5."""
+    axis = _QUANT_AXIS[name]
+    scale_spec = P(*(s for i, s in enumerate(weight_spec) if i != axis))
+    return QTensor(q=weight_spec, scale=scale_spec)
+
+
+def param_pspecs(
+    cfg: ModelConfig, params: Optional[Pytree] = None
+) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init_params' layout (models/transformer.py).
+
+    When ``params`` is given, weights that are QTensors get congruent
+    QTensor spec pairs (int8 + per-channel scale shard together).
+    """
+
+    def maybe_q(name: str, spec: P, leaf) -> Any:
+        if leaf is not None and isinstance(leaf, QTensor):
+            return _qspec(spec, name)
+        return spec
+
+    pblocks = params["blocks"] if params is not None else {}
     blocks = {
         "attn_norm": P(None, None),  # [L, Dm] replicated
         "mlp_norm": P(None, None),
@@ -39,16 +73,25 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, Any]:
         "w_up": P(None, None, "tp"),
         "w_down": P(None, "tp", None),  # [L, F, Dm] row
     }
+    for name in _QUANT_AXIS:
+        if name in blocks:
+            blocks[name] = maybe_q(name, blocks[name], pblocks.get(name))
     if cfg.post_norms:
         blocks["post_attn_norm"] = P(None, None)
         blocks["post_mlp_norm"] = P(None, None)
     specs: Dict[str, Any] = {
-        "embed": P("tp", None),  # [V, Dm] vocab-sharded
+        "embed": maybe_q(
+            "embed", P("tp", None),  # [V, Dm] vocab-sharded
+            params.get("embed") if params is not None else None,
+        ),
         "blocks": blocks,
         "final_norm": P(None),
     }
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, "tp")  # [Dm, V] vocab-sharded output
+        specs["lm_head"] = maybe_q(
+            "lm_head", P(None, "tp"),  # [Dm, V] vocab-sharded output
+            params.get("lm_head") if params is not None else None,
+        )
     return specs
 
 
@@ -66,8 +109,10 @@ def _to_shardings(mesh: Mesh, specs: Pytree) -> Pytree:
     )
 
 
-def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Pytree:
-    return _to_shardings(mesh, param_pspecs(cfg))
+def param_shardings(
+    cfg: ModelConfig, mesh: Mesh, params: Optional[Pytree] = None
+) -> Pytree:
+    return _to_shardings(mesh, param_pspecs(cfg, params))
 
 
 def kv_cache_shardings(mesh: Mesh) -> Pytree:
@@ -75,8 +120,9 @@ def kv_cache_shardings(mesh: Mesh) -> Pytree:
 
 
 def shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
-    """Place a (host or single-device) param pytree onto the mesh."""
-    return jax.device_put(params, param_shardings(cfg, mesh))
+    """Place a (host or single-device, possibly int8-quantized) param pytree
+    onto the mesh."""
+    return jax.device_put(params, param_shardings(cfg, mesh, params))
 
 
 def shard_kv_cache(kv_cache: Pytree, mesh: Mesh) -> Pytree:

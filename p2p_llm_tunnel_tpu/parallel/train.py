@@ -51,23 +51,34 @@ def make_train_step(
         return params, opt_state
 
     # Optimizer moments (mu/nu) are param-shaped → inherit the param's spec;
-    # everything else in the state (step count, wd) replicates.  Matching by
-    # shape over an eval_shape trace keeps this agnostic to optax internals.
+    # everything else in the state (step count, wd) replicates.  Matched by
+    # TREE PATH suffix, not shape: wq [L,dm,h*hd] and wo [L,h*hd,dm] have
+    # identical shapes whenever dm == n_heads*head_dim (every llama preset),
+    # so shape-keyed matching mis-sharded wo's moments (ADVICE r2 low #4).
     param_shapes = jax.eval_shape(
         lambda k: init_params(cfg, k, jnp.float32), jax.random.PRNGKey(0)
     )
     opt_shapes = jax.eval_shape(lambda: opt.init(param_shapes))
-    shape_to_spec = {
-        tuple(leaf.shape): spec
-        for leaf, spec in zip(
-            jax.tree.leaves(param_shapes),
-            jax.tree.leaves(
+    path_to_spec = {
+        jax.tree_util.keystr(path): (spec, tuple(leaf.shape))
+        for (path, spec), leaf in zip(
+            jax.tree_util.tree_flatten_with_path(
                 param_pspecs(cfg), is_leaf=lambda x: isinstance(x, P)
-            ),
+            )[0],
+            jax.tree.leaves(param_shapes),
         )
     }
-    opt_sharding = jax.tree.map(
-        lambda leaf: NamedSharding(mesh, shape_to_spec.get(tuple(leaf.shape), P())),
+
+    def _moment_spec(path, leaf) -> P:
+        ks = jax.tree_util.keystr(path)
+        for ppath, (spec, shape) in path_to_spec.items():
+            # e.g. "[0].mu['blocks']['wq']" ends with "['blocks']['wq']".
+            if ks.endswith(ppath) and tuple(leaf.shape) == shape:
+                return spec
+        return P()
+
+    opt_sharding = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _moment_spec(path, leaf)),
         opt_shapes,
     )
 

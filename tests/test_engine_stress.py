@@ -1,0 +1,99 @@
+"""Determinism stress: many overlapping requests + random cancels.
+
+The engine's core contract (the reference's serve.rs:263-277 replacement):
+greedy output for a prompt must be identical no matter what else shares the
+batch, when it was admitted, or which consumers abandoned their streams
+mid-flight.  This is the regression test for the r2 full-suite-only flake
+(host-buffer aliasing into in-flight XLA programs, fixed in engine.py
+_dispatch_decode).
+"""
+
+import asyncio
+import random
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+ECFG = EngineConfig(
+    model="tiny", num_slots=4, max_seq=64, dtype="float32", seed=0,
+    decode_steps=4, prefill_rows=4,
+)
+
+PROMPTS = [[1 + i, 2 + i, 3 + i, 4 + i] for i in range(8)]
+MAX_NEW = 6
+
+
+async def _collect(engine, prompt, cancel_after=None):
+    """Consume one generation; optionally abandon after N tokens (simulating
+    a proxy client that disconnected mid-SSE)."""
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=MAX_NEW, stop_ids=()):
+        out.append(ev.token_id)
+        if cancel_after is not None and len(out) >= cancel_after:
+            break
+    return out
+
+
+def test_stress_overlapping_requests_with_cancels_match_serial():
+    async def run():
+        engine = InferenceEngine(engine_cfg=ECFG)
+        await engine.start()
+        try:
+            # Serial references, one at a time on an otherwise idle engine.
+            serial = []
+            for p in PROMPTS:
+                serial.append(await _collect(engine, p))
+            assert all(len(s) == MAX_NEW for s in serial)
+
+            rng = random.Random(1234)
+            for wave in range(6):
+                tasks = []
+                expected = []
+                for j in range(25):
+                    idx = rng.randrange(len(PROMPTS))
+                    cancel_after = (
+                        rng.randint(1, MAX_NEW - 1) if rng.random() < 0.3 else None
+                    )
+                    tasks.append(
+                        asyncio.create_task(
+                            _collect(engine, PROMPTS[idx], cancel_after)
+                        )
+                    )
+                    expected.append((idx, cancel_after))
+                    # Stagger some submissions so admissions interleave with
+                    # in-flight decode bursts (the r2 race window).
+                    if rng.random() < 0.5:
+                        await asyncio.sleep(0.001 * rng.random())
+                results = await asyncio.gather(*tasks)
+                for (idx, cancel_after), got in zip(expected, results):
+                    want = serial[idx]
+                    if cancel_after is None:
+                        assert got == want, (
+                            f"wave {wave}: prompt {idx} diverged under load: "
+                            f"{got} != {want}"
+                        )
+                    else:
+                        assert got == want[: len(got)], (
+                            f"wave {wave}: cancelled prompt {idx} not a prefix: "
+                            f"{got} vs {want}"
+                        )
+        finally:
+            await engine.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 300))
+
+
+def test_stress_repeated_single_prompt_identical():
+    """Same prompt 30x concurrently: every stream must return the same ids."""
+    async def run():
+        engine = InferenceEngine(engine_cfg=ECFG)
+        await engine.start()
+        try:
+            ref = await _collect(engine, [9, 9, 8, 7])
+            results = await asyncio.gather(
+                *[_collect(engine, [9, 9, 8, 7]) for _ in range(30)]
+            )
+            assert all(r == ref for r in results), results
+        finally:
+            await engine.stop()
+
+    asyncio.run(asyncio.wait_for(run(), 300))

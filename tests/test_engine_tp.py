@@ -22,6 +22,37 @@ def _collect(engine, prompt, n):
     return asyncio.run(asyncio.wait_for(main(), 120))
 
 
+def _greedy_margins(cfg, params, prompt, toks):
+    """Top-2 logit margin at every greedy step of the observed sequence —
+    used to decide how strict the tp-vs-single comparison may be: GSPMD
+    reduction reordering legitimately flips argmax at fp-epsilon near-ties
+    (ADVICE r2 medium #2)."""
+    from p2p_llm_tunnel_tpu.models.transformer import (
+        decode_step, init_kv_cache, prefill_into_cache,
+    )
+
+    cache = init_kv_cache(cfg, 1, 64, jnp.float32)
+    t = 32
+    tokens = jnp.zeros((1, t), jnp.int32).at[0, : len(prompt)].set(
+        jnp.array(prompt)
+    )
+    last, cache = prefill_into_cache(
+        cfg, params, tokens, jnp.array([len(prompt)]), cache, jnp.array([0])
+    )
+    margins = []
+    pos = len(prompt)
+    logits = last[0]
+    for tok in toks:
+        two = jnp.sort(logits)[-2:]
+        margins.append(float(two[1] - two[0]))
+        logits, cache = decode_step(
+            cfg, params, cache, jnp.array([tok]), jnp.array([pos])
+        )
+        logits = logits[0]
+        pos += 1
+    return margins
+
+
 def test_tp_engine_matches_single_chip(cpu_devices):
     cfg = get_config("tiny", n_heads=8, n_kv_heads=2, vocab_size=512)
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
@@ -41,9 +72,62 @@ def test_tp_engine_matches_single_chip(cpu_devices):
     )
     toks_tp = _collect(tp_engine, prompt, 12)
 
-    # Greedy decode (temperature 0) must be bit-identical across shardings
-    # up to fp reassociation; token ids are the observable contract.
-    assert toks_single == toks_tp
+    if toks_single != toks_tp:
+        # Token ids are the observable contract, but sharded reductions may
+        # reassociate floats: a mismatch is only a failure when the
+        # single-chip margin at the divergence step was decisive (near-ties
+        # at fp32 epsilon can legally flip under tp).
+        div = next(
+            i for i, (a, b) in enumerate(zip(toks_single, toks_tp)) if a != b
+        )
+        margins = _greedy_margins(cfg, params, prompt, toks_single)
+        assert margins[div] < 1e-3, (
+            f"tp diverged at step {div} with decisive margin "
+            f"{margins[div]:.6f}: {toks_single} vs {toks_tp}"
+        )
+
+
+def test_tp_engine_int8(cpu_devices):
+    """int8 quantization composes with tensor parallelism (VERDICT r2 item
+    5 / BASELINE config 4: 70B int8 sharded on v5e-8): q shards like its
+    weight, the per-channel scale keeps the non-contracted placements."""
+    from jax.sharding import PartitionSpec as P
+
+    from p2p_llm_tunnel_tpu.models.quant import QTensor
+
+    cfg = get_config("tiny", n_heads=8, n_kv_heads=2, vocab_size=512)
+    ecfg = EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                        dtype="float32", decode_steps=4, tp=2, quant="int8")
+    eng = InferenceEngine(model_cfg=cfg, engine_cfg=ecfg)
+    wq = eng.params["blocks"]["wq"]
+    assert isinstance(wq, QTensor)
+    assert wq.q.sharding.spec == P(None, None, "tp")
+    assert wq.scale.sharding.spec == P(None, "tp")
+    wo = eng.params["blocks"]["wo"]
+    assert wo.q.sharding.spec == P(None, "tp", None)
+    assert wo.scale.sharding.spec == P(None, None)
+
+    toks = _collect(eng, list(b"int8 sharded decode"), 8)
+    assert len(toks) == 8
+
+    # Same weights must give the same stream as the unsharded int8 engine.
+    single = InferenceEngine(
+        model_cfg=cfg,
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=4, quant="int8"),
+    )
+    toks_single = _collect(single, list(b"int8 sharded decode"), 8)
+    if toks != toks_single:
+        div = next(
+            i for i, (a, b) in enumerate(zip(toks_single, toks)) if a != b
+        )
+        margins = _greedy_margins(
+            cfg, single.params, list(b"int8 sharded decode"), toks_single
+        )
+        assert margins[div] < 1e-3, (
+            f"int8 tp diverged at step {div} with decisive margin "
+            f"{margins[div]:.6f}: {toks_single} vs {toks}"
+        )
 
 
 def test_tp_engine_with_checkpoint(tmp_path, cpu_devices):

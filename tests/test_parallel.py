@@ -113,6 +113,23 @@ def test_train_step_runs_and_descends(cfg, cpu_devices):
     assert losses[-1] < losses[0], f"loss did not descend: {losses}"
 
 
+def test_opt_moment_shardings_by_path(cfg, cpu_devices):
+    """AdamW moments must inherit each param's OWN spec: wq and wo have the
+    same shape when dm == h*hd, so shape-keyed matching mis-sharded wo's
+    moments (ADVICE r2 low #4) — path-keyed matching must not."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(tp=4, dp=2)
+    init_fn, _ = make_train_step(cfg, mesh, lr=1e-2)
+    _, opt_state = init_fn(jax.random.PRNGKey(0))
+    adam = opt_state[0]  # ScaleByAdamState(count, mu, nu)
+    for moments in (adam.mu, adam.nu):
+        assert moments["blocks"]["wq"].sharding.spec == P(None, None, "tp")
+        assert moments["blocks"]["wo"].sharding.spec == P(None, "tp", None)
+        assert moments["blocks"]["w_down"].sharding.spec == P(None, "tp", None)
+        assert moments["embed"].sharding.spec == P("tp", None)
+
+
 def test_param_shardings_place_on_mesh(cfg, cpu_devices):
     mesh = make_mesh(tp=4, dp=2)
     params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
