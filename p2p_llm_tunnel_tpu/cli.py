@@ -54,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--transport", choices=("udp", "tcp"),
                        default=_env("TUNNEL_TRANSPORT", "udp"),
                        help="P2P data plane (default udp hole-punch)")
+        # NAT traversal aids (reference cli.rs:72-77 TURN surface):
+        p.add_argument("--stun", default=_env("TUNNEL_STUN"),
+                       help="STUN server host[:port] for a server-reflexive "
+                            "candidate (env TUNNEL_STUN; e.g. "
+                            "stun.l.google.com:19302)")
+        p.add_argument("--relay", default=_env("TUNNEL_RELAY"),
+                       help="relay host[:port] to fall back to when hole "
+                            "punching fails (env TUNNEL_RELAY)")
 
     serve = sub.add_parser("serve", help="provider peer: expose an LLM")
     common(serve)
@@ -98,6 +106,15 @@ def build_parser() -> argparse.ArgumentParser:
     sig = sub.add_parser("signal", help="run the rendezvous server")
     sig.add_argument("--listen", default="127.0.0.1")
     sig.add_argument("--port", type=int, default=8787)
+    sig.add_argument("--stun-port", type=int,
+                     default=int(_env("TUNNEL_STUN_PORT", "0")),
+                     help="also answer STUN binding requests on this UDP "
+                          "port (0 = disabled)")
+
+    rly = sub.add_parser("relay", help="run the UDP pairing relay "
+                                       "(TURN-equivalent fallback)")
+    rly.add_argument("--listen", default="0.0.0.0")
+    rly.add_argument("--port", type=int, default=3479)
     return ap
 
 
@@ -149,7 +166,8 @@ async def _serve_once(args) -> None:
     backend = None
     if args.backend == "tpu":
         backend = await _engine_backend(args)
-    channel, signaling = await connect(args.signal, args.room, args.transport)
+    channel, signaling = await connect(args.signal, args.room, args.transport,
+                                       stun_server=args.stun, relay=args.relay)
     try:
         if backend is not None:
             await run_serve(channel, backend=backend)
@@ -227,7 +245,8 @@ async def _proxy_once(args) -> None:
     from p2p_llm_tunnel_tpu.transport import connect
 
     host, _, port = args.listen.rpartition(":")
-    channel, signaling = await connect(args.signal, args.room, args.transport)
+    channel, signaling = await connect(args.signal, args.room, args.transport,
+                                       stun_server=args.stun, relay=args.relay)
     try:
         await run_proxy(channel, host or "127.0.0.1", int(port))
     finally:
@@ -239,7 +258,17 @@ async def _amain(args) -> None:
     if args.command == "signal":
         from p2p_llm_tunnel_tpu.signaling.server import SignalServer
 
+        if args.stun_port:
+            from p2p_llm_tunnel_tpu.transport.stun import start_stun_server
+
+            await start_stun_server(args.listen, args.stun_port)
         await SignalServer(args.listen, args.port).serve_forever()
+        return
+
+    if args.command == "relay":
+        from p2p_llm_tunnel_tpu.transport.relay import run_relay_server
+
+        await run_relay_server(args.listen, args.port)
         return
 
     if not args.room:

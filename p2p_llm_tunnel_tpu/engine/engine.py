@@ -61,6 +61,10 @@ class EngineConfig:
     # (parallel/sharding.py); 1 = single chip.  GSPMD inserts the ICI
     # collectives — the decode all-gather path of BASELINE config 4.
     tp: int = 1
+    # Sequence-parallel degree for prefill: shards the prompt axis over an
+    # sp mesh axis and runs ring attention (ops/ring_attention.py) — the
+    # long-context path (SURVEY §5).  Decode is unaffected (single-token).
+    sp: int = 1
     # Optional orbax checkpoint to load instead of random init.
     ckpt_path: Optional[str] = None
     # Weight quantization: "none" | "int8" (weight-only, per-channel).
@@ -130,22 +134,17 @@ class InferenceEngine:
                 params = quantize_params(params)
         elif self.ecfg.quant not in ("none", ""):
             raise ValueError(f"unknown quant mode {self.ecfg.quant!r}")
-        if mesh is None and self.ecfg.tp > 1:
+        if mesh is None and (self.ecfg.tp > 1 or self.ecfg.sp > 1):
             from p2p_llm_tunnel_tpu.parallel import make_mesh
 
-            mesh = make_mesh(tp=self.ecfg.tp, dp=1)
+            mesh = make_mesh(tp=self.ecfg.tp, dp=1, sp=self.ecfg.sp)
         self.mesh = mesh
         if mesh is not None:
-            from dataclasses import replace as _replace
-
             from p2p_llm_tunnel_tpu.parallel.sharding import (
                 param_shardings as _pshard,
                 shard_params,
             )
 
-            # pallas_call is not auto-partitioned by GSPMD; use the einsum
-            # attention path when the model runs sharded.
-            self.mcfg = _replace(self.mcfg, flash=False)
             log.info("sharding params over mesh %s", dict(mesh.shape))
             params = shard_params(params, self.mcfg, mesh)
             param_shardings = _pshard(self.mcfg, mesh, params)
@@ -233,7 +232,8 @@ class InferenceEngine:
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
-            self.mcfg, params, tokens, lengths, kv_cache, slots
+            self.mcfg, params, tokens, lengths, kv_cache, slots,
+            mesh=self.mesh,
         )
         first = sampling.sample(last_logits, samp, key)
         return first, kv_cache

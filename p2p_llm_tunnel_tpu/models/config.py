@@ -41,9 +41,13 @@ class ModelConfig:
     # attention score scale; None → 1/sqrt(head_dim)
     query_scale: Optional[float] = None
     # Use the Pallas flash kernel for prefill attention when the backend is
-    # TPU and shapes tile (T%128==0, head_dim%128==0).  Engines disable it
-    # for sharded meshes (GSPMD does not auto-partition pallas_call).
+    # TPU and shapes tile (T%128==0, head_dim%128==0).  Under a tp mesh the
+    # kernel runs per head-shard via shard_map (GSPMD does not
+    # auto-partition pallas_call).
     flash: bool = True
+    # Run the flash kernel in Pallas interpret mode even off-TPU — CPU-mesh
+    # tests of the shard_map'd kernel path set this.
+    flash_interpret: bool = False
 
     @property
     def q_per_kv(self) -> int:

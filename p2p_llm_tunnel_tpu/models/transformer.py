@@ -16,6 +16,7 @@ in-process upstream that replaces its reqwest→Ollama hop (serve.rs:219).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Dict, Optional, Tuple
 
@@ -148,48 +149,119 @@ def _logits(cfg: ModelConfig, params, x):
 # prefill
 # ---------------------------------------------------------------------------
 
+def _prefill_attention_fn(cfg: ModelConfig, mesh, t: int):
+    """Pick the prefill attention implementation for this (config, mesh).
+
+    Returns ``fn(q, k, v, valid, window) -> [B,T,H,D]``.  Selection:
+    - ring attention when the mesh has an ``sp`` axis > 1 (sequence sharded
+      over the ICI ring; long-context serving — SURVEY §5);
+    - the Pallas flash kernel when shapes tile, wrapped in shard_map over
+      the head axes when a ``tp`` axis > 1 is present (pallas_call is not
+      GSPMD-partitioned — VERDICT r2 item 6);
+    - the dense einsum fallback otherwise (always-correct oracle).
+    """
+    axes = dict(mesh.shape) if mesh is not None else {}
+    sp, tp = axes.get("sp", 1), axes.get("tp", 1)
+
+    if sp > 1:
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "ring attention does not support sliding windows; "
+                "use an sp=1 mesh for windowed models"
+            )
+        from p2p_llm_tunnel_tpu.ops.ring_attention import make_ring_attention
+
+        ring = make_ring_attention(
+            mesh, "sp",
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            head_axis="tp" if tp > 1 else None,
+        )
+
+        def ring_fn(q, k, v, valid, window):
+            # Right-padded prompts need no pad mask: pad KV sits at positions
+            # strictly after every real query, so causality masks it.
+            return ring(q, k, v)
+
+        return ring_fn
+
+    use_flash = (
+        cfg.flash
+        and (jax.default_backend() == "tpu" or cfg.flash_interpret)
+        and t % 128 == 0
+        and cfg.head_dim % 128 == 0
+    )
+    if use_flash:
+        from p2p_llm_tunnel_tpu.ops.pallas_attention import (
+            flash_causal_attention,
+        )
+
+        flash = functools.partial(
+            flash_causal_attention,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            interpret=cfg.flash_interpret,
+        )
+        if tp > 1:
+            from jax.sharding import PartitionSpec as P
+
+            head_spec = P(None, None, "tp", None)
+            rep = P(None, None)
+
+            def flash_tp(q, k, v, valid, window):
+                # One kernel per head-shard: q heads and kv heads both split
+                # on tp (column-parallel wq/wk/wv), so GQA grouping is
+                # preserved shard-locally.  window crosses the shard_map
+                # boundary as a replicated scalar (t+1 = disabled).
+                win = jnp.asarray(t + 1 if window is None else window, jnp.int32)
+                return jax.shard_map(
+                    lambda q_, k_, v_, valid_, win_: flash(
+                        q_, k_, v_, valid_, window=win_
+                    ),
+                    mesh=mesh,
+                    in_specs=(head_spec, head_spec, head_spec, rep, P()),
+                    out_specs=head_spec,
+                    # pallas_call does not annotate varying-mesh-axes on its
+                    # outputs; the per-shard kernel is trivially correct
+                    # (no cross-shard comms), so skip the vma check.
+                    check_vma=False,
+                )(q, k, v, valid, win)
+
+            return flash_tp
+        return lambda q, k, v, valid, window: flash(q, k, v, valid, window=window)
+
+    return lambda q, k, v, valid, window: causal_attention(
+        q, k, v, valid,
+        scale=cfg.query_scale,
+        softcap=cfg.attn_softcap,
+        window=window,
+    )
+
+
 def prefill(
     cfg: ModelConfig,
     params: Params,
     tokens: jnp.ndarray,  # [B, T] right-padded
     valid: jnp.ndarray,  # [B, T] bool
+    mesh=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Full-prompt forward. Returns (logits [B,T,V], k, v [L,B,T,K,D])."""
+    """Full-prompt forward. Returns (logits [B,T,V], k, v [L,B,T,K,D]).
+
+    ``mesh`` (optional jax.sharding.Mesh) selects sharded attention paths:
+    tp shard_map's the flash kernel over head shards; sp>1 runs ring
+    attention over the sequence axis (see _prefill_attention_fn).
+    """
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
     x = _embed(cfg, params, tokens)
     layer_idx = jnp.arange(cfg.n_layers)
-
-    use_flash = (
-        cfg.flash
-        and jax.default_backend() == "tpu"
-        and t % 128 == 0
-        and cfg.head_dim % 128 == 0
-    )
+    attention = _prefill_attention_fn(cfg, mesh, t)
 
     def step(x, xs):
         blk, idx = xs
         h = _norm(cfg, x, blk["attn_norm"])
         q, k, v = _qkv(cfg, blk, h, positions)
-        if use_flash:
-            from p2p_llm_tunnel_tpu.ops.pallas_attention import (
-                flash_causal_attention,
-            )
-
-            window = _layer_window(cfg, idx, t)
-            attn = flash_causal_attention(
-                q, k, v, valid,
-                scale=cfg.query_scale,
-                softcap=cfg.attn_softcap,
-                window=window,
-            )
-        else:
-            attn = causal_attention(
-                q, k, v, valid,
-                scale=cfg.query_scale,
-                softcap=cfg.attn_softcap,
-                window=_layer_window(cfg, idx, t),
-            )
+        attn = attention(q, k, v, valid, _layer_window(cfg, idx, t))
         attn = mm(attn.reshape(b, t, -1), blk["wo"])
         if cfg.post_norms:
             attn = _norm(cfg, attn, blk["post_attn_norm"])
@@ -213,6 +285,7 @@ def prefill_into_cache(
     lengths: jnp.ndarray,  # [Bp]
     kv_cache: KVCache,
     slots: jnp.ndarray,  # [Bp] cache slot per prompt
+    mesh=None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Prefill prompts and scatter their KV into cache slots.
 
@@ -222,7 +295,7 @@ def prefill_into_cache(
     """
     b, t = tokens.shape
     valid = jnp.arange(t)[None, :] < lengths[:, None]
-    logits, ks, vs = prefill(cfg, params, tokens, valid)
+    logits, ks, vs = prefill(cfg, params, tokens, valid, mesh=mesh)
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None], axis=1
     )[:, 0]  # [Bp, V]

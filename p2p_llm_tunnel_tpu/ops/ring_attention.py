@@ -55,6 +55,7 @@ def _ring_attention_local(
     axis_name: str,
     scale: float,
     softcap: Optional[float],
+    vary_axes: Tuple[str, ...] = (),
 ) -> jnp.ndarray:
     """The per-device program (runs inside shard_map)."""
     b, tq, h, d = q.shape
@@ -67,11 +68,22 @@ def _ring_attention_local(
     q5 = q.reshape(b, tq, kh, g, d)
     q_pos = my_idx * tq + jnp.arange(tq)
 
-    # pvary: the accumulators start as constants but the scan makes them
-    # device-varying over the ring axis; their carry types must match.
-    acc0 = jax.lax.pvary(jnp.zeros((b, kh, g, tq, d), jnp.float32), (axis_name,))
-    m0 = jax.lax.pvary(jnp.full((b, kh, g, tq), _NEG_INF, jnp.float32), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((b, kh, g, tq), jnp.float32), (axis_name,))
+    # The accumulators start as constants but the scan body makes them
+    # device-varying over the ring axis — and over the head (tp) axis when
+    # composed with tensor parallelism — so their carry types must be cast
+    # varying over every axis the inputs vary over.
+    axes = (axis_name, *vary_axes)
+    vary = getattr(jax.lax, "pcast", None)
+    if vary is not None:
+        def _v(x):
+            return vary(x, axes, to="varying")
+    else:  # older jax spelling
+        def _v(x):
+            return jax.lax.pvary(x, axes)
+
+    acc0 = _v(jnp.zeros((b, kh, g, tq, d), jnp.float32))
+    m0 = _v(jnp.full((b, kh, g, tq), _NEG_INF, jnp.float32))
+    l0 = _v(jnp.zeros((b, kh, g, tq), jnp.float32))
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -109,26 +121,33 @@ def make_ring_attention(
     *,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    head_axis: Optional[str] = None,
 ):
     """Build a jittable ring-attention fn over ``mesh``'s sequence axis.
 
     Returned fn takes GLOBAL arrays q [B,T,H,D], k/v [B,T,K,D] (sequence
     dense, causal) and returns [B,T,H,D]; under jit the inputs/outputs are
     sequence-sharded over ``axis_name`` and the K/V rotation rides the ring.
+
+    ``head_axis`` ("tp") additionally shards the head axes, composing ring
+    sequence parallelism with megatron tensor parallelism: each device owns
+    its head slice AND its sequence block, and the ring rotates only over
+    ``axis_name`` (the per-device program is head-count agnostic).
     """
 
     def fn(q, k, v):
         d = q.shape[-1]
         s = scale if scale is not None else d**-0.5
         local = functools.partial(
-            _ring_attention_local, axis_name=axis_name, scale=s, softcap=softcap
+            _ring_attention_local, axis_name=axis_name, scale=s, softcap=softcap,
+            vary_axes=(head_axis,) if head_axis else (),
         )
-        seq_spec = P(None, axis_name, None, None)
+        spec = P(None, axis_name, head_axis, None)
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(seq_spec, seq_spec, seq_spec),
-            out_specs=seq_spec,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
         )
         return sharded(q, k, v)
 

@@ -72,22 +72,31 @@ async def connect(
     room: str,
     transport: str = "udp",
     timeout: float = CONNECT_TIMEOUT,
+    stun_server: Optional[str] = None,
+    relay: Optional[str] = None,
 ) -> Tuple[Channel, SignalingClient]:
     """Rendezvous in ``room`` and return an established data channel.
+
+    ``stun_server`` ('host[:port]') adds a server-reflexive candidate
+    learned from the punching socket itself (rtc.rs:49-52 equivalent);
+    ``relay`` ('host[:port]') names the encrypted-blind relay both peers
+    fall back to when direct punching times out (rtc.rs:55-63 equivalent).
 
     The caller owns both returned objects; close the signaling client once
     the channel is up if trickle candidates are no longer needed.
     """
     try:
         return await asyncio.wait_for(
-            _connect_inner(signal_url, room, transport), timeout
+            _connect_inner(signal_url, room, transport, stun_server, relay),
+            timeout,
         )
     except asyncio.TimeoutError:
         raise ConnectError(f"connect timed out after {timeout}s")
 
 
 async def _connect_inner(
-    signal_url: str, room: str, transport: str
+    signal_url: str, room: str, transport: str,
+    stun_server: Optional[str], relay: Optional[str],
 ) -> Tuple[Channel, SignalingClient]:
     signaling = await SignalingClient.connect(signal_url, room)
     try:
@@ -99,11 +108,13 @@ async def _connect_inner(
             log.info("room %r empty; waiting for a peer (offerer role)", room)
             await _expect(signaling, PeerJoined)
             channel = await _establish(signaling, room, observed_ip, transport,
-                                       offerer=True)
+                                       offerer=True, stun_server=stun_server,
+                                       relay=relay)
         else:
             log.info("room %r occupied; answering", room)
             channel = await _establish(signaling, room, observed_ip, transport,
-                                       offerer=False)
+                                       offerer=False, stun_server=stun_server,
+                                       relay=relay)
         return channel, signaling
     except BaseException:
         await signaling.close()
@@ -125,11 +136,19 @@ async def _expect(signaling: SignalingClient, kind):
         log.debug("ignoring %s while waiting for %s", type(msg).__name__, kind.__name__)
 
 
-def _udp_candidates(port: int, observed_ip: Optional[str]) -> List[List]:
+def _udp_candidates(
+    port: int,
+    observed_ip: Optional[str],
+    reflexive: Optional[Tuple[str, int]] = None,
+) -> List[List]:
     cands = [[ip, port] for ip in _local_addresses()]
+    if reflexive is not None and list(reflexive) not in cands:
+        # Server-reflexive candidate from a real STUN query off the punching
+        # socket — the exact NAT mapping the peer must hit (rtc.rs:49-52).
+        cands.append([reflexive[0], reflexive[1]])
     if observed_ip and all(ip != observed_ip for ip, _ in cands):
         # NAT-external guess: same port as bound (works for cone NATs that
-        # preserve ports; a TURN-style relay is the escape hatch, not built).
+        # preserve ports); the relay fallback covers the NATs this misses.
         cands.append([observed_ip, port])
     return cands
 
@@ -140,6 +159,8 @@ async def _establish(
     observed_ip: Optional[str],
     transport: str,
     offerer: bool,
+    stun_server: Optional[str] = None,
+    relay: Optional[str] = None,
 ) -> Channel:
     keys = HandshakeKeys()
     channel: Optional[UdpChannel] = None
@@ -154,11 +175,29 @@ async def _establish(
     try:
         if transport == "udp":
             channel = await UdpChannel.bind()
+            reflexive = None
+            if stun_server:
+                from p2p_llm_tunnel_tpu.transport.stun import parse_server
+
+                reflexive = await channel.stun_query([parse_server(stun_server)])
+                if reflexive:
+                    log.info("stun reflexive candidate: %s:%d", *reflexive)
             sdp = {
                 "kind": "udp",
                 "pubkey": keys.public_bytes.hex(),
-                "candidates": _udp_candidates(channel.local_port, observed_ip),
+                "candidates": _udp_candidates(
+                    channel.local_port, observed_ip, reflexive
+                ),
             }
+            if relay:
+                from p2p_llm_tunnel_tpu.transport.relay import parse_relay
+
+                rh, rp = parse_relay(relay)
+                # The offerer's token wins (both peers must present the same
+                # one); answerer proposes only if the offer had no relay.
+                import os as _os
+
+                sdp["relay"] = [rh, rp, _os.urandom(12).hex()]
         elif transport == "tcp":
             if offerer:
                 accepted = asyncio.get_running_loop().create_future()
@@ -207,11 +246,30 @@ async def _establish(
         if transport == "udp":
             channel.set_session(box)
             punch_list = [(str(h), int(p)) for h, p in remote_cands]
+            # Relay rendezvous: the OFFER's relay+token wins on BOTH sides
+            # (each peer must join the same relay with the same token); the
+            # answer's is the fallback when the offer proposed none.
+            if offerer:
+                relay_info = sdp.get("relay") or remote.get("relay")
+            else:
+                relay_info = remote.get("relay") or sdp.get("relay")
             trickle = asyncio.create_task(_accept_trickle(signaling, punch_list))
             try:
                 await channel.punch(punch_list, PUNCH_TIMEOUT)
             except TimeoutError as e:
-                raise ConnectError(str(e))
+                if not relay_info:
+                    raise ConnectError(str(e))
+                # Direct punching failed (symmetric/port-rewriting NATs):
+                # pivot through the encrypted-blind relay (rtc.rs:55-63
+                # TURN-equivalent).  The channel's datagrams stay sealed
+                # end-to-end; the relay only forwards ciphertext.
+                rh, rp, token = str(relay_info[0]), int(relay_info[1]), str(relay_info[2])
+                log.warning("hole punch failed; falling back to relay %s:%d", rh, rp)
+                try:
+                    await channel.join_relay((rh, rp), token)
+                    await channel.punch([(rh, rp)], PUNCH_TIMEOUT)
+                except TimeoutError as e2:
+                    raise ConnectError(f"relay fallback failed: {e2}")
             finally:
                 trickle.cancel()
             out, channel = channel, None  # ownership passes to the caller

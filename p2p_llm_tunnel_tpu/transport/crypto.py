@@ -75,13 +75,20 @@ class SecureBox:
         return nonce[4:] + self._send.encrypt(nonce, plaintext, aad or None)
 
     def open(self, wire: bytes, aad: bytes = b"") -> bytes:
+        return self.open_ctr(wire, aad)[1]
+
+    def open_ctr(self, wire: bytes, aad: bytes = b"") -> Tuple[int, bytes]:
+        """Decrypt and also return the wire nonce counter, so the caller can
+        enforce a replay policy (transport/udp.py drops repeated counters
+        before allowing peer-address migration)."""
         if len(wire) < 8 + TAG_SIZE:
             raise CryptoError("ciphertext too short")
         nonce = b"\x00\x00\x00\x00" + wire[:8]
         try:
-            return self._recv.decrypt(nonce, wire[8:], aad or None)
+            plaintext = self._recv.decrypt(nonce, wire[8:], aad or None)
         except Exception as e:
             raise CryptoError(f"decryption failed: {e}") from e
+        return struct.unpack(">Q", wire[:8])[0], plaintext
 
 
 def random_session_id() -> str:

@@ -1,0 +1,125 @@
+"""Encrypted-blind UDP relay — the TURN-equivalent escape hatch.
+
+When hole punching cannot succeed (symmetric / port-rewriting NATs on both
+sides), the reference falls back to a TURN relay (reference
+tunnel/src/rtc.rs:55-63; config surface cli.rs:72-77).  This is the native
+equivalent: a dumb pairing relay that
+
+- accepts ``JOIN <token>`` datagrams (magic-prefixed) and pairs the two
+  sources that present the same token, answering each with ``JOINED``;
+- thereafter forwards every non-JOIN datagram from one paired source to the
+  other verbatim.
+
+The relay never holds keys: channel datagrams are already sealed end-to-end
+(X25519 + ChaCha20-Poly1305, transport/crypto.py), so the relay sees only
+ciphertext — closer to TURN-over-DTLS than to a trusted middlebox.
+
+Pairings idle out after IDLE_TIMEOUT so a public relay cannot leak forward
+state forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional, Tuple
+
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MAGIC_JOIN = b"TPUTUNL1J"
+MAGIC_JOINED = b"TPUTUNL1K"
+IDLE_TIMEOUT = 120.0
+MAX_TOKEN = 64
+
+
+def join_packet(token: str) -> bytes:
+    return MAGIC_JOIN + token.encode()
+
+
+def is_joined_packet(data: bytes) -> bool:
+    return data.startswith(MAGIC_JOINED)
+
+
+class _Pairing:
+    __slots__ = ("addrs", "last_active")
+
+    def __init__(self) -> None:
+        self.addrs: list = []
+        self.last_active = time.monotonic()
+
+
+class RelayServer(asyncio.DatagramProtocol):
+    """Pairing + forwarding state machine (one instance per socket)."""
+
+    def __init__(self) -> None:
+        self.transport: Optional[asyncio.DatagramTransport] = None
+        self._by_token: Dict[str, _Pairing] = {}
+        self._by_addr: Dict[Tuple[str, int], Tuple[str, _Pairing]] = {}
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    def _gc(self) -> None:
+        now = time.monotonic()
+        for token, pairing in list(self._by_token.items()):
+            if now - pairing.last_active > IDLE_TIMEOUT:
+                for a in pairing.addrs:
+                    self._by_addr.pop(a, None)
+                del self._by_token[token]
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self._gc()
+        if data.startswith(MAGIC_JOIN):
+            token = data[len(MAGIC_JOIN):][:MAX_TOKEN].decode("ascii", "replace")
+            pairing = self._by_token.setdefault(token, _Pairing())
+            pairing.last_active = time.monotonic()
+            if addr not in pairing.addrs:
+                if len(pairing.addrs) >= 2:
+                    log.warning("relay token %r already paired; ignoring %s",
+                                token, addr)
+                    return
+                pairing.addrs.append(addr)
+                self._by_addr[addr] = (token, pairing)
+                log.info("relay: %s joined token %r (%d/2)",
+                         addr, token, len(pairing.addrs))
+            # Ack every JOIN (idempotent) so late/retried joiners sync up.
+            self.transport.sendto(MAGIC_JOINED, addr)
+            return
+        entry = self._by_addr.get(addr)
+        if entry is None:
+            return  # not a participant; drop
+        _, pairing = entry
+        pairing.last_active = time.monotonic()
+        for other in pairing.addrs:
+            if other != addr:
+                self.transport.sendto(data, other)
+
+
+async def start_relay_server(
+    host: str = "0.0.0.0", port: int = 0
+) -> Tuple[asyncio.DatagramTransport, int]:
+    """Bind a relay; returns (transport, bound_port). Close to stop."""
+    loop = asyncio.get_running_loop()
+    transport, _ = await loop.create_datagram_endpoint(
+        RelayServer, local_addr=(host, port)
+    )
+    bound = transport.get_extra_info("sockname")[1]
+    log.info("relay server listening on %s:%d", host, bound)
+    return transport, bound
+
+
+async def run_relay_server(host: str = "0.0.0.0", port: int = 3479) -> None:
+    """CLI entry: serve until cancelled."""
+    transport, _ = await start_relay_server(host, port)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        transport.close()
+
+
+def parse_relay(spec: str) -> Tuple[str, int]:
+    """'host[:port]' → (host, port)."""
+    host, _, port = spec.partition(":")
+    return host, int(port) if port else 3479

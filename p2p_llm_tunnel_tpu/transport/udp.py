@@ -19,6 +19,13 @@ native equivalent built on a bare UDP socket:
 - **liveness**: keepalive probes every 5 s; the channel declares itself
   disconnected after 15 s of silence (the reference delegates this to the
   WebRTC state machine, rtc.rs:166-174).
+- **replay defense**: AEAD nonce counters are tracked per direction with an
+  anti-replay window; a captured datagram replayed from a spoofed source
+  can neither migrate the peer address nor be delivered twice.
+- **candidate discovery / fallback**: ``stun_query`` learns the reflexive
+  (ip, port) of THIS socket (rtc.rs:49-52 equivalent); ``join_relay``
+  pivots the session through an encrypted-blind relay when punching fails
+  (rtc.rs:55-63 TURN equivalent).
 """
 
 from __future__ import annotations
@@ -28,11 +35,15 @@ import struct
 import time
 from typing import Dict, List, Optional, Tuple
 
+from p2p_llm_tunnel_tpu.transport import relay as relay_mod
+from p2p_llm_tunnel_tpu.transport import stun
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.transport.crypto import CryptoError, SecureBox
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+REPLAY_WINDOW = 4096  # counters older than max-seen minus this are dropped
 
 MTU_PAYLOAD = 1200  # fragment payload bytes per datagram
 WINDOW = 512  # max unacked packets in flight
@@ -89,6 +100,14 @@ class UdpChannel(Channel):
         self._last_sent = time.monotonic()
         self._maint_task: Optional[asyncio.Task] = None
 
+        # anti-replay state (AEAD nonce counters, one direction)
+        self._replay_max = -1
+        self._replay_seen: set = set()
+
+        # STUN / relay machinery
+        self._stun_waiters: Dict[bytes, asyncio.Future] = {}
+        self._relay_joined = asyncio.Event()
+
     # -- setup ------------------------------------------------------------
 
     @classmethod
@@ -109,6 +128,57 @@ class UdpChannel(Channel):
         """Install the derived session keys (before punching starts)."""
         self._box = box
 
+    # -- candidate discovery / relay fallback ------------------------------
+
+    async def stun_query(
+        self, servers: List[Tuple[str, int]], timeout: float = 3.0
+    ) -> Optional[Tuple[str, int]]:
+        """Reflexive (ip, port) of THIS socket via the first STUN server to
+        answer; None if none do.  Must run before/while punching — the
+        mapping only matches if the query leaves the same socket."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        txids = []
+        for addr in servers:
+            pkt, txid = stun.build_binding_request()
+            self._stun_waiters[txid] = fut
+            txids.append(txid)
+            try:
+                self._transport.sendto(pkt, addr)
+            except OSError as e:
+                log.debug("stun send to %s failed: %s", addr, e)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            log.info("no STUN response from %s within %.1fs", servers, timeout)
+            return None
+        finally:
+            for txid in txids:
+                self._stun_waiters.pop(txid, None)
+
+    async def join_relay(
+        self, relay_addr: Tuple[str, int], token: str, timeout: float = 5.0
+    ) -> None:
+        """Register with the pairing relay; raises TimeoutError if it never
+        acks.  After this, punching against [relay_addr] rides the relay."""
+        deadline = time.monotonic() + timeout
+        pkt = relay_mod.join_packet(token)
+        while not self._relay_joined.is_set():
+            try:
+                self._transport.sendto(pkt, relay_addr)
+            except OSError as e:
+                log.debug("relay join send failed: %s", e)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"relay {relay_addr} never acked join")
+            try:
+                await asyncio.wait_for(
+                    self._relay_joined.wait(), min(0.25, remaining)
+                )
+            except asyncio.TimeoutError:
+                continue
+        log.info("joined relay %s (token %s…)", relay_addr, token[:8])
+
     async def punch(
         self, candidates: List[Tuple[str, int]], timeout: float = 10.0
     ) -> None:
@@ -118,14 +188,16 @@ class UdpChannel(Channel):
         the peer address); raises TimeoutError otherwise.
         """
         assert self._box is not None, "set_session before punch"
-        self._maint_task = asyncio.create_task(self._maintenance())
+        if self._maint_task is None:
+            self._maint_task = asyncio.create_task(self._maintenance())
         deadline = time.monotonic() + timeout
         while not self._established.is_set():
             for addr in candidates:
                 self._send_control(PT_PUNCH, addr)
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                self.close()
+                # The socket stays usable: the caller may retry against a
+                # relay (connect.py fallback) or close the channel itself.
                 raise TimeoutError(f"hole punch failed after {timeout}s")
             try:
                 await asyncio.wait_for(
@@ -181,20 +253,45 @@ class UdpChannel(Channel):
     # -- receiving ---------------------------------------------------------
 
     def _on_datagram(self, wire: bytes, addr) -> None:
+        # Out-of-band control traffic first: STUN responses and relay acks
+        # are cleartext and structurally distinguishable from AEAD datagrams.
+        if stun.is_stun_packet(wire):
+            for txid, fut in list(self._stun_waiters.items()):
+                parsed = stun.parse_binding_response(wire, txid)
+                if parsed is not None and not fut.done():
+                    fut.set_result(parsed)
+                    break
+            return
+        if relay_mod.is_joined_packet(wire):
+            self._relay_joined.set()
+            return
         if self._box is None:
             return  # pre-handshake traffic: drop
         try:
-            pkt = self._box.open(wire)
+            ctr, pkt = self._box.open_ctr(wire)
         except CryptoError:
             log.debug("dropping unauthenticated datagram from %s", addr)
             return
         if not pkt:
             return
+        # Anti-replay: a captured datagram replayed from a spoofed source
+        # must not migrate the peer address or be delivered twice (ADVICE
+        # r2 low #5).  Window-based so UDP reordering still delivers.
+        if ctr <= self._replay_max - REPLAY_WINDOW or ctr in self._replay_seen:
+            log.debug("dropping replayed datagram ctr=%d from %s", ctr, addr)
+            return
+        self._replay_seen.add(ctr)
+        if ctr > self._replay_max:
+            self._replay_max = ctr
+            if len(self._replay_seen) > 2 * REPLAY_WINDOW:
+                floor = self._replay_max - REPLAY_WINDOW
+                self._replay_seen = {c for c in self._replay_seen if c > floor}
         self._last_heard = time.monotonic()
         ptype = pkt[0]
 
         # First authenticated packet locks the peer address (ICE-selected
-        # pair equivalent); later valid packets may migrate it (NAT rebind).
+        # pair equivalent); later valid fresh packets may migrate it (NAT
+        # rebind) — replays were dropped above.
         if self._peer_addr != addr:
             self._peer_addr = addr
         if not self._established.is_set():

@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Networked integration test (reference scripts/test-tunnel.sh:1-107 shape):
+# a signal server reachable over the network, a timestamped room, readiness
+# polling on peer LOGS (not just the port), curl direct vs through-tunnel.
+#
+# By default this targets the reference's public signal server URL; in an
+# egress-less environment point SIGNAL_URL at a deployed/containerized one,
+# or leave SELF_HOST=1 (default) to spin up the full networked stack —
+# signal server WITH a STUN responder, plus a UDP relay — and run the peers
+# against those *as network services* (every hop crosses a real socket).
+#
+#   SELF_HOST=0 SIGNAL_URL=wss://signal-server.fly.dev scripts/test-tunnel.sh
+set -u
+cd "$(dirname "$0")/.."
+
+LOGDIR=$(mktemp -d)
+ROOM="test-$(date +%s)"           # timestamped room (test-tunnel.sh:16)
+SELF_HOST=${SELF_HOST:-1}
+SIG_PORT=${SIG_PORT:-18788}
+STUN_PORT=${STUN_PORT:-13478}
+RELAY_PORT=${RELAY_PORT:-13479}
+MOCK_PORT=${MOCK_PORT:-13002}
+PROXY_PORT=${PROXY_PORT:-19000}
+SIGNAL_URL=${SIGNAL_URL:-ws://127.0.0.1:$SIG_PORT}
+PIDS=()
+
+cleanup() {
+  for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+  wait 2>/dev/null || true
+}
+trap cleanup EXIT
+
+fail() {
+  echo "FAIL: $1"
+  for f in mock signal relay serve proxy; do
+    echo "--- $f ---"; tail -20 "$LOGDIR/$f.log" 2>/dev/null
+  done
+  exit 1
+}
+
+echo "[1/6] mock upstream on :$MOCK_PORT"
+python -m p2p_llm_tunnel_tpu.testing.mock_llm --port "$MOCK_PORT" --pace 0.05 \
+  > "$LOGDIR/mock.log" 2>&1 &
+PIDS+=($!)
+
+if [ "$SELF_HOST" = 1 ]; then
+  echo "[2/6] signal server on :$SIG_PORT (+ STUN on :$STUN_PORT) and relay on :$RELAY_PORT"
+  python -m p2p_llm_tunnel_tpu.cli signal --port "$SIG_PORT" \
+    --stun-port "$STUN_PORT" > "$LOGDIR/signal.log" 2>&1 &
+  PIDS+=($!)
+  python -m p2p_llm_tunnel_tpu.cli relay --listen 127.0.0.1 \
+    --port "$RELAY_PORT" > "$LOGDIR/relay.log" 2>&1 &
+  PIDS+=($!)
+  sleep 1
+  STUN_ARGS=(--stun "127.0.0.1:$STUN_PORT" --relay "127.0.0.1:$RELAY_PORT")
+else
+  echo "[2/6] using external signal server $SIGNAL_URL"
+  STUN_ARGS=()
+fi
+
+echo "[3/6] serve peer (room $ROOM)"
+python -m p2p_llm_tunnel_tpu.cli serve \
+  --signal "$SIGNAL_URL" --room "$ROOM" \
+  --upstream "http://127.0.0.1:$MOCK_PORT" "${STUN_ARGS[@]}" \
+  > "$LOGDIR/serve.log" 2>&1 &
+PIDS+=($!)
+sleep 1
+
+echo "[4/6] proxy peer on :$PROXY_PORT"
+python -m p2p_llm_tunnel_tpu.cli proxy \
+  --signal "$SIGNAL_URL" --room "$ROOM" \
+  --listen "127.0.0.1:$PROXY_PORT" "${STUN_ARGS[@]}" \
+  > "$LOGDIR/proxy.log" 2>&1 &
+PIDS+=($!)
+
+echo "[5/6] polling peer logs for readiness (test-tunnel.sh:79-86)"
+ready=0
+for _ in $(seq 1 15); do
+  if grep -q "tunnel ready" "$LOGDIR/serve.log" 2>/dev/null \
+     && grep -q "proxy listening" "$LOGDIR/proxy.log" 2>/dev/null; then
+    ready=1; break
+  fi
+  sleep 1
+done
+[ "$ready" = 1 ] || fail "peers never logged readiness"
+
+echo "[6/6] curl direct vs through tunnel"
+direct=$(curl -s "http://127.0.0.1:$MOCK_PORT/v1/models")
+echo "$direct" | grep -q "test-model" || fail "direct upstream broken: $direct"
+
+via=$(curl -s "http://127.0.0.1:$PROXY_PORT/v1/models")
+[ "$via" = "$direct" ] || fail "through-tunnel response differs: $via vs $direct"
+
+body=$(curl -s "http://127.0.0.1:$PROXY_PORT/health")
+[ "$body" = "ok" ] || fail "/health returned: $body"
+
+echo "PASS: networked tunnel e2e (room $ROOM via $SIGNAL_URL, STUN+relay deployed)"

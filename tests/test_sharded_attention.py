@@ -1,0 +1,132 @@
+"""Sharded prefill attention paths (VERDICT r2 item 6).
+
+- the Pallas flash kernel shard_map'd over tp head-shards matches the dense
+  oracle (interpret mode on the CPU mesh);
+- ring attention composes with tp (heads AND sequence sharded);
+- full prefill under a tp mesh with flash enabled matches the einsum path;
+- the engine serves a prompt longer than one sp shard's sequence block.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+from p2p_llm_tunnel_tpu.ops.attention import causal_attention
+from p2p_llm_tunnel_tpu.ops.ring_attention import (
+    make_ring_attention,
+    ring_attention_reference,
+)
+from p2p_llm_tunnel_tpu.parallel import make_mesh
+
+
+def _qkv(key, b, t, h, kh, d):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), jnp.float32),
+        jax.random.normal(kk, (b, t, kh, d), jnp.float32),
+        jax.random.normal(kv, (b, t, kh, d), jnp.float32),
+    )
+
+
+def test_flash_tp_shardmap_matches_dense(cpu_devices):
+    """shard_map'd flash kernel over tp=2 head shards == dense oracle."""
+    from p2p_llm_tunnel_tpu.models.transformer import _prefill_attention_fn
+
+    mesh = make_mesh(tp=2, dp=1)
+    cfg = get_config(
+        "tiny", n_heads=4, n_kv_heads=2, head_dim=128,
+        flash=True, flash_interpret=True,
+    )
+    t = 256
+    q, k, v = _qkv(jax.random.PRNGKey(0), b=2, t=t, h=4, kh=2, d=128)
+    valid = jnp.ones((2, t), bool)
+    attn = _prefill_attention_fn(cfg, mesh, t)
+    got = jax.jit(lambda *a: attn(*a, None))(q, k, v, valid)
+    want = causal_attention(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_composes_with_tp(cpu_devices):
+    """Ring attention with heads sharded on tp AND sequence on sp."""
+    mesh = make_mesh(tp=2, dp=1, sp=4)
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=2, t=64, h=4, kh=2, d=16)
+    ring = make_ring_attention(mesh, "sp", head_axis="tp")
+    got = jax.jit(ring)(q, k, v)
+    want = ring_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_sp_mesh_matches_dense(cpu_devices):
+    """Full prefill forward under an sp=2/tp=2 mesh == unsharded prefill."""
+    cfg = get_config("tiny", n_heads=4, n_kv_heads=2, vocab_size=512)
+    assert cfg.sliding_window is None
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    b, t = 2, 64
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, cfg.vocab_size)
+    valid = jnp.ones((b, t), bool)
+
+    logits_ref, ks_ref, vs_ref = prefill(cfg, params, tokens, valid)
+
+    mesh = make_mesh(tp=2, dp=1, sp=2)
+    logits_s, ks_s, vs_s = jax.jit(
+        lambda p, tk, vl: prefill(cfg, p, tk, vl, mesh=mesh)
+    )(params, tokens, valid)
+    np.testing.assert_allclose(
+        np.asarray(logits_s), np.asarray(logits_ref), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ks_s), np.asarray(ks_ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_prefill_sp_rejects_sliding_window(cpu_devices):
+    cfg = get_config("tiny-gemma")
+    assert cfg.sliding_window is not None
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    mesh = make_mesh(tp=1, dp=1, sp=2)
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(NotImplementedError):
+        prefill(cfg, params, tokens, jnp.ones((1, 32), bool), mesh=mesh)
+
+
+def _collect(engine, prompt, n):
+    async def main():
+        await engine.start()
+        toks = []
+        async for ev in engine.generate(prompt, max_new_tokens=n, stop_ids=()):
+            toks.append(ev.token_id)
+        await engine.stop()
+        return toks
+
+    return asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_engine_sp_serves_long_prompt(cpu_devices):
+    """Engine on an sp=2 mesh serves a prompt spanning both sequence shards
+    (prompt 40 tokens -> bucket 64 -> 32 per shard) and matches the
+    single-chip engine stream."""
+    cfg = get_config("tiny", n_heads=4, n_kv_heads=2, vocab_size=512)
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    prompt = list(range(3, 43))  # 40 tokens > one sp shard's 32-token block
+
+    single = InferenceEngine(
+        model_cfg=cfg, params=params,
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=128,
+                                dtype="float32", decode_steps=4),
+    )
+    want = _collect(single, prompt, 8)
+
+    sp_engine = InferenceEngine(
+        model_cfg=cfg, params=params,
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=128,
+                                dtype="float32", decode_steps=4, sp=2),
+    )
+    assert dict(sp_engine.mesh.shape)["sp"] == 2
+    got = _collect(sp_engine, prompt, 8)
+    assert got == want
