@@ -147,11 +147,13 @@ async def _run_attempt(model: str) -> dict:
     from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
 
     clients = int(os.environ.get("BENCH_CLIENTS", "32"))
-    max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "96"))
+    max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "128"))
     slots = int(os.environ.get("BENCH_SLOTS", "32"))
     max_seq = int(os.environ.get("BENCH_MAX_SEQ", "512"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
+    eager_steps = int(os.environ.get("BENCH_DECODE_STEPS_EAGER", "4"))
+    prefill_rows = int(os.environ.get("BENCH_PREFILL_ROWS", "8"))
     quant = os.environ.get("BENCH_QUANT", "int8")
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
@@ -162,21 +164,28 @@ async def _run_attempt(model: str) -> dict:
         f"slots={slots} decode_steps={decode_steps} quant={quant}"
     )
     t0 = time.monotonic()
-    from p2p_llm_tunnel_tpu.engine.tokenizer import ByteTokenizer
+    from p2p_llm_tunnel_tpu.engine.tokenizer import NumericTokenizer
     from p2p_llm_tunnel_tpu.models.config import get_config
 
     # Keep the preset's REAL vocabulary (llama3: 128256) so the embed and
     # lm_head matmuls — ~12% of 8B decode HBM traffic — are benched at true
-    # size; the byte tokenizer just renders ids >= 256 as empty deltas.
+    # size.  NumericTokenizer renders EVERY sampled id as visible text, so
+    # each decoded token crosses the tunnel as a RES_BODY-framed SSE chunk
+    # and the headline number can be counted CLIENT-side (VERDICT r3
+    # item 3: the r3 run measured with the tunnel idle).
     engine = InferenceEngine(
         engine_cfg=EngineConfig(
             model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
-            decode_steps=decode_steps, quant=quant,
+            decode_steps=decode_steps, decode_steps_eager=eager_steps,
+            prefill_rows=prefill_rows, quant=quant,
         ),
-        tokenizer=ByteTokenizer(vocab_size=get_config(model).vocab_size),
+        tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
     _log(f"engine init (weights on device) took {time.monotonic() - t0:.1f}s")
     await engine.start()
+    t0 = time.monotonic()
+    await engine.warmup()
+    _log(f"decode warmup (view x steps compiles) took {time.monotonic() - t0:.1f}s")
 
     serve_ch, proxy_ch = loopback_pair()
     serve_task = asyncio.create_task(
@@ -234,13 +243,14 @@ async def _run_attempt(model: str) -> dict:
                 pass
         await engine.stop()
 
-    # Token count comes from the engine's counter: with random weights the
-    # byte-level SSE stream is mostly invisible UTF-8 fragments, so counting
-    # client-visible deltas would undercount real decoded tokens.  Wall time
-    # and TTFT are still measured at the HTTP client, end to end.
+    # Headline tok/s counts tokens RECEIVED BY THE HTTP CLIENTS as SSE
+    # deltas — every one crossed the tunnel as a RES_BODY frame, so frame
+    # mux + flow control + SSE emission are inside the measurement.  The
+    # engine counter is reported alongside as a cross-check (they differ
+    # only by surplus tokens decoded past a request's eviction).
     visible_tokens = sum(r["tokens"] for r in results)
     ttfts = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
-    tok_s = engine_tokens / wall if wall > 0 else 0.0
+    tok_s = visible_tokens / wall if wall > 0 else 0.0
     ttft_p50_ms = statistics.median(ttfts) * 1000.0 if ttfts else None
     n_params, peak_flops = _model_flops_params(model)
     return {
@@ -261,6 +271,7 @@ async def _run_attempt(model: str) -> dict:
         "model": model,
         "quant": quant,
         "clients": clients,
+        "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,
         "engine_tokens": engine_tokens,
         "visible_tokens": visible_tokens,
         "wall_s": round(wall, 2),
@@ -277,9 +288,18 @@ def _attempt_main(model: str, deadline_s: float) -> None:
         os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
-    if os.environ.get("BENCH_FORCE_CPU"):
-        import jax
+    import jax
 
+    # Persistent compilation cache: init/decode/prefill programs compile
+    # once per CONFIG ever, not once per process — r3 burned 245 s of the
+    # bench budget on compiles alone (VERDICT Weak #6).
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_CC_DIR", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     result = asyncio.run(_run_attempt(model))
     print(json.dumps(result), flush=True)

@@ -48,6 +48,10 @@ class ModelConfig:
     # Run the flash kernel in Pallas interpret mode even off-TPU — CPU-mesh
     # tests of the shard_map'd kernel path set this.
     flash_interpret: bool = False
+    # W8A8: quantize activations dynamically (per-token int8) so QTensor
+    # matmuls run as native int8×int8 MXU dots — set by the engine when
+    # EngineConfig.quant == "w8a8".  Inert for non-quantized params.
+    act_quant: bool = False
 
     @property
     def q_per_kv(self) -> int:

@@ -214,3 +214,18 @@ def test_stream_decoder_multibyte():
     dec = StreamDecoder(tok)
     out = "".join(dec.push(i) for i in ids)
     assert out == text
+
+
+def test_numeric_tokenizer_renders_every_id():
+    from p2p_llm_tunnel_tpu.engine.tokenizer import NumericTokenizer, StreamDecoder
+
+    tok = NumericTokenizer(vocab_size=128256)
+    assert tok.vocab_size == 128256
+    assert tok.decode_token(0) == "0 "
+    assert tok.decode_token(128255) == "128255 "
+    # StreamDecoder must flush every push immediately (no pending buffering)
+    dec = StreamDecoder(tok)
+    assert dec.push(42) == "42 "
+    assert dec.push(99999) == "99999 "
+    # encoding stays byte-level so prompts are valid ids
+    assert all(i < 256 for i in tok.encode("hello"))

@@ -191,3 +191,39 @@ def test_new_presets_instantiate():
         cfg = get_config(name)
         assert cfg.n_heads % cfg.n_kv_heads == 0
         assert cfg.dim  # smoke: fields populated
+
+
+def test_decode_kv_view_parity(model):
+    """A kv_view bucket covering every live position must reproduce the
+    full-cache decode logits exactly — the engine's length-bucketed decode
+    (attention HBM reads track context, not max_seq) must be invisible."""
+    cfg, params = model
+    t = 10
+    prompt_len = 6
+    max_seq = 32
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (1, t), 0, cfg.vocab_size)
+
+    cache_a = init_kv_cache(cfg, 2, max_seq, jnp.float32)
+    _, cache_a = prefill_into_cache(
+        cfg, params,
+        jnp.pad(tokens[:, :prompt_len], ((0, 0), (0, 2))),
+        jnp.array([prompt_len]), cache_a, jnp.array([0]),
+    )
+    cache_b = jax.tree.map(lambda x: x, cache_a)
+
+    for pos in range(prompt_len, t):
+        step_tokens = jnp.full((2,), int(tokens[0, pos]), jnp.int32)
+        step_pos = jnp.full((2,), pos, jnp.int32)
+        full, cache_a = decode_step(cfg, params, cache_a, step_tokens, step_pos)
+        view, cache_b = decode_step(
+            cfg, params, cache_b, step_tokens, step_pos, kv_view=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(full), np.asarray(view), rtol=1e-5, atol=1e-5,
+            err_msg=f"kv_view decode diverges at position {pos}",
+        )
+    # caches must stay identical too (writes target the full cache)
+    for k in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(cache_a[k]), np.asarray(cache_b[k]), rtol=0, atol=0
+        )

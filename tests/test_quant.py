@@ -85,3 +85,83 @@ def test_engine_with_int8(cpu_devices):
 
     toks = asyncio.run(asyncio.wait_for(main(), 120))
     assert len(toks) == 6
+
+
+def test_w8a8_mm_tracks_float():
+    """act_quant=True path: dynamic int8 activations × int8 weights."""
+    w = jax.random.normal(jax.random.PRNGKey(7), (64, 48), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(8), (5, 64), jnp.float32)
+    from p2p_llm_tunnel_tpu.models.quant import _quantize
+
+    qt = _quantize(w, axis=0)
+    got = np.asarray(mm(x, qt, act_quant=True))
+    want = np.asarray(x) @ np.asarray(w)
+    # two int8 quantizations compound: compare relative to magnitude
+    denom = np.abs(want).mean() + 1e-6
+    assert np.abs(got - want).mean() / denom < 0.05
+
+
+def test_w8a8_head_matmul_tracks_float():
+    from p2p_llm_tunnel_tpu.models.quant import _quantize, head_matmul
+
+    embed = jax.random.normal(jax.random.PRNGKey(9), (96, 64), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(10), (3, 64), jnp.float32)
+    qt = _quantize(embed, axis=1)  # per-vocab-row, as quantize_params does
+    got = np.asarray(head_matmul(x, qt, act_quant=True))
+    want = np.asarray(x) @ np.asarray(embed).T
+    denom = np.abs(want).mean() + 1e-6
+    assert np.abs(got - want).mean() / denom < 0.05
+
+
+def test_w8a8_prefill_tracks_fp32(cpu_devices):
+    """Full-model forward with dynamic activation quant stays close enough
+    for argmax agreement — the accuracy bar for using it in serving."""
+    from dataclasses import replace
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params(params)
+    aq_cfg = replace(cfg, act_quant=True)
+    tokens = jnp.arange(24)[None, :] % cfg.vocab_size
+    valid = jnp.ones_like(tokens, bool)
+    ref, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(params)
+    got, _, _ = jax.jit(lambda p: prefill(aq_cfg, p, tokens, valid))(qparams)
+    ref, got = np.asarray(ref), np.asarray(got)
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree > 0.85, f"argmax agreement too low: {agree}"
+
+
+def test_engine_with_w8a8(cpu_devices):
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2, quant="w8a8")
+    )
+    assert isinstance(eng.params["blocks"]["wq"], QTensor)
+    assert eng.mcfg.act_quant
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"quantized"), max_new_tokens=6,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 6
+
+
+def test_init_params_quantized_single_jit(cpu_devices):
+    """Whole-tree int8 init returns QTensor leaves with the right shapes."""
+    from p2p_llm_tunnel_tpu.models.quant import init_params_quantized
+
+    cfg = get_config("tiny")
+    params = init_params_quantized(cfg, jax.random.PRNGKey(0))
+    wq = params["blocks"]["wq"]
+    assert isinstance(wq, QTensor)
+    assert wq.q.dtype == jnp.int8
+    assert wq.q.shape == (cfg.n_layers, cfg.dim, cfg.n_heads * cfg.head_dim)
+    assert params["embed"].q.shape == (cfg.vocab_size, cfg.dim)

@@ -66,3 +66,32 @@ def test_jit_stable():
     a = f(logits, make_params(4), jax.random.PRNGKey(0))
     b = sample(logits, make_params(4), jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_all_greedy_batch_skips_stochastic_path():
+    """All-greedy batches take the lax.cond fast path; result is pure argmax
+    regardless of top_k/top_p settings (those only gate stochastic rows)."""
+    logits = logits_fixture()
+    params = SamplingParams(
+        temperature=jnp.zeros((4,)),
+        top_k=jnp.full((4,), 2, jnp.int32),
+        top_p=jnp.full((4,), 0.5),
+    )
+    out = sample(logits, params, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.argmax(np.asarray(logits), -1)
+    )
+
+
+def test_mixed_greedy_and_stochastic_rows_still_exact():
+    """One stochastic row forces the full path; greedy rows stay argmax."""
+    logits = logits_fixture()
+    params = SamplingParams(
+        temperature=jnp.array([0.0, 1.0, 0.0, 0.0]),
+        top_k=jnp.zeros((4,), jnp.int32),
+        top_p=jnp.ones((4,)),
+    )
+    out = np.asarray(sample(logits, params, jax.random.PRNGKey(4)))
+    ref = np.argmax(np.asarray(logits), -1)
+    for i in (0, 2, 3):
+        assert out[i] == ref[i]
