@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--relay", default=_env("TUNNEL_RELAY"),
                        help="relay host[:port] to fall back to when hole "
                             "punching fails (env TUNNEL_RELAY)")
+        p.add_argument("--relay-secret", default=_env("TUNNEL_RELAY_SECRET"),
+                       help="shared credential for an authenticated relay "
+                            "(env TUNNEL_RELAY_SECRET) — the --turn-user/"
+                            "--turn-pass surface of the reference")
 
     serve = sub.add_parser("serve", help="provider peer: expose an LLM")
     common(serve)
@@ -115,6 +119,9 @@ def build_parser() -> argparse.ArgumentParser:
                                        "(TURN-equivalent fallback)")
     rly.add_argument("--listen", default="0.0.0.0")
     rly.add_argument("--port", type=int, default=3479)
+    rly.add_argument("--secret", default=_env("TUNNEL_RELAY_SECRET"),
+                     help="require HMAC-authenticated JOINs with this shared "
+                          "credential (env TUNNEL_RELAY_SECRET)")
     return ap
 
 
@@ -167,7 +174,8 @@ async def _serve_once(args) -> None:
     if args.backend == "tpu":
         backend = await _engine_backend(args)
     channel, signaling = await connect(args.signal, args.room, args.transport,
-                                       stun_server=args.stun, relay=args.relay)
+                                       stun_server=args.stun, relay=args.relay,
+                                       relay_secret=args.relay_secret)
     try:
         if backend is not None:
             await run_serve(channel, backend=backend)
@@ -229,6 +237,11 @@ async def _engine_backend(args):
             [make_engine(i) for i in range(args.replicas)], args.model
         )
         await router.start()
+        # Pre-compile every decode variant BEFORE serving: a first-hit
+        # compile inside the live loop would stall the event loop past the
+        # transport's 15 s dead-peer timeout and kill the tunnel.
+        for eng in router.engines:
+            await eng.warmup()
         _BACKEND = router_backend(router)
     else:
         from p2p_llm_tunnel_tpu.engine.api import engine_backend
@@ -236,6 +249,8 @@ async def _engine_backend(args):
         log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
         engine = make_engine(0)
         await engine.start()
+        # See replica branch: compile all decode variants before traffic.
+        await engine.warmup()
         _BACKEND = engine_backend(engine, args.model)
     return _BACKEND
 
@@ -246,7 +261,8 @@ async def _proxy_once(args) -> None:
 
     host, _, port = args.listen.rpartition(":")
     channel, signaling = await connect(args.signal, args.room, args.transport,
-                                       stun_server=args.stun, relay=args.relay)
+                                       stun_server=args.stun, relay=args.relay,
+                                       relay_secret=args.relay_secret)
     try:
         await run_proxy(channel, host or "127.0.0.1", int(port))
     finally:
@@ -268,7 +284,7 @@ async def _amain(args) -> None:
     if args.command == "relay":
         from p2p_llm_tunnel_tpu.transport.relay import run_relay_server
 
-        await run_relay_server(args.listen, args.port)
+        await run_relay_server(args.listen, args.port, args.secret)
         return
 
     if not args.room:

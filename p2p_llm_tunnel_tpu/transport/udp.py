@@ -16,6 +16,14 @@ native equivalent built on a bare UDP socket:
   (real backpressure, which the reference lacks: SURVEY.md §7 hard-part 3).
   Messages are fragmented to MTU-sized packets and reassembled in order,
   preserving data-channel message boundaries.
+- **congestion control**: Jacobson/Karn RTT estimation drives the RTO
+  (srtt + 4·rttvar, Karn's rule skips retransmitted samples) and an AIMD
+  congestion window paces the sender — slow start to ssthresh, additive
+  growth after, multiplicative halving on timeout loss (at most once per
+  RTT).  The reference inherits all of this from SCTP inside the webrtc
+  crate (rtc.rs via Cargo.toml:14); this is the native equivalent, so
+  behavior under WAN loss degrades gracefully instead of retransmit-
+  storming at a fixed RTO floor (VERDICT r3 Weak #4).
 - **liveness**: keepalive probes every 5 s; the channel declares itself
   disconnected after 15 s of silence (the reference delegates this to the
   WebRTC state machine, rtc.rs:166-174).
@@ -46,9 +54,11 @@ log = get_logger(__name__)
 REPLAY_WINDOW = 4096  # counters older than max-seen minus this are dropped
 
 MTU_PAYLOAD = 1200  # fragment payload bytes per datagram
-WINDOW = 512  # max unacked packets in flight
+WINDOW = 512  # hard cap on unacked packets in flight (cwnd never exceeds it)
 RTO_MIN = 0.15
 RTO_MAX = 2.0
+CWND_INIT = 32  # initial congestion window (packets)
+CWND_MIN = 4  # floor after multiplicative decrease
 KEEPALIVE_INTERVAL = 5.0
 DEAD_TIMEOUT = 15.0
 PUNCH_INTERVAL = 0.25
@@ -91,10 +101,21 @@ class UdpChannel(Channel):
         self._window_free = asyncio.Event()
         self._window_free.set()
 
+        # congestion control (Jacobson RTO + AIMD window)
+        self._srtt: Optional[float] = None
+        self._rttvar = 0.0
+        self._rto = RTO_MAX / 2  # conservative until the first RTT sample
+        self._cwnd = float(CWND_INIT)
+        self._cwnd_cap = float(WINDOW)  # tightened by bind() from SO_RCVBUF
+        self._ssthresh = float(WINDOW)
+        self._last_backoff = 0.0
+        self._retransmits = 0  # total, for tests/metrics
+
         # receiver state
         self._recv_next = 0
         self._out_of_order: Dict[int, Tuple[bytes, bool]] = {}
         self._partial = bytearray()
+        self._ack_scheduled = False
 
         self._last_heard = time.monotonic()
         self._last_sent = time.monotonic()
@@ -107,6 +128,7 @@ class UdpChannel(Channel):
         # STUN / relay machinery
         self._stun_waiters: Dict[bytes, asyncio.Future] = {}
         self._relay_joined = asyncio.Event()
+        self._relay_reject: Optional[str] = None
 
     # -- setup ------------------------------------------------------------
 
@@ -118,11 +140,43 @@ class UdpChannel(Channel):
             lambda: _Proto(ch), local_addr=(host, port)
         )
         ch._transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            # A full ARQ window (512 × ~1.2 KB) must fit the peer's kernel
+            # receive buffer, or slow start overruns it and manufactures
+            # loss on a clean path.  Ask for 2 MB (the kernel clamps to
+            # rmem_max), then cap cwnd to what was actually granted — both
+            # peers run this same stack, so the local grant is a sound
+            # proxy for the remote one.
+            try:
+                sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 2 << 20)
+                sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 2 << 20)
+            except OSError:
+                pass
+            rcvbuf = sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF)
+            ch._cwnd_cap = float(
+                max(CWND_MIN, min(WINDOW, rcvbuf // (2 * MTU_PAYLOAD)))
+            )
         return ch
 
     @property
     def local_port(self) -> int:
         return self._transport.get_extra_info("sockname")[1]
+
+    @property
+    def congestion_stats(self) -> dict:
+        """Live ARQ/congestion state (observability + loss-injection tests)."""
+        return {
+            "srtt": self._srtt,
+            "rttvar": self._rttvar,
+            "rto": self._rto,
+            "cwnd": self._cwnd,
+            "ssthresh": self._ssthresh,
+            "retransmits": self._retransmits,
+            "in_flight": len(self._unacked),
+        }
 
     def set_session(self, box: SecureBox) -> None:
         """Install the derived session keys (before punching starts)."""
@@ -157,12 +211,15 @@ class UdpChannel(Channel):
                 self._stun_waiters.pop(txid, None)
 
     async def join_relay(
-        self, relay_addr: Tuple[str, int], token: str, timeout: float = 5.0
+        self, relay_addr: Tuple[str, int], token: str, timeout: float = 5.0,
+        secret: Optional[str] = None,
     ) -> None:
         """Register with the pairing relay; raises TimeoutError if it never
-        acks.  After this, punching against [relay_addr] rides the relay."""
+        acks.  After this, punching against [relay_addr] rides the relay.
+        ``secret`` authenticates the JOIN against a credentialed relay."""
         deadline = time.monotonic() + timeout
-        pkt = relay_mod.join_packet(token)
+        pkt = relay_mod.join_packet(token, secret)
+        self._relay_reject = None
         while not self._relay_joined.is_set():
             try:
                 self._transport.sendto(pkt, relay_addr)
@@ -177,6 +234,10 @@ class UdpChannel(Channel):
                 )
             except asyncio.TimeoutError:
                 continue
+        if self._relay_reject is not None:
+            reason, self._relay_reject = self._relay_reject, None
+            self._relay_joined.clear()
+            raise PermissionError(f"relay {relay_addr}: {reason}")
         log.info("joined relay %s (token %s…)", relay_addr, token[:8])
 
     async def punch(
@@ -227,6 +288,23 @@ class UdpChannel(Channel):
         if self._peer_addr is not None:
             self._send_raw(_ACK_HDR.pack(PT_ACK, self._recv_next), self._peer_addr)
 
+    def _schedule_ack(self) -> None:
+        """Coalesced (delayed) ACK: one cumulative ACK per event-loop batch
+        of arrivals instead of one per data packet.  Per-packet ACKs under a
+        full-window burst overflow the sender's UDP receive buffer, and the
+        lost tail ACKs then masquerade as packet loss (spurious RTO
+        retransmits + cwnd collapse on a clean path)."""
+        if self._ack_scheduled:
+            return
+        self._ack_scheduled = True
+
+        def flush() -> None:
+            self._ack_scheduled = False
+            if not self.is_closed:
+                self._send_ack()
+
+        asyncio.get_running_loop().call_soon(flush)
+
     # -- sending (reliable) -----------------------------------------------
 
     async def _send_impl(self, data: bytes) -> None:
@@ -238,7 +316,7 @@ class UdpChannel(Channel):
         offsets = range(0, len(data), MTU_PAYLOAD) if data else [0]
         frags = [data[o : o + MTU_PAYLOAD] for o in offsets]
         for i, frag in enumerate(frags):
-            while len(self._unacked) >= WINDOW:
+            while len(self._unacked) >= int(min(self._cwnd_cap, self._cwnd)):
                 self._window_free.clear()
                 await self._window_free.wait()
                 if self.is_closed:
@@ -263,6 +341,14 @@ class UdpChannel(Channel):
                     break
             return
         if relay_mod.is_joined_packet(wire):
+            self._relay_joined.set()
+            return
+        if relay_mod.is_reject_packet(wire):
+            # Explicit relay NACK (auth required / bad credentials): record
+            # the reason and wake join_relay so it fails fast and clearly
+            # instead of timing out indistinguishably from an unreachable
+            # relay.
+            self._relay_reject = relay_mod.reject_reason(wire)
             self._relay_joined.set()
             return
         if self._box is None:
@@ -314,14 +400,50 @@ class UdpChannel(Channel):
 
     def _handle_ack(self, cum: int) -> None:
         # cumulative: everything strictly below `cum` is delivered.
+        now = time.monotonic()
+        newly_acked = 0
         for seq in [s for s in self._unacked if _seq_lt(s, cum)]:
-            del self._unacked[seq]
-        if len(self._unacked) < WINDOW:
+            pkt, sent_at, tries = self._unacked.pop(seq)
+            newly_acked += 1
+            if tries == 0:
+                # Karn's rule: only never-retransmitted packets give an
+                # unambiguous RTT sample.
+                self._rtt_sample(now - sent_at)
+        if newly_acked:
+            # AIMD growth: slow start doubles per RTT (+1 per acked packet),
+            # congestion avoidance adds ~1 packet per RTT (+n/cwnd).
+            if self._cwnd < self._ssthresh:
+                self._cwnd = min(self._cwnd_cap, self._cwnd + newly_acked)
+            else:
+                self._cwnd = min(
+                    self._cwnd_cap, self._cwnd + newly_acked / self._cwnd
+                )
+        if len(self._unacked) < int(min(self._cwnd_cap, self._cwnd)):
             self._window_free.set()
+
+    def _rtt_sample(self, rtt: float) -> None:
+        """Jacobson/Karels estimator: rto = srtt + 4·rttvar, clamped."""
+        if self._srtt is None:
+            self._srtt = rtt
+            self._rttvar = rtt / 2
+        else:
+            self._rttvar = 0.75 * self._rttvar + 0.25 * abs(self._srtt - rtt)
+            self._srtt = 0.875 * self._srtt + 0.125 * rtt
+        self._rto = min(RTO_MAX, max(RTO_MIN, self._srtt + 4 * self._rttvar))
+
+    def _on_timeout_loss(self, now: float) -> None:
+        """Multiplicative decrease, at most once per RTT (a whole window lost
+        to one congestion event must not collapse cwnd to the floor)."""
+        if now - self._last_backoff < (self._srtt or self._rto):
+            return
+        self._last_backoff = now
+        self._ssthresh = max(float(CWND_MIN), self._cwnd / 2)
+        self._cwnd = self._ssthresh
+        log.debug("congestion backoff: cwnd=%.0f rto=%.3f", self._cwnd, self._rto)
 
     def _handle_data(self, seq: int, fin: bool, payload: bytes) -> None:
         if _seq_lt(seq, self._recv_next):
-            self._send_ack()  # duplicate of already-delivered packet
+            self._send_ack()  # duplicate (likely a lost ACK): re-ack NOW
             return
         self._out_of_order[seq] = (payload, fin)
         while self._recv_next in self._out_of_order:
@@ -331,7 +453,7 @@ class UdpChannel(Channel):
             if is_fin:
                 self._deliver(bytes(self._partial))
                 self._partial.clear()
-        self._send_ack()
+        self._schedule_ack()
 
     # -- maintenance -------------------------------------------------------
 
@@ -347,10 +469,33 @@ class UdpChannel(Channel):
                                     DEAD_TIMEOUT)
                         self.close()
                         return
-                    for seq, (pkt, sent_at, tries) in list(self._unacked.items()):
-                        rto = min(RTO_MAX, RTO_MIN * (2 ** min(tries, 4)))
+                    # Pace retransmissions by the (just-halved) cwnd: a
+                    # whole-window burst loss expires in one tick, and
+                    # resending it all back-to-back would blast the same
+                    # burst into the queue that just dropped it.  Unsent
+                    # expirees go out on later ticks (their sent_at is
+                    # untouched), naturally staggered.
+                    budget = max(CWND_MIN, int(min(self._cwnd, self._cwnd_cap)))
+                    resent = 0
+                    # Oldest-first in mod-2^32 sequence space: in-flight
+                    # seqs live in [next_seq - W, next_seq), so this key is
+                    # smallest for the packet the peer's cumulative ACK is
+                    # blocked on — a plain numeric sort would invert at the
+                    # u32 wrap and starve it of the per-tick budget.
+                    base = self._next_seq
+                    for seq, (pkt, sent_at, tries) in sorted(
+                        self._unacked.items(),
+                        key=lambda kv: (kv[0] - base) & 0xFFFFFFFF,
+                    ):
+                        if resent >= budget:
+                            break
+                        # Estimated RTO with exponential backoff per retry.
+                        rto = min(RTO_MAX, self._rto * (2 ** min(tries, 4)))
                         if now - sent_at >= rto:
+                            self._on_timeout_loss(now)
                             self._unacked[seq] = (pkt, now, tries + 1)
+                            self._retransmits += 1
+                            resent += 1
                             self._send_raw(pkt, self._peer_addr)
                     # Keepalive gates on time-since-last-SENT and uses PUNCH
                     # (which elicits a PUNCH_ACK), so an idle-but-healthy

@@ -258,3 +258,262 @@ def test_replayed_data_not_delivered_twice():
             a.close(); b.close()
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# trickled candidates (sent, not just received)
+# ---------------------------------------------------------------------------
+
+class _DelayedStun(asyncio.DatagramProtocol):
+    """STUN responder that answers after ``delay`` seconds — forces the
+    reflexive candidate to miss the offer/answer and arrive TRICKLED."""
+
+    def __init__(self, delay: float):
+        self._delay = delay
+        self._transport = None
+
+    def connection_made(self, transport):
+        self._transport = transport
+
+    def datagram_received(self, data, addr):
+        if not is_stun_packet(data):
+            return
+        txid = data[8:20]
+
+        async def reply():
+            await asyncio.sleep(self._delay)
+            self._transport.sendto(build_binding_response(txid, addr), addr)
+
+        asyncio.get_running_loop().create_task(reply())
+
+
+def test_punch_succeeds_only_via_trickled_candidate(monkeypatch):
+    """VERDICT r3 item 7: every advertised candidate is a blackhole for BOTH
+    peers, so the SDP exchange alone cannot connect them.  The reflexive
+    address arrives from STUN *after* the offer/answer (delayed responder),
+    must be SENT via signaling send_candidate, received by the peer's
+    trickle collector, and punched — proving the late-candidate path works
+    end to end in both directions."""
+
+    async def run():
+        server = SignalServer("127.0.0.1", 0)
+        await server.start()
+        loop = asyncio.get_running_loop()
+        stun_transport, _ = await loop.create_datagram_endpoint(
+            lambda: _DelayedStun(delay=1.2), local_addr=("127.0.0.1", 0)
+        )
+        stun_port = stun_transport.get_extra_info("sockname")[1]
+        url = f"ws://127.0.0.1:{server.port}"
+
+        # Every up-front candidate is a blackhole: punching can only succeed
+        # through the late reflexive address (which, with a loopback STUN
+        # server, is the channel's true 127.0.0.1 endpoint).
+        monkeypatch.setattr(
+            connect_mod, "_udp_candidates", lambda *a, **k: [["127.0.0.1", 9]]
+        )
+
+        async def peer():
+            return await connect(
+                url, "trickle-e2e", "udp", timeout=25.0,
+                stun_server=f"127.0.0.1:{stun_port}",
+            )
+
+        (ch_a, sig_a), (ch_b, sig_b) = await asyncio.gather(peer(), peer())
+        try:
+            await ch_a.send(b"punched late")
+            assert await asyncio.wait_for(ch_b.recv(), 5.0) == b"punched late"
+            await ch_b.send(b"ack")
+            assert await asyncio.wait_for(ch_a.recv(), 5.0) == b"ack"
+        finally:
+            for ch in (ch_a, ch_b):
+                ch.close()
+            for sig in (sig_a, sig_b):
+                await sig.close()
+            stun_transport.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_blackholed_candidates_without_trickle_fail(monkeypatch):
+    """Control for the trickle test: the same sabotage WITHOUT a STUN server
+    must time out — proving the success above really came from the trickled
+    candidate, not some other path."""
+
+    async def run():
+        server = SignalServer("127.0.0.1", 0)
+        await server.start()
+        url = f"ws://127.0.0.1:{server.port}"
+        monkeypatch.setattr(
+            connect_mod, "_udp_candidates", lambda *a, **k: [["127.0.0.1", 9]]
+        )
+        monkeypatch.setattr(connect_mod, "PUNCH_TIMEOUT", 1.0)
+
+        async def peer():
+            return await connect(url, "trickle-ctl", "udp", timeout=10.0)
+
+        with pytest.raises(connect_mod.ConnectError):
+            try:
+                results = await asyncio.gather(
+                    peer(), peer(), return_exceptions=True
+                )
+                for r in results:
+                    if isinstance(r, BaseException):
+                        raise r
+                    ch, sig = r
+                    ch.close()
+                    await sig.close()
+            finally:
+                await server.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# relay auth (credentialed relay — reference --turn-user/--turn-pass surface)
+# ---------------------------------------------------------------------------
+
+def _mk_relay(secret=None):
+    from p2p_llm_tunnel_tpu.transport.relay import RelayServer
+
+    class _Cap:
+        def __init__(self):
+            self.out = []
+
+        def sendto(self, data, addr):
+            self.out.append((data, addr))
+
+    srv = RelayServer(secret)
+    cap = _Cap()
+    srv.connection_made(cap)
+    return srv, cap
+
+
+def test_relay_requires_valid_mac_when_secret_set():
+    from p2p_llm_tunnel_tpu.transport.relay import (
+        MAGIC_JOINED, join_packet,
+    )
+
+    from p2p_llm_tunnel_tpu.transport.relay import (
+        MAGIC_REJECT, RJ_AUTH_REQUIRED, RJ_BAD_AUTH,
+    )
+
+    srv, cap = _mk_relay(secret="s3cret")
+    # plain (unauthenticated) JOIN: NACKed with auth-required
+    srv.datagram_received(join_packet("tok"), ("10.0.0.1", 1111))
+    assert cap.out[-1][0] == MAGIC_REJECT + bytes([RJ_AUTH_REQUIRED])
+    # wrong secret: NACKed with bad-auth
+    srv.datagram_received(join_packet("tok", secret="wrong"), ("10.0.0.1", 1111))
+    assert cap.out[-1][0] == MAGIC_REJECT + bytes([RJ_BAD_AUTH])
+    # correct secret: JOINED ack
+    srv.datagram_received(join_packet("tok", secret="s3cret"), ("10.0.0.1", 1111))
+    assert cap.out[-1][0] == MAGIC_JOINED
+
+
+def test_relay_rejects_stale_authenticated_join():
+    import time as _time
+
+    from p2p_llm_tunnel_tpu.transport.relay import AUTH_WINDOW, join_packet
+
+    from p2p_llm_tunnel_tpu.transport.relay import MAGIC_REJECT, RJ_BAD_AUTH
+
+    srv, cap = _mk_relay(secret="s3cret")
+    old = _time.time() - AUTH_WINDOW - 60
+    srv.datagram_received(
+        join_packet("tok", secret="s3cret", now=old), ("10.0.0.2", 2222)
+    )
+    # stale JOIN must not pair — it gets a bad-auth NACK, never a JOINED
+    assert [d for d, _ in cap.out] == [MAGIC_REJECT + bytes([RJ_BAD_AUTH])]
+
+
+def test_open_relay_accepts_both_join_forms():
+    from p2p_llm_tunnel_tpu.transport.relay import MAGIC_JOINED, join_packet
+
+    srv, cap = _mk_relay(secret=None)
+    srv.datagram_received(join_packet("tok"), ("10.0.0.1", 1111))
+    srv.datagram_received(join_packet("tok", secret="any"), ("10.0.0.3", 3333))
+    assert [d for d, _ in cap.out] == [MAGIC_JOINED, MAGIC_JOINED]
+    # and the two sources are now paired: data forwards
+    cap.out.clear()
+    srv.datagram_received(b"ciphertext", ("10.0.0.1", 1111))
+    assert cap.out == [(b"ciphertext", ("10.0.0.3", 3333))]
+
+
+def test_authenticated_relay_end_to_end(monkeypatch):
+    """Full connect() with sabotage-forced relay fallback AND a relay secret:
+    only peers holding the credential can pair."""
+
+    async def run():
+        server = SignalServer("127.0.0.1", 0)
+        await server.start()
+        transport, rport = await start_relay_server(
+            "127.0.0.1", secret="hunter2"
+        )
+        relay = f"127.0.0.1:{rport}"
+        url = f"ws://127.0.0.1:{server.port}"
+        monkeypatch.setattr(
+            connect_mod, "_udp_candidates", lambda *a, **k: [["127.0.0.1", 9]]
+        )
+        monkeypatch.setattr(connect_mod, "PUNCH_TIMEOUT", 1.0)
+
+        async def peer():
+            return await connect(url, "relay-auth", "udp", timeout=20.0,
+                                 relay=relay, relay_secret="hunter2")
+
+        (ch_a, sig_a), (ch_b, sig_b) = await asyncio.gather(peer(), peer())
+        try:
+            await ch_a.send(b"authed relay")
+            assert await asyncio.wait_for(ch_b.recv(), 5.0) == b"authed relay"
+        finally:
+            for ch in (ch_a, ch_b):
+                ch.close()
+            for sig in (sig_a, sig_b):
+                await sig.close()
+            transport.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_relay_rejects_replayed_join_from_other_source():
+    """A captured authenticated JOIN resent from a different address must
+    not occupy a pairing slot (nonce pinned to first source); the same
+    bytes from the SAME source stay idempotent (join retries)."""
+    from p2p_llm_tunnel_tpu.transport.relay import MAGIC_JOINED, join_packet
+
+    srv, cap = _mk_relay(secret="s3cret")
+    pkt = join_packet("tok", secret="s3cret")
+    srv.datagram_received(pkt, ("10.0.0.1", 1111))
+    assert len(cap.out) == 1 and cap.out[0][0] == MAGIC_JOINED
+    # retry from the same source: idempotent ack
+    srv.datagram_received(pkt, ("10.0.0.1", 1111))
+    assert len(cap.out) == 2
+    # replay from an attacker: dropped, no slot consumed
+    srv.datagram_received(pkt, ("6.6.6.6", 666))
+    assert len(cap.out) == 2
+    # the legitimate second peer still pairs
+    pkt_b = join_packet("tok", secret="s3cret")
+    srv.datagram_received(pkt_b, ("10.0.0.2", 2222))
+    assert len(cap.out) == 3
+    cap.out.clear()
+    srv.datagram_received(b"ct", ("10.0.0.1", 1111))
+    assert cap.out == [(b"ct", ("10.0.0.2", 2222))]
+
+
+def test_client_join_relay_fails_fast_on_auth_reject():
+    """A client without the credential against a secret-bearing relay gets
+    an explicit PermissionError naming the auth problem — not an opaque
+    join timeout (undiagnosable-misconfig finding, r4 review)."""
+    import pytest as _pytest
+
+    async def run():
+        transport, rport = await start_relay_server("127.0.0.1", secret="s")
+        ch = await UdpChannel.bind("127.0.0.1")
+        try:
+            with _pytest.raises(PermissionError, match="auth"):
+                await ch.join_relay(("127.0.0.1", rport), "tok", timeout=5.0)
+        finally:
+            ch.close()
+            transport.close()
+
+    asyncio.run(run())
