@@ -344,3 +344,43 @@ def test_hop_by_hop_headers_stripped():
             assert seen.get("connection") != "keep-alive"
 
     asyncio.run(run())
+
+
+def test_simple_upstream_prefix_strip_through_tunnel():
+    """C13 fixture (reference tmp/test_upstream.py): prefix-less upstream
+    routes (/models, /chat/completions) served through the tunnel with
+    --advertise /v1 stripping the prefix end-to-end."""
+    from p2p_llm_tunnel_tpu.testing.simple_upstream import (
+        create_simple_upstream_handler,
+    )
+
+    async def main():
+        async with tunnel_stack(
+            upstream_handler=create_simple_upstream_handler(), advertise="/v1"
+        ) as base:
+            resp = await http11.http_request("GET", f"{base}/v1/models", {}, b"")
+            assert resp.status == 200
+            body = json.loads(b''.join([c async for c in resp.iter_chunks()]))
+            assert body["data"][0]["id"] == "simple-model"
+
+            resp = await http11.http_request(
+                "POST",
+                f"{base}/v1/chat/completions",
+                {"content-type": "application/json"},
+                json.dumps(
+                    {"messages": [{"role": "user", "content": "ping"}]}
+                ).encode(),
+            )
+            assert resp.status == 200
+            body = json.loads(b''.join([c async for c in resp.iter_chunks()]))
+            assert body["choices"][0]["message"]["content"] == "echo: ping"
+
+            # non-matching path passes through UNCHANGED (serve.rs:177-184):
+            # /models hits the upstream's /models route directly
+            resp = await http11.http_request("GET", f"{base}/models", {}, b"")
+            assert resp.status == 200
+            # ...but a prefixed path that strips to nothing real 404s
+            resp = await http11.http_request("GET", f"{base}/v1/nope", {}, b"")
+            assert resp.status == 404
+
+    asyncio.run(asyncio.wait_for(main(), 30))

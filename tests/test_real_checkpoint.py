@@ -1,0 +1,153 @@
+"""Opt-in end-to-end smoke test with a REAL pretrained checkpoint.
+
+VERDICT r3 item 8: the HF-conversion path (models/checkpoint.py convert_hf)
+is exercised by synthetic trees in test_checkpoint.py; this test closes the
+loop with actual pretrained weights — load → quantize → serve → stream
+coherent greedy text through the tunnel via /v1/chat/completions.
+
+Opt-in because the CI image has no model weights and no network egress:
+set ``TUNNEL_HF_CKPT`` to a local HuggingFace checkpoint directory (config
++ safetensors + tokenizer) of a llama- or gemma2-family model, e.g.
+
+    TUNNEL_HF_CKPT=/models/Llama-3.2-1B TUNNEL_HF_FAMILY=llama \\
+        python -m pytest tests/test_real_checkpoint.py -v
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+CKPT = os.environ.get("TUNNEL_HF_CKPT")
+FAMILY = os.environ.get("TUNNEL_HF_FAMILY", "llama")
+
+pytestmark = pytest.mark.skipif(
+    not CKPT or not os.path.isdir(CKPT),
+    reason="TUNNEL_HF_CKPT not set / not a directory (opt-in weights test)",
+)
+
+
+def _load_hf_params_and_cfg():
+    """Read an HF checkpoint directory into (ModelConfig, params, tokenizer)
+    without network access."""
+    import numpy as np
+
+    from p2p_llm_tunnel_tpu.engine.tokenizer import HFTokenizer
+    from p2p_llm_tunnel_tpu.models.checkpoint import convert_hf
+    from p2p_llm_tunnel_tpu.models.config import ModelConfig
+
+    with open(os.path.join(CKPT, "config.json")) as f:
+        hf = json.load(f)
+    cfg = ModelConfig(
+        name=os.path.basename(CKPT.rstrip("/")),
+        vocab_size=hf["vocab_size"],
+        dim=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get(
+            "head_dim", hf["hidden_size"] // hf["num_attention_heads"]
+        ),
+        ffn_dim=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+    state = {}
+    try:
+        from safetensors import safe_open
+
+        for fn in sorted(os.listdir(CKPT)):
+            if fn.endswith(".safetensors"):
+                with safe_open(os.path.join(CKPT, fn), framework="np") as f:
+                    for k in f.keys():
+                        state[k] = f.get_tensor(k)
+    except ImportError:
+        import torch
+
+        for fn in sorted(os.listdir(CKPT)):
+            if fn.endswith(".bin"):
+                sd = torch.load(
+                    os.path.join(CKPT, fn), map_location="cpu",
+                    weights_only=True,
+                )
+                for k, v in sd.items():
+                    state[k] = v.to(torch.float32).numpy()
+    if not state:
+        pytest.skip("no safetensors/bin weight files found in TUNNEL_HF_CKPT")
+
+    params = convert_hf(FAMILY, state, cfg)
+    tok = HFTokenizer(CKPT)
+    return cfg, params, tok
+
+
+def test_real_checkpoint_streams_coherent_text():
+    from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+    from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy
+    from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+    from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
+
+    cfg, params, tok = _load_hf_params_and_cfg()
+
+    async def main():
+        engine = InferenceEngine(
+            model_cfg=cfg,
+            engine_cfg=EngineConfig(
+                model=cfg.name, num_slots=2, max_seq=256,
+                decode_steps=4, quant="int8",
+            ),
+            params=params,
+            tokenizer=tok,
+        )
+        await engine.start()
+        serve_ch, proxy_ch = loopback_pair()
+        serve_task = asyncio.create_task(
+            run_serve(serve_ch, backend=engine_backend(engine, cfg.name))
+        )
+        ready: asyncio.Future = asyncio.get_running_loop().create_future()
+        proxy_task = asyncio.create_task(
+            run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready)
+        )
+        port = await asyncio.wait_for(ready, 30.0)
+        try:
+            resp = await http_request(
+                "POST",
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                {"content-type": "application/json"},
+                json.dumps(
+                    {
+                        "messages": [
+                            {"role": "user", "content": "The capital of France is"}
+                        ],
+                        "max_tokens": 12,
+                        "temperature": 0.0,
+                        "stream": False,
+                    }
+                ).encode(),
+                timeout=600.0,
+            )
+            assert resp.status == 200
+            body = json.loads(
+                b"".join([c async for c in resp.iter_chunks()])
+            )
+            text = body["choices"][0]["message"]["content"]
+            # Coherence bar: real weights under greedy decode must produce
+            # words, not noise — "Paris" for any competent base model.
+            assert text.strip(), "model produced no text"
+            assert any(c.isalpha() for c in text)
+            print(f"model output: {text!r}")
+        finally:
+            serve_task.cancel()
+            proxy_task.cancel()
+            for t in (serve_task, proxy_task):
+                try:
+                    await t
+                except (asyncio.CancelledError, RuntimeError):
+                    pass
+            await engine.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 1200))
