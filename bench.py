@@ -155,13 +155,16 @@ async def _run_attempt(model: str) -> dict:
     eager_steps = int(os.environ.get("BENCH_DECODE_STEPS_EAGER", "4"))
     prefill_rows = int(os.environ.get("BENCH_PREFILL_ROWS", "8"))
     quant = os.environ.get("BENCH_QUANT", "int8")
+    pf8 = os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
+    flash_decode = os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
 
     _log(
         f"attempt model={model} clients={clients} max_tokens={max_tokens} "
-        f"slots={slots} decode_steps={decode_steps} quant={quant}"
+        f"slots={slots} decode_steps={decode_steps} quant={quant} "
+        f"prefill_act_quant={pf8} flash_decode={flash_decode}"
     )
     t0 = time.monotonic()
     from p2p_llm_tunnel_tpu.engine.tokenizer import NumericTokenizer
@@ -178,6 +181,7 @@ async def _run_attempt(model: str) -> dict:
             model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
             decode_steps=decode_steps, decode_steps_eager=eager_steps,
             prefill_rows=prefill_rows, quant=quant,
+            prefill_act_quant=pf8, flash_decode=flash_decode,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -270,6 +274,8 @@ async def _run_attempt(model: str) -> dict:
         "mfu": round(tok_s * 2 * n_params / peak_flops, 4),
         "model": model,
         "quant": quant,
+        "prefill_act_quant": pf8,
+        "flash_decode": flash_decode,
         "clients": clients,
         "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,
         "engine_tokens": engine_tokens,

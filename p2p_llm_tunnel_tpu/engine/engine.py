@@ -16,7 +16,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 import jax
@@ -72,9 +72,19 @@ class EngineConfig:
     sp: int = 1
     # Optional orbax checkpoint to load instead of random init.
     ckpt_path: Optional[str] = None
-    # Weight quantization: "none" | "int8" (weight-only, per-channel).
+    # Weight quantization: "none" | "int8" (weight-only, per-channel) |
+    # "w8a8" (also quantize activations dynamically; int8 MXU dots).
     # Halves decode HBM traffic and fits 8B-class models on a 16 GB chip.
     quant: str = "none"
+    # Use the Pallas decode-attention kernel on TPU-tileable shapes
+    # (models/config.py flash_decode).  Off by default pending on-hardware
+    # measurement; correctness is oracle-pinned (tests/test_pallas_decode).
+    flash_decode: bool = False
+    # With quant="int8": ALSO run activations int8 during PREFILL only.
+    # Prefill is MXU-compute-bound (hundreds of tokens per row) where int8
+    # doubles throughput; decode stays weight-only (it is HBM-bound, w8a8
+    # measured at parity there — PERF.md) for best accuracy per token.
+    prefill_act_quant: bool = False
 
 
 @dataclass
@@ -109,6 +119,8 @@ class InferenceEngine:
         self.mcfg = model_cfg or get_config(
             self.ecfg.model, vocab_size=self.tokenizer.vocab_size
         )
+        if self.ecfg.flash_decode and not self.mcfg.flash_decode:
+            self.mcfg = dc_replace(self.mcfg, flash_decode=True)
         dtype = jnp.dtype(self.ecfg.dtype)
         key = jax.random.PRNGKey(self.ecfg.seed)
         if params is None:
@@ -138,11 +150,9 @@ class InferenceEngine:
                 log.info("quantizing weights to int8 (per-channel, weight-only)")
                 params = quantize_params(params)
             if self.ecfg.quant == "w8a8" and not self.mcfg.act_quant:
-                from dataclasses import replace
-
                 # int8 weights AND dynamic int8 activations: QTensor matmuls
                 # become native int8 MXU dots (models/quant.py _int8_dot).
-                self.mcfg = replace(self.mcfg, act_quant=True)
+                self.mcfg = dc_replace(self.mcfg, act_quant=True)
         elif self.ecfg.quant not in ("none", ""):
             raise ValueError(f"unknown quant mode {self.ecfg.quant!r}")
         if mesh is None and (self.ecfg.tp > 1 or self.ecfg.sp > 1):
@@ -175,6 +185,13 @@ class InferenceEngine:
             # engine's dp axis is 1 — replica routing is a layer above).
             self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
         self.scheduler = Scheduler(b, s)
+
+        # Prefill may run a hotter quant mode than decode (prefill_act_quant):
+        # a separate static config for the prefill program only.
+        self._prefill_mcfg = self.mcfg
+        if (self.ecfg.prefill_act_quant and self.ecfg.quant == "int8"
+                and not self.mcfg.act_quant):
+            self._prefill_mcfg = dc_replace(self.mcfg, act_quant=True)
 
         # Host-side per-slot state driving each decode step.
         self._last_token = np.zeros((rows,), np.int32)
@@ -252,7 +269,7 @@ class InferenceEngine:
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
-            self.mcfg, params, tokens, lengths, kv_cache, slots,
+            self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
             mesh=self.mesh,
         )
         first = sampling.sample(last_logits, samp, key)

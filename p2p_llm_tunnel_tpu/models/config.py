@@ -52,6 +52,11 @@ class ModelConfig:
     # matmuls run as native int8×int8 MXU dots — set by the engine when
     # EngineConfig.quant == "w8a8".  Inert for non-quantized params.
     act_quant: bool = False
+    # Use the Pallas decode-attention kernel (ops/pallas_decode_attention)
+    # for slot decode when the backend is TPU and shapes tile (view and
+    # head_dim % 128 == 0).  Off by default: the einsum path is the oracle;
+    # flip on once measured faster for the target config.
+    flash_decode: bool = False
 
     @property
     def q_per_kv(self) -> int:

@@ -351,6 +351,35 @@ def decode_step(
     layer_idx = jnp.arange(cfg.n_layers)
     slot_ids = jnp.arange(b)
 
+    use_flash = (
+        cfg.flash_decode
+        and (jax.default_backend() == "tpu" or cfg.flash_interpret)
+        and kv_view % 128 == 0
+        and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
+    )
+    if use_flash:
+        from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+            flash_decode_attention,
+        )
+
+        def attention(q, k_l, v_l, idx):
+            win = _layer_window(cfg, idx, s)
+            return flash_decode_attention(
+                q, k_l, v_l, positions,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=win,
+                interpret=cfg.flash_interpret,
+            )
+    else:
+        def attention(q, k_l, v_l, idx):
+            return cached_attention(
+                q, k_l, v_l, positions,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=_layer_window(cfg, idx, s),
+            )
+
     def step(carry, xs):
         x, k_cache, v_cache = carry
         blk, idx = xs
@@ -366,12 +395,7 @@ def decode_step(
         start = (idx, zero, zero, zero, zero)
         k_l = jax.lax.dynamic_slice(k_cache, start, view_shape)[0]
         v_l = jax.lax.dynamic_slice(v_cache, start, view_shape)[0]
-        attn = cached_attention(
-            q, k_l, v_l, positions,
-            scale=cfg.query_scale,
-            softcap=cfg.attn_softcap,
-            window=_layer_window(cfg, idx, s),
-        )
+        attn = attention(q, k_l, v_l, idx)
         attn = mm(attn.reshape(b, 1, -1), blk["wo"], cfg.act_quant)
         if cfg.post_norms:
             attn = _norm(cfg, attn, blk["post_attn_norm"])

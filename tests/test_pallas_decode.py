@@ -1,0 +1,125 @@
+"""Pallas decode-attention kernel vs the einsum oracle.
+
+Mirrors tests/test_pallas_attention.py's strategy for the prefill kernel:
+interpret mode on CPU, cached_attention (ops/attention.py) as ground truth,
+sweeping GQA grouping, positions, sliding windows, and softcap.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.ops.attention import cached_attention
+from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import flash_decode_attention
+
+
+def _mk(b, s, h, kh, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kh, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("h,kh", [(4, 4), (8, 2), (4, 1)])
+def test_matches_einsum_oracle(h, kh):
+    b, s, d = 3, 256, 32
+    q, k, v = _mk(b, s, h, kh, d)
+    pos = jnp.array([0, 100, 255], jnp.int32)
+    want = cached_attention(q, k, v, pos)
+    got = flash_decode_attention(q, k, v, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_positions_gate_attendable_prefix():
+    """Cache entries past a slot's position must not influence its output:
+    corrupt the tail of the cache and assert identical results."""
+    b, s, h, kh, d = 2, 256, 4, 2, 16
+    q, k, v = _mk(b, s, h, kh, d, seed=1)
+    pos = jnp.array([50, 130], jnp.int32)
+    base = flash_decode_attention(q, k, v, pos, interpret=True)
+    k2 = k.at[:, 200:].set(1e6)
+    v2 = v.at[:, 200:].set(-1e6)
+    poisoned = flash_decode_attention(q, k2, v2, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(poisoned))
+
+
+def test_sliding_window_matches_oracle():
+    b, s, h, kh, d = 2, 256, 4, 2, 16
+    q, k, v = _mk(b, s, h, kh, d, seed=2)
+    pos = jnp.array([180, 255], jnp.int32)
+    for window in (16, 64):
+        want = cached_attention(q, k, v, pos, window=window)
+        got = flash_decode_attention(q, k, v, pos, window=window,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_softcap_and_scale_match_oracle():
+    b, s, h, kh, d = 2, 128, 4, 2, 16
+    q, k, v = _mk(b, s, h, kh, d, seed=3)
+    pos = jnp.array([64, 127], jnp.int32)
+    want = cached_attention(q, k, v, pos, scale=0.25, softcap=30.0)
+    got = flash_decode_attention(q, k, v, pos, scale=0.25, softcap=30.0,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_traced_window_scalar():
+    """gemma-2 passes the window as a traced scalar from inside lax.scan."""
+    b, s, h, kh, d = 1, 128, 2, 1, 16
+    q, k, v = _mk(b, s, h, kh, d, seed=4)
+    pos = jnp.array([100], jnp.int32)
+
+    def f(win):
+        return flash_decode_attention(q, k, v, pos, window=win,
+                                      interpret=True)
+
+    got = jax.jit(f)(jnp.asarray(32))
+    want = cached_attention(q, k, v, pos, window=32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rejects_untileable_seq():
+    q, k, v = _mk(1, 100, 2, 1, 16)
+    with pytest.raises(ValueError, match="S %"):
+        flash_decode_attention(q, k, v, jnp.array([0]), interpret=True)
+
+
+def test_full_model_decode_flash_parity():
+    """decode_step with flash_decode (interpret) must reproduce the einsum
+    path exactly through the full tiny model, including gemma-2 windows."""
+    from dataclasses import replace
+
+    from p2p_llm_tunnel_tpu.models.config import get_config
+    from p2p_llm_tunnel_tpu.models.transformer import (
+        decode_step, init_kv_cache, init_params, prefill_into_cache,
+    )
+
+    for preset in ("tiny", "tiny-gemma"):
+        cfg = get_config(preset)
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        fcfg = replace(cfg, flash_decode=True, flash_interpret=True)
+        cache = init_kv_cache(cfg, 2, 256, jnp.float32)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0,
+                                  cfg.vocab_size)
+        _, cache = prefill_into_cache(
+            cfg, params, jnp.pad(toks, ((0, 0), (0, 2))),
+            jnp.array([6]), cache, jnp.array([0]),
+        )
+        cache_f = jax.tree.map(lambda x: x, cache)
+        step_tokens = jnp.full((2,), 3, jnp.int32)
+        step_pos = jnp.full((2,), 6, jnp.int32)
+        ref, _ = decode_step(cfg, params, cache, step_tokens, step_pos,
+                             kv_view=128)
+        got, _ = decode_step(fcfg, params, cache_f, step_tokens, step_pos,
+                             kv_view=128)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4,
+            err_msg=f"flash decode diverges on {preset}",
+        )

@@ -165,3 +165,29 @@ def test_init_params_quantized_single_jit(cpu_devices):
     assert wq.q.dtype == jnp.int8
     assert wq.q.shape == (cfg.n_layers, cfg.dim, cfg.n_heads * cfg.head_dim)
     assert params["embed"].q.shape == (cfg.vocab_size, cfg.dim)
+
+
+def test_engine_prefill_act_quant(cpu_devices):
+    """prefill_act_quant: prefill runs W8A8, decode stays weight-only —
+    generation must work end to end and the decode config stays unchanged."""
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2, quant="int8",
+                                prefill_act_quant=True)
+    )
+    assert eng._prefill_mcfg.act_quant
+    assert not eng.mcfg.act_quant
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"pf8"), max_new_tokens=5,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 5

@@ -39,7 +39,7 @@ def _load_hf_params_and_cfg():
 
     with open(os.path.join(CKPT, "config.json")) as f:
         hf = json.load(f)
-    cfg = ModelConfig(
+    kwargs = dict(
         name=os.path.basename(CKPT.rstrip("/")),
         vocab_size=hf["vocab_size"],
         dim=hf["hidden_size"],
@@ -54,6 +54,21 @@ def _load_hf_params_and_cfg():
         norm_eps=hf.get("rms_norm_eps", 1e-5),
         tie_embeddings=hf.get("tie_word_embeddings", False),
     )
+    if FAMILY == "gemma2":
+        # gemma-2's architecture knobs do NOT live at llama defaults; a
+        # config without them silently runs the wrong forward pass.
+        kwargs.update(
+            act="gelu",
+            post_norms=True,
+            attn_softcap=hf.get("attn_logit_softcapping", 50.0),
+            logit_softcap=hf.get("final_logit_softcapping", 30.0),
+            sliding_window=hf.get("sliding_window", 4096),
+            embed_scale=True,
+            query_scale=hf.get("query_pre_attn_scalar", 256) ** -0.5,
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_embeddings=True,
+        )
+    cfg = ModelConfig(**kwargs)
 
     state = {}
     try:
@@ -136,9 +151,15 @@ def test_real_checkpoint_streams_coherent_text():
             )
             text = body["choices"][0]["message"]["content"]
             # Coherence bar: real weights under greedy decode must produce
-            # words, not noise — "Paris" for any competent base model.
+            # language, not noise.  Any competent base model continues the
+            # prompt with "Paris"; failing that, require the output to be
+            # mostly letters/spaces (catches garbage like "aQz!!" that a
+            # broken conversion produces).
             assert text.strip(), "model produced no text"
-            assert any(c.isalpha() for c in text)
+            wordish = sum(c.isalpha() or c.isspace() for c in text) / len(text)
+            assert "paris" in text.lower() or wordish > 0.8, (
+                f"output fails the coherence bar: {text!r}"
+            )
             print(f"model output: {text!r}")
         finally:
             serve_task.cancel()
