@@ -155,7 +155,10 @@ async def _run_attempt(model: str) -> dict:
     eager_steps = int(os.environ.get("BENCH_DECODE_STEPS_EAGER", "4"))
     prefill_rows = int(os.environ.get("BENCH_PREFILL_ROWS", "8"))
     quant = os.environ.get("BENCH_QUANT", "int8")
-    pf8 = os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
+    # Effective only with int8 weights (the engine ignores it otherwise);
+    # record what actually ran, not what was asked for.
+    pf8 = (os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
+           and quant == "int8")
     flash_decode = os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.

@@ -256,7 +256,8 @@ class InferenceEngine:
         def one(carry, step_key):
             toks, pos, cache = carry
             logits, cache = decode_step(
-                self.mcfg, params, cache, toks, pos, kv_view=kv_view
+                self.mcfg, params, cache, toks, pos, kv_view=kv_view,
+                mesh=self.mesh,
             )
             sampled = sampling.sample(logits, samp, step_key)
             return (sampled, pos + 1, cache), sampled

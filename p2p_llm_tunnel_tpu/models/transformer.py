@@ -254,10 +254,30 @@ def prefill(
     attention over the sequence axis (see _prefill_attention_fn).
     """
     b, t = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
     x = _embed(cfg, params, tokens)
-    layer_idx = jnp.arange(cfg.n_layers)
     attention = _prefill_attention_fn(cfg, mesh, t)
+    x, ks, vs = apply_blocks(cfg, params["blocks"], x, valid, attention)
+    x = _norm(cfg, x, params["final_norm"])
+    return _logits(cfg, params, x), ks, vs
+
+
+def apply_blocks(
+    cfg: ModelConfig,
+    blocks: Params,  # stacked [L_chunk, ...] (the whole stack or a pp stage)
+    x: jnp.ndarray,  # [B, T, Dm] embedded activations
+    valid: jnp.ndarray,  # [B, T] bool
+    attention,  # fn(q, k, v, valid, window) -> [B,T,H,D]
+    layer_offset=0,  # global index of blocks[0] (pp stages pass stage*L/S)
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run a stacked block chunk over activations; returns (x', ks, vs).
+
+    Factored out of ``prefill`` so the pipeline-parallel stage executor
+    (parallel/pipeline.py) runs exactly the same per-layer computation on
+    its layer shard — one definition of what a block IS."""
+    b, t, _ = x.shape
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    n_chunk = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    layer_idx = layer_offset + jnp.arange(n_chunk)
 
     def step(x, xs):
         blk, idx = xs
@@ -275,9 +295,8 @@ def prefill(
         x = x + mlp
         return x, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(step, x, (params["blocks"], layer_idx))
-    x = _norm(cfg, x, params["final_norm"])
-    return _logits(cfg, params, x), ks, vs
+    x, (ks, vs) = jax.lax.scan(step, x, (blocks, layer_idx))
+    return x, ks, vs
 
 
 def prefill_into_cache(
@@ -323,6 +342,7 @@ def decode_step(
     tokens: jnp.ndarray,  # [B] one token per slot
     positions: jnp.ndarray,  # [B] where this token goes in the cache
     kv_view: Optional[int] = None,  # static: attend only to cache[:kv_view]
+    mesh=None,  # Mesh when params/cache are sharded (gates the flash path)
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step over every slot. Returns (logits [B,V], new cache).
 
@@ -351,11 +371,23 @@ def decode_step(
     layer_idx = jnp.arange(cfg.n_layers)
     slot_ids = jnp.arange(b)
 
+    # Flash-decode gating beyond the config flag:
+    # - tp>1 falls back to the einsum path: pallas_call is not GSPMD-
+    #   partitioned, so under a tp mesh XLA would all-gather the sharded
+    #   q/KV onto every chip (the hazard prefill's flash_tp shard_map
+    #   wrapper exists for — apply the same wrapper here before enabling);
+    # - bound the staged K/V planes to the VMEM budget: this kernel stages
+    #   the full [view, D] K and V per (slot, kv-head) program, so the
+    #   per-slot frontier skips COMPUTE but not the HBM→VMEM DMA; very
+    #   large views must use the einsum path (or a future S-gridded kernel).
+    tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
     use_flash = (
         cfg.flash_decode
         and (jax.default_backend() == "tpu" or cfg.flash_interpret)
+        and tp == 1
         and kv_view % 128 == 0
         and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
+        and kv_view * cfg.head_dim <= 8192 * 128
     )
     if use_flash:
         from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (

@@ -4,8 +4,11 @@ The decode analog of ops/pallas_attention.py (VERDICT r3 item 4): each grid
 program owns one (slot, kv-head) pair and runs the full GQA group's queries
 ([G, D], G = H/K) against that head's cache prefix with the online-softmax
 update, stopping at the slot's valid frontier — K blocks entirely past the
-slot's position are skipped, so compute follows each slot's OWN context
-length (the XLA einsum path masks but still computes the whole view).
+slot's position skip their COMPUTE (the XLA einsum path masks but computes
+the whole view).  Note the HBM→VMEM DMA is not skipped: each program
+stages its full [view, D] K/V planes, so callers must bound view (the
+model layer caps view·head_dim at 1M elements ≈ 4 MB of K+V per program);
+DMA-level frontier skipping needs an S-gridded variant.
 
 Fuses score, mask, softmax, and value matmuls into one kernel where the
 einsum path (ops/attention.py cached_attention) lowers to several — fewer

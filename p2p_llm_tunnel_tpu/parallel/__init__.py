@@ -11,9 +11,17 @@ Axes convention (scaling-book style):
 - ``dp``  — data parallel / batch-slot axis
 - ``tp``  — tensor parallel (megatron column/row split of attn + MLP)
 - ``sp``  — sequence parallel (ring attention KV rotation; ops/ring_attention)
+- ``pp``  — pipeline parallel (GPipe microbatches, ppermute stage hand-off;
+  parallel/pipeline)
 """
 
 from p2p_llm_tunnel_tpu.parallel.mesh import best_mesh, make_mesh
+from p2p_llm_tunnel_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    pipeline_loss_fn,
+    pipeline_prefill,
+    shard_params_pp,
+)
 from p2p_llm_tunnel_tpu.parallel.sharding import (
     kv_cache_pspecs,
     param_pspecs,
@@ -24,6 +32,10 @@ from p2p_llm_tunnel_tpu.parallel.sharding import (
 __all__ = [
     "make_mesh",
     "best_mesh",
+    "make_pp_mesh",
+    "pipeline_prefill",
+    "pipeline_loss_fn",
+    "shard_params_pp",
     "param_pspecs",
     "kv_cache_pspecs",
     "shard_params",
