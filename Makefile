@@ -1,7 +1,7 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native test test-unit test-local bench serve proxy signal multichip
+.PHONY: native test test-unit test-local test-race bench serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
@@ -13,6 +13,14 @@ test-unit:         ## full pytest suite on the virtual CPU mesh
 
 test-local:        ## hermetic 4-process end-to-end over real sockets
 	scripts/test-local.sh
+
+test-race:         ## concurrency suites under asyncio debug mode (A2: the
+	## TSan-equivalent CI job — asyncio surfaces never-awaited coros,
+	## non-threadsafe loop calls, and >100ms callback stalls as errors)
+	PYTHONASYNCIODEBUG=1 python -W error::RuntimeWarning -m pytest \
+		tests/test_engine_stress.py tests/test_transport_net.py \
+		tests/test_transport_lossy.py tests/test_flow_control.py \
+		tests/test_reconnect.py -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

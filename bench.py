@@ -159,7 +159,10 @@ async def _run_attempt(model: str) -> dict:
     # record what actually ran, not what was asked for.
     pf8 = (os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
            and quant == "int8")
-    flash_decode = os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
+    kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
+    # An int8 KV cache forces the einsum decode path; record what ran.
+    flash_decode = (os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
+                    and kv_quant != "int8")
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
@@ -185,6 +188,7 @@ async def _run_attempt(model: str) -> dict:
             decode_steps=decode_steps, decode_steps_eager=eager_steps,
             prefill_rows=prefill_rows, quant=quant,
             prefill_act_quant=pf8, flash_decode=flash_decode,
+            kv_quant=kv_quant,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -278,6 +282,7 @@ async def _run_attempt(model: str) -> dict:
         "model": model,
         "quant": quant,
         "prefill_act_quant": pf8,
+        "kv_quant": kv_quant,
         "flash_decode": flash_decode,
         "clients": clients,
         "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,

@@ -76,6 +76,10 @@ class EngineConfig:
     # "w8a8" (also quantize activations dynamically; int8 MXU dots).
     # Halves decode HBM traffic and fits 8B-class models on a 16 GB chip.
     quant: str = "none"
+    # KV-cache quantization: "none" | "int8" (per-token-per-head scales).
+    # Halves the KV read term that dominates long-context decode HBM
+    # traffic; attention dequant fuses into the einsum operand read.
+    kv_quant: str = "none"
     # Use the Pallas decode-attention kernel on TPU-tileable shapes
     # (models/config.py flash_decode).  Off by default pending on-hardware
     # measurement; correctness is oracle-pinned (tests/test_pallas_decode).
@@ -177,7 +181,11 @@ class InferenceEngine:
         # scatter into, so batched prefill never corrupts a live slot.
         rows = b + 1
         self._scratch_slot = b
-        self.kv_cache = init_kv_cache(self.mcfg, rows, s, dtype)
+        if self.ecfg.kv_quant not in ("none", "", "int8"):
+            raise ValueError(f"unknown kv_quant mode {self.ecfg.kv_quant!r}")
+        self.kv_cache = init_kv_cache(
+            self.mcfg, rows, s, dtype, quant=self.ecfg.kv_quant == "int8"
+        )
         if self.mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import shard_kv_cache
 

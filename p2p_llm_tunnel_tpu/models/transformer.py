@@ -77,10 +77,37 @@ def init_params(
 
 
 def init_kv_cache(
-    cfg: ModelConfig, num_slots: int, max_seq: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, num_slots: int, max_seq: int, dtype=jnp.bfloat16,
+    quant: bool = False,
 ) -> KVCache:
+    """Slot cache; ``quant=True`` stores int8 values + per-(token, head)
+    fp32 scales — halves the KV read term that dominates decode HBM traffic
+    at long context (the KV analog of weight-only int8; scales add 1/D of
+    the saving back)."""
     shape = (cfg.n_layers, num_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if not quant:
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+        "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+    }
+
+
+def kv_cache_is_quantized(kv_cache: KVCache) -> bool:
+    return "k_scale" in kv_cache
+
+
+def _quant_kv(x: jnp.ndarray):
+    """Symmetric int8 over the trailing head_dim axis → (q, scale).
+
+    Same formula as activation quant — one definition (models/quant.py
+    _quantize_act); only the scale's keepdims differs."""
+    from p2p_llm_tunnel_tpu.models.quant import _quantize_act
+
+    q, scale = _quantize_act(x)
+    return q, scale[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -326,9 +353,18 @@ def prefill_into_cache(
     ks = ks[:, :, :s_max]
     vs = vs[:, :, :s_max]
     t_w = ks.shape[2]
-    k_new = kv_cache["k"].at[:, slots, :t_w].set(ks)
-    v_new = kv_cache["v"].at[:, slots, :t_w].set(vs)
-    return last, {"k": k_new, "v": v_new}
+    out = dict(kv_cache)
+    if kv_cache_is_quantized(kv_cache):
+        kq, k_s = _quant_kv(ks)
+        vq, v_s = _quant_kv(vs)
+        out["k"] = kv_cache["k"].at[:, slots, :t_w].set(kq)
+        out["v"] = kv_cache["v"].at[:, slots, :t_w].set(vq)
+        out["k_scale"] = kv_cache["k_scale"].at[:, slots, :t_w].set(k_s)
+        out["v_scale"] = kv_cache["v_scale"].at[:, slots, :t_w].set(v_s)
+    else:
+        out["k"] = kv_cache["k"].at[:, slots, :t_w].set(ks)
+        out["v"] = kv_cache["v"].at[:, slots, :t_w].set(vs)
+    return last, out
 
 
 # ---------------------------------------------------------------------------
@@ -380,9 +416,11 @@ def decode_step(
     #   the full [view, D] K and V per (slot, kv-head) program, so the
     #   per-slot frontier skips COMPUTE but not the HBM→VMEM DMA; very
     #   large views must use the einsum path (or a future S-gridded kernel).
+    quant = kv_cache_is_quantized(kv_cache)
     tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
     use_flash = (
         cfg.flash_decode
+        and not quant  # kernel reads raw K/V; int8 cache takes the einsum path
         and (jax.default_backend() == "tpu" or cfg.flash_interpret)
         and tp == 1
         and kv_view % 128 == 0
@@ -413,20 +451,44 @@ def decode_step(
             )
 
     def step(carry, xs):
-        x, k_cache, v_cache = carry
+        x, cache = carry
         blk, idx = xs
         h = _norm(cfg, x, blk["attn_norm"])
         q, k, v = _qkv(cfg, blk, h, pos2d)  # q [B,1,H,D], k/v [B,1,K,D]
-        k_cache = k_cache.at[idx, slot_ids, positions].set(k[:, 0])
-        v_cache = v_cache.at[idx, slot_ids, positions].set(v[:, 0])
+        cache = dict(cache)
+        if quant:
+            kq, k_s = _quant_kv(k[:, 0])
+            vq, v_s = _quant_kv(v[:, 0])
+            cache["k"] = cache["k"].at[idx, slot_ids, positions].set(kq)
+            cache["v"] = cache["v"].at[idx, slot_ids, positions].set(vq)
+            cache["k_scale"] = (
+                cache["k_scale"].at[idx, slot_ids, positions].set(k_s)
+            )
+            cache["v_scale"] = (
+                cache["v_scale"].at[idx, slot_ids, positions].set(v_s)
+            )
+        else:
+            cache["k"] = cache["k"].at[idx, slot_ids, positions].set(k[:, 0])
+            cache["v"] = cache["v"].at[idx, slot_ids, positions].set(v[:, 0])
         # ONE dynamic_slice for (layer, view-prefix): slicing the layer out
         # first and sub-slicing after makes XLA materialize the full-length
         # layer before the view cut — the fused form reads only view bytes.
         view_shape = (1, b, kv_view, cfg.n_kv_heads, cfg.head_dim)
         zero = jnp.zeros((), idx.dtype)
         start = (idx, zero, zero, zero, zero)
-        k_l = jax.lax.dynamic_slice(k_cache, start, view_shape)[0]
-        v_l = jax.lax.dynamic_slice(v_cache, start, view_shape)[0]
+        k_l = jax.lax.dynamic_slice(cache["k"], start, view_shape)[0]
+        v_l = jax.lax.dynamic_slice(cache["v"], start, view_shape)[0]
+        if quant:
+            # Dequant fuses into the attention einsum's operand read: int8
+            # bytes cross HBM, bf16 never materializes (same fusion the
+            # int8 weights rely on — PERF.md).
+            sc_shape = (1, b, kv_view, cfg.n_kv_heads)
+            k_s = jax.lax.dynamic_slice(
+                cache["k_scale"], start[:4], sc_shape)[0]
+            v_s = jax.lax.dynamic_slice(
+                cache["v_scale"], start[:4], sc_shape)[0]
+            k_l = (k_l.astype(jnp.float32) * k_s[..., None]).astype(x.dtype)
+            v_l = (v_l.astype(jnp.float32) * v_s[..., None]).astype(x.dtype)
         attn = attention(q, k_l, v_l, idx)
         attn = mm(attn.reshape(b, 1, -1), blk["wo"], cfg.act_quant)
         if cfg.post_norms:
@@ -437,16 +499,16 @@ def decode_step(
         if cfg.post_norms:
             mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
         x = x + mlp
-        return (x, k_cache, v_cache), None
+        return (x, cache), None
 
-    (x, k_new, v_new), _ = jax.lax.scan(
+    (x, new_cache), _ = jax.lax.scan(
         step,
-        (x, kv_cache["k"], kv_cache["v"]),
+        (x, dict(kv_cache)),
         (params["blocks"], layer_idx),
     )
     x = _norm(cfg, x, params["final_norm"])
     logits = _logits(cfg, params, x)[:, 0]  # [B,V]
-    return logits, {"k": k_new, "v": v_new}
+    return logits, new_cache
 
 
 # ---------------------------------------------------------------------------

@@ -95,10 +95,18 @@ def param_pspecs(
     return specs
 
 
-def kv_cache_pspecs() -> Dict[str, P]:
-    """[L, Slots, S, K, D]: slots on dp, kv heads on tp."""
+def kv_cache_pspecs(kv_cache: Optional[Pytree] = None) -> Dict[str, P]:
+    """[L, Slots, S, K, D]: slots on dp, kv heads on tp.  Quantized caches
+    (models/transformer.py init_kv_cache quant=True) add per-token scale
+    leaves [L, Slots, S, K] that shard congruently."""
     spec = P(None, "dp", None, "tp", None)
-    return {"k": spec, "v": spec}
+    scale_spec = P(None, "dp", None, "tp")
+    if kv_cache is None:
+        return {"k": spec, "v": spec}
+    return {
+        name: (spec if leaf.ndim == 5 else scale_spec)
+        for name, leaf in kv_cache.items()
+    }
 
 
 def _to_shardings(mesh: Mesh, specs: Pytree) -> Pytree:
@@ -115,8 +123,8 @@ def param_shardings(
     return _to_shardings(mesh, param_pspecs(cfg, params))
 
 
-def kv_cache_shardings(mesh: Mesh) -> Pytree:
-    return _to_shardings(mesh, kv_cache_pspecs())
+def kv_cache_shardings(mesh: Mesh, kv_cache: Optional[Pytree] = None) -> Pytree:
+    return _to_shardings(mesh, kv_cache_pspecs(kv_cache))
 
 
 def shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
@@ -126,4 +134,4 @@ def shard_params(params: Pytree, cfg: ModelConfig, mesh: Mesh) -> Pytree:
 
 
 def shard_kv_cache(kv_cache: Pytree, mesh: Mesh) -> Pytree:
-    return jax.device_put(kv_cache, kv_cache_shardings(mesh))
+    return jax.device_put(kv_cache, kv_cache_shardings(mesh, kv_cache))

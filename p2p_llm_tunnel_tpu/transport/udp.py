@@ -459,10 +459,26 @@ class UdpChannel(Channel):
 
     async def _maintenance(self) -> None:
         """Retransmit timers, keepalives, dead-peer detection."""
+        from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
         try:
             while not self.is_closed:
                 await asyncio.sleep(RTO_MIN / 2)
                 now = time.monotonic()
+                # Congestion state as first-class gauges (SURVEY §5: the
+                # rebuild exposes counters where the reference greps logs).
+                # Gauges are last-writer-wins: meaningful for the normal
+                # one-channel-per-process peers; multi-channel processes
+                # should read per-channel congestion_stats instead.
+                # Retransmits are a COUNTER (incremented at retransmit time
+                # below) so they aggregate correctly across channels.
+                global_metrics.set_gauge("transport_cwnd", self._cwnd)
+                global_metrics.set_gauge(
+                    "transport_srtt_ms", (self._srtt or 0.0) * 1000.0
+                )
+                global_metrics.set_gauge(
+                    "transport_in_flight", float(len(self._unacked))
+                )
                 if self._established.is_set():
                     if now - self._last_heard > DEAD_TIMEOUT:
                         log.warning("udp peer silent for %.0fs; disconnecting",
@@ -495,6 +511,7 @@ class UdpChannel(Channel):
                             self._on_timeout_loss(now)
                             self._unacked[seq] = (pkt, now, tries + 1)
                             self._retransmits += 1
+                            global_metrics.inc("transport_retransmits_total")
                             resent += 1
                             self._send_raw(pkt, self._peer_addr)
                     # Keepalive gates on time-since-last-SENT and uses PUNCH

@@ -137,3 +137,24 @@ def test_param_shardings_place_on_mesh(cfg, cpu_devices):
     # column-parallel: last axis split 4 ways
     assert wq.sharding.spec == jax.sharding.PartitionSpec(None, None, "tp")
     assert len(wq.sharding.device_set) == 8
+
+
+def test_quantized_kv_cache_shards_congruently(cpu_devices):
+    """Int8 KV cache + per-token scales place on a dp×tp mesh with scales
+    sharded like their values (minus the head_dim axis)."""
+    import jax.numpy as jnp
+
+    from p2p_llm_tunnel_tpu.models.config import get_config
+    from p2p_llm_tunnel_tpu.models.transformer import init_kv_cache
+    from p2p_llm_tunnel_tpu.parallel import make_mesh, shard_kv_cache
+
+    cfg = get_config("tiny")
+    mesh = make_mesh(tp=2, dp=2, devices=cpu_devices[:4])
+    cache = init_kv_cache(cfg, 4, 32, jnp.float32, quant=True)
+    sharded = shard_kv_cache(cache, mesh)
+    assert sharded["k"].dtype == jnp.int8
+    # values shard kv-heads on tp; scales shard the same axes minus head_dim
+    k_spec = sharded["k"].sharding.spec
+    s_spec = sharded["k_scale"].sharding.spec
+    assert tuple(k_spec) == (None, "dp", None, "tp", None)
+    assert tuple(s_spec) == (None, "dp", None, "tp")

@@ -191,3 +191,88 @@ def test_engine_prefill_act_quant(cpu_devices):
 
     toks = asyncio.run(asyncio.wait_for(main(), 120))
     assert len(toks) == 5
+
+
+def test_kv_cache_int8_decode_tracks_fp32(cpu_devices):
+    """Int8 KV cache: token-by-token decode must track the fp32-cache path
+    closely (per-token-per-head scales bound the error) and agree on
+    argmax — the accuracy bar for serving with a quantized cache."""
+    from p2p_llm_tunnel_tpu.models.transformer import (
+        decode_step, init_kv_cache, init_params, prefill_into_cache,
+    )
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    t = 12
+    prompt_len = 6
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, t), 0,
+                                cfg.vocab_size)
+
+    def run(quant):
+        cache = init_kv_cache(cfg, 2, 32, jnp.float32, quant=quant)
+        _, cache = prefill_into_cache(
+            cfg, params,
+            jnp.pad(tokens[:, :prompt_len], ((0, 0), (0, 2))),
+            jnp.array([prompt_len]), cache, jnp.array([1]),
+        )
+        outs = []
+        for pos in range(prompt_len, t):
+            step_tokens = jnp.zeros((2,), jnp.int32).at[1].set(tokens[0, pos])
+            step_pos = jnp.zeros((2,), jnp.int32).at[1].set(pos)
+            logits, cache = decode_step(cfg, params, cache, step_tokens,
+                                        step_pos)
+            outs.append(np.asarray(logits[1]))
+        return np.stack(outs)
+
+    ref = run(False)
+    got = run(True)
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree >= 0.95, f"argmax agreement too low: {agree}"
+    denom = np.abs(ref).mean() + 1e-6
+    assert np.abs(ref - got).mean() / denom < 0.1
+
+
+def test_kv_cache_int8_respects_kv_view(cpu_devices):
+    """View bucketing composes with the quantized cache (scales slice with
+    the values)."""
+    from p2p_llm_tunnel_tpu.models.transformer import (
+        decode_step, init_kv_cache, init_params, prefill_into_cache,
+    )
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    cache = init_kv_cache(cfg, 2, 64, jnp.float32, quant=True)
+    _, cache = prefill_into_cache(
+        cfg, params, jnp.arange(8)[None, :] % cfg.vocab_size,
+        jnp.array([8]), cache, jnp.array([0]),
+    )
+    cache_b = jax.tree.map(lambda x: x, cache)
+    toks = jnp.full((2,), 3, jnp.int32)
+    pos = jnp.full((2,), 8, jnp.int32)
+    full, _ = decode_step(cfg, params, cache, toks, pos)
+    view, _ = decode_step(cfg, params, cache_b, toks, pos, kv_view=16)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(view),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_engine_with_kv_quant(cpu_devices):
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2,
+                                quant="int8", kv_quant="int8")
+    )
+    assert "k_scale" in eng.kv_cache
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"kv-quantized"), max_new_tokens=6,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 6
