@@ -14,9 +14,10 @@ test-unit:         ## full pytest suite on the virtual CPU mesh
 test-local:        ## hermetic 4-process end-to-end over real sockets
 	scripts/test-local.sh
 
-test-race:         ## concurrency suites under asyncio debug mode (A2: the
-	## TSan-equivalent CI job — asyncio surfaces never-awaited coros,
-	## non-threadsafe loop calls, and >100ms callback stalls as errors)
+# A2's TSan-equivalent CI job: asyncio debug mode surfaces never-awaited
+# coroutines, non-threadsafe loop calls, and >100ms callback stalls; the -W
+# flag turns the resulting RuntimeWarnings into test failures.
+test-race:         ## concurrency suites under asyncio debug mode
 	PYTHONASYNCIODEBUG=1 python -W error::RuntimeWarning -m pytest \
 		tests/test_engine_stress.py tests/test_transport_net.py \
 		tests/test_transport_lossy.py tests/test_flow_control.py \

@@ -118,6 +118,61 @@ def convert_hf_llama(
     return params
 
 
+def convert_hf_mixtral(
+    state: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF Mixtral layout → stacked MoE pytree.
+
+    Attention matches llama (transposed projections); the MoE block maps
+    ``block_sparse_moe.gate`` → router and
+    ``block_sparse_moe.experts.{e}.w1/w3/w2`` → moe_gate/moe_up/moe_down,
+    stacked [L, E, ...] (models/moe.py layout)."""
+    l, e = cfg.n_layers, cfg.n_experts
+
+    def w(name: str, i: int) -> np.ndarray:
+        return np.asarray(state[f"model.layers.{i}.{name}.weight"])
+
+    def experts(proj: str) -> jnp.ndarray:
+        return _stack(
+            [
+                np.stack(
+                    [w(f"block_sparse_moe.experts.{x}.{proj}", i).T
+                     for x in range(e)]
+                )
+                for i in range(l)
+            ],
+            dtype,
+        )
+
+    blocks = {
+        "attn_norm": _stack([w("input_layernorm", i) for i in range(l)], dtype),
+        "mlp_norm": _stack(
+            [w("post_attention_layernorm", i) for i in range(l)], dtype
+        ),
+        "wq": _stack([w("self_attn.q_proj", i).T for i in range(l)], dtype),
+        "wk": _stack([w("self_attn.k_proj", i).T for i in range(l)], dtype),
+        "wv": _stack([w("self_attn.v_proj", i).T for i in range(l)], dtype),
+        "wo": _stack([w("self_attn.o_proj", i).T for i in range(l)], dtype),
+        "router": _stack(
+            [w("block_sparse_moe.gate", i).T for i in range(l)], dtype
+        ),
+        "moe_gate": experts("w1"),
+        "moe_up": experts("w3"),
+        "moe_down": experts("w2"),
+    }
+    params: Params = {
+        "embed": jnp.asarray(np.asarray(state["model.embed_tokens.weight"]), dtype),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(np.asarray(state["model.norm.weight"]), dtype),
+    }
+    if not cfg.tie_embeddings:
+        head = state.get("lm_head.weight")
+        if head is None:
+            head = state["model.embed_tokens.weight"]
+        params["lm_head"] = jnp.asarray(np.asarray(head).T, dtype)
+    return params
+
+
 def convert_hf_gemma2(
     state: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.bfloat16
 ) -> Params:
@@ -161,6 +216,7 @@ def convert_hf_gemma2(
 CONVERTERS = {
     "llama": convert_hf_llama,
     "gemma2": convert_hf_gemma2,
+    "mixtral": convert_hf_mixtral,
 }
 
 

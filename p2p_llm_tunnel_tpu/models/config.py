@@ -57,6 +57,12 @@ class ModelConfig:
     # head_dim % 128 == 0).  Off by default: the einsum path is the oracle;
     # flip on once measured faster for the target config.
     flash_decode: bool = False
+    # Mixture-of-experts (mixtral-style): 0 = dense MLP.  With n_experts
+    # set, each block's MLP becomes a router + per-expert SwiGLU, top-k
+    # routed with renormalized weights; expert weights shard over an
+    # ``ep`` mesh axis (expert parallelism — models/moe.py).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
 
     @property
     def q_per_kv(self) -> int:
@@ -186,8 +192,46 @@ def llama3_70b() -> ModelConfig:
     )
 
 
+def tiny_moe(vocab_size: int = 512) -> ModelConfig:
+    """Tiny mixture-of-experts config: 4 experts, top-2 — CPU-testable
+    coverage for the MoE block and expert-parallel sharding."""
+    return ModelConfig(
+        name="tiny-moe",
+        vocab_size=vocab_size,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        ffn_dim=128,
+        n_experts=4,
+        n_experts_per_tok=2,
+    )
+
+
+def mixtral_8x7b() -> ModelConfig:
+    """Mixtral-8x7B: llama-style attention, 8-expert top-2 SwiGLU MLPs."""
+    return ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=14336,
+        rope_theta=1000000.0,
+        norm_eps=1e-5,
+        sliding_window=None,
+        n_experts=8,
+        n_experts_per_tok=2,
+    )
+
+
 PRESETS = {
     "tiny": tiny,
+    "tiny-moe": tiny_moe,
+    "mixtral-8x7b": mixtral_8x7b,
     "tiny-gemma": tiny_gemma,
     "gemma2-2b": gemma2_2b,
     "llama3-8b": llama3_8b,

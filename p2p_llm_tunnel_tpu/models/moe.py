@@ -1,0 +1,88 @@
+"""Mixture-of-experts MLP block + expert-parallel sharding (P5).
+
+SURVEY.md §2 marks expert parallelism "out of scope unless MoE models
+added" — this adds them: mixtral-style blocks where each layer's MLP is a
+router over ``n_experts`` per-expert SwiGLUs, top-k routed with
+renormalized gate weights.
+
+Compute strategy: DENSE-DROPLESS — every expert computes every token and
+the router weights (zero for unrouted experts) scale the combine.  This
+keeps shapes static (XLA-friendly, no capacity dropping, exactly
+reproduces the routed math) at the cost of E/k× the FLOPs of a routed
+gather; a Megablocks-style grouped matmul is the future optimization for
+serving large MoEs at scale.
+
+Expert parallelism falls out of sharding: expert weights carry the expert
+axis on an ``ep`` mesh axis (pspecs below), so each device computes ONLY
+its resident experts' contributions and the final expert-contraction
+einsum becomes a psum over ``ep`` — GSPMD inserts the collective.  With
+dense-dropless compute this is exact EP: per-device FLOPs and weight
+memory both scale down by the ep degree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe_blocks(cfg, keys, dense_fn) -> dict:
+    """MoE leaves for the stacked block tree.
+
+    ``dense_fn(key, shape, fan_in)`` is init_params' dense initializer so
+    MoE weights follow the same distribution.  Layout:
+    router [L, Dm, E]; experts [L, E, Dm, F] (gate/up) and [L, E, F, Dm]
+    (down)."""
+    l, dm, f, e = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    return {
+        "router": dense_fn(keys[0], (l, dm, e), dm),
+        "moe_gate": dense_fn(keys[1], (l, e, dm, f), dm),
+        "moe_up": dense_fn(keys[2], (l, e, dm, f), dm),
+        "moe_down": dense_fn(keys[3], (l, e, f, dm), f),
+    }
+
+
+def moe_pspecs() -> dict:
+    """PartitionSpecs for the MoE leaves: experts shard on ``ep``; the
+    router (tiny) replicates."""
+    return {
+        "router": P(None, None, None),
+        "moe_gate": P(None, "ep", None, None),
+        "moe_up": P(None, "ep", None, None),
+        "moe_down": P(None, "ep", None, None),
+    }
+
+
+def moe_mlp(cfg, blk, h, act_fn) -> jnp.ndarray:
+    """Routed MLP for one layer: h [B, T, Dm] → [B, T, Dm].
+
+    ``blk`` holds this layer's slice (router [Dm, E], experts [E, ...]).
+    Router math in fp32 (softmax over experts, top-k, renormalize) exactly
+    as mixtral; combine contracts the expert axis LAST so an ep-sharded
+    expert dimension turns into one psum.
+    """
+    k = cfg.n_experts_per_tok
+    e = cfg.n_experts
+
+    logits = (
+        h.astype(jnp.float32) @ blk["router"].astype(jnp.float32)
+    )  # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, k)  # [B, T, k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    # Scatter the renormalized top-k back to a dense [B, T, E] weight map
+    # (zeros for unrouted experts — they compute but contribute nothing).
+    weights = (
+        jax.nn.one_hot(top_i, e, dtype=jnp.float32) * top_p[..., None]
+    ).sum(axis=-2)  # [B, T, E]
+
+    # Dense-dropless expert compute, expert axis kept free until the end.
+    gate = jnp.einsum("btd,edf->btef", h, blk["moe_gate"])
+    up = jnp.einsum("btd,edf->btef", h, blk["moe_up"])
+    inner = act_fn(gate) * up  # [B, T, E, F]
+    down = jnp.einsum("btef,efd->bted", inner, blk["moe_down"])
+    out = jnp.einsum(
+        "bted,bte->btd", down.astype(jnp.float32), weights
+    )
+    return out.astype(h.dtype)

@@ -130,6 +130,12 @@ def init_params_quantized(cfg, key: jax.Array) -> Params:
 def _build_params_quantized(cfg, key: jax.Array) -> Params:
     import jax.numpy as jnp
 
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError(
+            "int8 quantization of MoE expert weights is not implemented; "
+            "serve MoE models with quant='none'"
+        )
+
     l, dm, h, kh, hd, f, v = (
         cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
         cfg.head_dim, cfg.ffn_dim, cfg.vocab_size,
@@ -176,6 +182,11 @@ def quantize_params(params: Params, cfg=None) -> Params:
     """
     del cfg
     blocks = params["blocks"]
+    if "router" in blocks:
+        raise NotImplementedError(
+            "int8 quantization of MoE expert weights is not implemented; "
+            "serve MoE models with quant='none'"
+        )
     qblocks = dict(blocks)
     for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
         qblocks[name] = _quantize(blocks[name], axis=1)

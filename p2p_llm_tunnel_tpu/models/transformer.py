@@ -45,7 +45,7 @@ def init_params(
         cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
         cfg.head_dim, cfg.ffn_dim, cfg.vocab_size,
     )
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 12)
 
     def dense(k, shape, fan_in):
         return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
@@ -58,10 +58,17 @@ def init_params(
         "wk": dense(keys[1], (l, dm, kh * hd), dm),
         "wv": dense(keys[2], (l, dm, kh * hd), dm),
         "wo": dense(keys[3], (l, h * hd, dm), h * hd),
-        "w_gate": dense(keys[4], (l, dm, f), dm),
-        "w_up": dense(keys[5], (l, dm, f), dm),
-        "w_down": dense(keys[6], (l, f, dm), f),
     }
+    if cfg.n_experts:
+        from p2p_llm_tunnel_tpu.models.moe import init_moe_blocks
+
+        blocks.update(init_moe_blocks(cfg, keys[8:12], dense))
+    else:
+        blocks.update({
+            "w_gate": dense(keys[4], (l, dm, f), dm),
+            "w_up": dense(keys[5], (l, dm, f), dm),
+            "w_down": dense(keys[6], (l, f, dm), f),
+        })
     if cfg.post_norms:
         blocks["post_attn_norm"] = jnp.zeros((l, dm), dtype)
         blocks["post_mlp_norm"] = jnp.zeros((l, dm), dtype)
@@ -125,6 +132,10 @@ def _act(cfg: ModelConfig, x):
 
 
 def _mlp(cfg: ModelConfig, blk, h):
+    if cfg.n_experts:
+        from p2p_llm_tunnel_tpu.models.moe import moe_mlp
+
+        return moe_mlp(cfg, blk, h, lambda x: _act(cfg, x))
     aq = cfg.act_quant
     gate = _act(cfg, mm(h, blk["w_gate"], aq)) * mm(h, blk["w_up"], aq)
     return mm(gate, blk["w_down"], aq)

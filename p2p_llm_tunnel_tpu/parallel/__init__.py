@@ -13,6 +13,8 @@ Axes convention (scaling-book style):
 - ``sp``  — sequence parallel (ring attention KV rotation; ops/ring_attention)
 - ``pp``  — pipeline parallel (GPipe microbatches, ppermute stage hand-off;
   parallel/pipeline)
+- ``ep``  — expert parallel (MoE expert weights sharded per device, the
+  expert-sum contraction becomes a psum; models/moe.py)
 """
 
 from p2p_llm_tunnel_tpu.parallel.mesh import best_mesh, make_mesh

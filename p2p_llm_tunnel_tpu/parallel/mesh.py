@@ -25,20 +25,24 @@ def make_mesh(
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Mesh with axes (dp, tp, sp) over ``dp*tp*sp`` devices.
+    """Mesh with axes (dp, ep, tp, sp) over ``dp*ep*tp*sp`` devices.
 
     ``tp`` is the fastest-varying axis so tensor-parallel collectives run
-    between adjacent devices (ICI neighbours on a slice).
+    between adjacent devices (ICI neighbours on a slice); ``ep`` (expert
+    parallelism, models/moe.py) sits between dp and tp.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n = dp * tp * sp
+    n = dp * tp * sp * ep
     if len(devices) < n:
-        raise ValueError(f"mesh {dp}x{tp}x{sp} needs {n} devices, have {len(devices)}")
-    grid = np.array(devices[:n]).reshape(dp, sp, tp)
+        raise ValueError(
+            f"mesh {dp}x{ep}x{tp}x{sp} needs {n} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:n]).reshape(dp, ep, sp, tp)
     # Axis order in memory: dp outermost, tp innermost (contiguous devices).
-    return Mesh(np.transpose(grid, (0, 2, 1)), ("dp", "tp", "sp"))
+    return Mesh(np.transpose(grid, (0, 1, 3, 2)), ("dp", "ep", "tp", "sp"))
 
 
 def best_mesh(

@@ -69,10 +69,17 @@ def param_pspecs(
         "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),  # [L, H*hd, Dm] row
-        "w_gate": P(None, None, "tp"),  # [L, Dm, F] column
-        "w_up": P(None, None, "tp"),
-        "w_down": P(None, "tp", None),  # [L, F, Dm] row
     }
+    if cfg.n_experts:
+        from p2p_llm_tunnel_tpu.models.moe import moe_pspecs
+
+        blocks.update(moe_pspecs())
+    else:
+        blocks.update({
+            "w_gate": P(None, None, "tp"),  # [L, Dm, F] column
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),  # [L, F, Dm] row
+        })
     for name in _QUANT_AXIS:
         if name in blocks:
             blocks[name] = maybe_q(name, blocks[name], pblocks.get(name))

@@ -33,6 +33,9 @@ GRID = [
     ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
     ("ctx2048", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                  "BENCH_CLIENTS": "16"}),
+    ("kv-int8", {"BENCH_KV_QUANT": "int8"}),
+    ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
+                     "BENCH_CLIENTS": "16", "BENCH_KV_QUANT": "int8"}),
     ("w8a8", {"BENCH_QUANT": "w8a8"}),
 ]
 

@@ -1,0 +1,125 @@
+"""Mixture-of-experts block + expert parallelism (P5, SURVEY §2).
+
+The E=1/k=1 MoE is mathematically the dense MLP — an exact oracle for the
+routing/combine math; EP sharding is pinned to the unsharded forward on the
+virtual CPU mesh.  (tests/test_models.py's consistency/causality matrix
+also runs over tiny-moe via its fixture.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+
+
+def _inputs(cfg, t=12, seed=5):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (2, t), 0,
+                                cfg.vocab_size)
+    return tokens, jnp.ones_like(tokens, bool)
+
+
+def test_single_expert_equals_dense_mlp():
+    """E=1, k=1: the router contributes weight exactly 1.0 to the only
+    expert, so logits must equal the dense model with identical weights."""
+    dense_cfg = get_config("tiny")
+    moe_cfg = get_config("tiny-moe", n_experts=1, n_experts_per_tok=1)
+    dense = init_params(dense_cfg, jax.random.PRNGKey(0), jnp.float32)
+    moe = init_params(moe_cfg, jax.random.PRNGKey(0), jnp.float32)
+    # graft the dense MLP weights into the single expert slot
+    moe["embed"] = dense["embed"]
+    moe["final_norm"] = dense["final_norm"]
+    moe["lm_head"] = dense["lm_head"]
+    for name in ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo"):
+        moe["blocks"][name] = dense["blocks"][name]
+    moe["blocks"]["moe_gate"] = dense["blocks"]["w_gate"][:, None]
+    moe["blocks"]["moe_up"] = dense["blocks"]["w_up"][:, None]
+    moe["blocks"]["moe_down"] = dense["blocks"]["w_down"][:, None]
+
+    tokens, valid = _inputs(dense_cfg)
+    want, _, _ = prefill(dense_cfg, dense, tokens, valid)
+    got, _, _ = prefill(moe_cfg, moe, tokens, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_topk_routing_masks_unrouted_experts():
+    """Corrupting an expert the router never picks must not change output:
+    bias the router hard toward experts 0/1 and poison expert 3."""
+    cfg = get_config("tiny-moe")
+    params = init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    # Zero router → all logits tie → top_k picks the lowest indices, so
+    # experts {0, 1} are deterministically routed and 2/3 never are.
+    params["blocks"]["router"] = jnp.zeros_like(params["blocks"]["router"])
+
+    tokens, valid = _inputs(cfg)
+    base, _, _ = prefill(cfg, params, tokens, valid)
+    poisoned = dict(params)
+    poisoned["blocks"] = dict(params["blocks"])
+    poisoned["blocks"]["moe_down"] = (
+        params["blocks"]["moe_down"].at[:, 2:].set(1e6)
+    )
+    got, _, _ = prefill(cfg, poisoned, tokens, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base))
+
+
+def test_expert_parallel_matches_unsharded(cpu_devices):
+    """EP: expert weights sharded over the ep mesh axis, logits identical
+    to the single-device forward (GSPMD inserts the expert-sum psum)."""
+    from p2p_llm_tunnel_tpu.parallel import make_mesh
+    from p2p_llm_tunnel_tpu.parallel.sharding import shard_params
+
+    cfg = get_config("tiny-moe")
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    tokens, valid = _inputs(cfg)
+    want, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(params)
+
+    mesh = make_mesh(ep=4, devices=jax.devices()[:4])
+    sharded = shard_params(params, cfg, mesh)
+    assert "ep" in str(sharded["blocks"]["moe_gate"].sharding.spec)
+    got, _, _ = jax.jit(lambda p: prefill(cfg, p, tokens, valid))(sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_engine_generates(cpu_devices):
+    import asyncio
+
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny-moe", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2)
+    )
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"mixture"), max_new_tokens=6,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 6
+
+
+def test_moe_rejects_int8_quant():
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    with pytest.raises(NotImplementedError, match="MoE"):
+        InferenceEngine(
+            engine_cfg=EngineConfig(model="tiny-moe", num_slots=2,
+                                    max_seq=64, quant="int8")
+        )
+
+
+def test_mixtral_preset_and_converter_registered():
+    from p2p_llm_tunnel_tpu.models.checkpoint import CONVERTERS
+
+    cfg = get_config("mixtral-8x7b")
+    assert cfg.n_experts == 8 and cfg.n_experts_per_tok == 2
+    assert "mixtral" in CONVERTERS

@@ -36,7 +36,7 @@ def cfg():
 
 def test_make_mesh_axes(cpu_devices):
     mesh = make_mesh(tp=4, dp=2)
-    assert mesh.axis_names == ("dp", "tp", "sp")
+    assert mesh.axis_names == ("dp", "ep", "tp", "sp")
     assert mesh.shape["tp"] == 4 and mesh.shape["dp"] == 2
     assert mesh.shape["sp"] == 1
 
