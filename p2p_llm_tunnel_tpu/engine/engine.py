@@ -67,9 +67,15 @@ class EngineConfig:
     # collectives — the decode all-gather path of BASELINE config 4.
     tp: int = 1
     # Sequence-parallel degree for prefill: shards the prompt axis over an
-    # sp mesh axis and runs ring attention (ops/ring_attention.py) — the
-    # long-context path (SURVEY §5).  Decode is unaffected (single-token).
+    # sp mesh axis — the long-context path (SURVEY §5).  Decode is
+    # unaffected (single-token).
     sp: int = 1
+    # SP strategy: "ring" (ppermute KV rotation) | "ulysses" (all_to_all
+    # head/sequence swap; supports sliding windows) — models/config.py.
+    sp_mode: str = "ring"
+    # Expert-parallel degree (MoE models): shards expert weights over an
+    # ep mesh axis (models/moe.py); 1 = experts replicated.
+    ep: int = 1
     # Optional orbax checkpoint to load instead of random init.
     ckpt_path: Optional[str] = None
     # Weight quantization: "none" | "int8" (weight-only, per-channel) |
@@ -125,6 +131,13 @@ class InferenceEngine:
         )
         if self.ecfg.flash_decode and not self.mcfg.flash_decode:
             self.mcfg = dc_replace(self.mcfg, flash_decode=True)
+        if self.ecfg.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown sp_mode {self.ecfg.sp_mode!r}")
+        if self.ecfg.sp_mode != "ring" and self.mcfg.sp_mode != self.ecfg.sp_mode:
+            # One-directional like flash_decode: a non-default EngineConfig
+            # choice promotes into the model config, but an explicitly
+            # ulysses model_cfg is never silently reverted to ring.
+            self.mcfg = dc_replace(self.mcfg, sp_mode=self.ecfg.sp_mode)
         dtype = jnp.dtype(self.ecfg.dtype)
         key = jax.random.PRNGKey(self.ecfg.seed)
         if params is None:
@@ -159,10 +172,14 @@ class InferenceEngine:
                 self.mcfg = dc_replace(self.mcfg, act_quant=True)
         elif self.ecfg.quant not in ("none", ""):
             raise ValueError(f"unknown quant mode {self.ecfg.quant!r}")
-        if mesh is None and (self.ecfg.tp > 1 or self.ecfg.sp > 1):
+        if mesh is None and (
+            self.ecfg.tp > 1 or self.ecfg.sp > 1 or self.ecfg.ep > 1
+        ):
             from p2p_llm_tunnel_tpu.parallel import make_mesh
 
-            mesh = make_mesh(tp=self.ecfg.tp, dp=1, sp=self.ecfg.sp)
+            mesh = make_mesh(
+                tp=self.ecfg.tp, dp=1, sp=self.ecfg.sp, ep=self.ecfg.ep
+            )
         self.mesh = mesh
         if mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import (

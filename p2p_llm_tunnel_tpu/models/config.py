@@ -57,6 +57,13 @@ class ModelConfig:
     # head_dim % 128 == 0).  Off by default: the einsum path is the oracle;
     # flip on once measured faster for the target config.
     flash_decode: bool = False
+    # Sequence-parallel strategy when the mesh has sp > 1:
+    # "ring"    — K/V blocks rotate via ppermute (bandwidth-optimal on the
+    #             ICI ring; no sliding-window support)
+    # "ulysses" — one all_to_all swaps the sequence shard for a head shard,
+    #             plain attention runs over the full context (windows and
+    #             pad masks work; needs H and K divisible by sp)
+    sp_mode: str = "ring"
     # Mixture-of-experts (mixtral-style): 0 = dense MLP.  With n_experts
     # set, each block's MLP becomes a router + per-expert SwiGLU, top-k
     # routed with renormalized weights; expert weights shard over an

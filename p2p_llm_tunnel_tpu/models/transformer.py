@@ -203,11 +203,35 @@ def _prefill_attention_fn(cfg: ModelConfig, mesh, t: int):
     axes = dict(mesh.shape) if mesh is not None else {}
     sp, tp = axes.get("sp", 1), axes.get("tp", 1)
 
+    if sp > 1 and cfg.sp_mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_mode {cfg.sp_mode!r}")
+    if sp > 1 and cfg.sp_mode == "ulysses":
+        from p2p_llm_tunnel_tpu.ops.ulysses_attention import (
+            make_ulysses_attention,
+        )
+
+        if cfg.n_heads % sp or cfg.n_kv_heads % sp:
+            raise ValueError(
+                f"ulysses sp={sp} needs H ({cfg.n_heads}) and K "
+                f"({cfg.n_kv_heads}) divisible by sp; use sp_mode='ring'"
+            )
+        ulysses = make_ulysses_attention(
+            mesh, "sp", scale=cfg.query_scale, softcap=cfg.attn_softcap,
+            head_axis="tp" if tp > 1 else None,
+        )
+
+        def ulysses_fn(q, k, v, valid, window):
+            # Full-sequence inner attention: pad masks and sliding windows
+            # apply unchanged (the capability ring attention lacks).
+            return ulysses(q, k, v, valid, window=window)
+
+        return ulysses_fn
+
     if sp > 1:
         if cfg.sliding_window is not None:
             raise NotImplementedError(
                 "ring attention does not support sliding windows; "
-                "use an sp=1 mesh for windowed models"
+                "use sp_mode='ulysses' or an sp=1 mesh for windowed models"
             )
         from p2p_llm_tunnel_tpu.ops.ring_attention import make_ring_attention
 

@@ -1,0 +1,102 @@
+"""Ulysses (all-to-all) sequence parallelism for causal attention.
+
+The second of the two sequence/context-parallel strategies SURVEY §5 calls
+for (ring attention being the first, ops/ring_attention.py): instead of
+rotating K/V blocks around the ring, each device swaps its SEQUENCE shard
+for a HEAD shard with one ``all_to_all``, runs ordinary full-sequence
+attention over its now-complete context for its head slice, and swaps
+back.  DeepSpeed-Ulysses' layout (arXiv:2309.14509, pattern only).
+
+Trade-off vs ring: two all-to-alls per layer (O(T·H·D/sp) bytes each)
+instead of (sp-1) ppermute hops of K/V; the inner attention is the plain
+dense/flash kernel with no online-softmax bookkeeping, and arbitrary masks
+(sliding windows!) work unchanged because every device sees the full
+sequence.  Requires the head counts to divide the shard count's multiple:
+H % sp == 0 and K % sp == 0 (GQA kv heads are all-to-all'd too).
+
+Numerics pinned to the single-device oracle by tests/test_ulysses.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from p2p_llm_tunnel_tpu.ops.attention import causal_attention
+
+
+def _ulysses_local(
+    q: jnp.ndarray,  # [B, T/sp, H, D] this device's sequence shard
+    k: jnp.ndarray,  # [B, T/sp, K, D]
+    v: jnp.ndarray,  # [B, T/sp, K, D]
+    valid: jnp.ndarray,  # [B, T] replicated (full-sequence pad mask)
+    *,
+    axis_name: str,
+    scale: float,
+    softcap: Optional[float],
+    window: Optional[int],
+) -> jnp.ndarray:
+    # seq-shard → head-shard: split heads (axis 2), gather sequence (axis 1).
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, tiled=True
+    )
+    q_h = a2a(q, split_axis=2, concat_axis=1)  # [B, T, H/sp, D]
+    k_h = a2a(k, split_axis=2, concat_axis=1)  # [B, T, K/sp, D]
+    v_h = a2a(v, split_axis=2, concat_axis=1)
+    out = causal_attention(
+        q_h, k_h, v_h, valid, scale=scale, softcap=softcap, window=window
+    )  # [B, T, H/sp, D]
+    # head-shard → seq-shard for the residual stream.
+    return a2a(out, split_axis=1, concat_axis=2)  # [B, T/sp, H, D]
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    head_axis: Optional[str] = None,
+):
+    """Build a jittable Ulysses attention fn over ``mesh``'s sequence axis.
+
+    Returned fn takes GLOBAL arrays q [B,T,H,D], k/v [B,T,K,D] and a full
+    ``valid`` [B,T] mask (replicated), plus an optional window, and returns
+    [B,T,H,D] sequence-sharded like its inputs — the same contract as
+    make_ring_attention, with window/pad-mask support ring lacks.
+
+    ``head_axis`` ("tp") composes with tensor parallelism exactly as
+    make_ring_attention does: heads shard on tp OUTSIDE the all_to_all, so
+    each tp shard swaps only its own head slice over sp (needs H/tp and
+    K/tp divisible by sp).
+    """
+    sp = mesh.shape[axis_name]
+    tp = mesh.shape[head_axis] if head_axis else 1
+
+    def fn(q, k, v, valid, window=None):
+        h, kh, d = q.shape[2], k.shape[2], q.shape[-1]
+        if (h // tp) % sp or (kh // tp) % sp or h % tp or kh % tp:
+            raise ValueError(
+                f"ulysses needs per-tp-shard head counts divisible by "
+                f"sp={sp}; got H={h}, K={kh}, tp={tp} (use ring attention)"
+            )
+        s = scale if scale is not None else d**-0.5
+        local = functools.partial(
+            _ulysses_local, axis_name=axis_name, scale=s, softcap=softcap,
+            window=window,
+        )
+        spec = P(None, axis_name, head_axis, None)
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P()),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return sharded(q, k, v, valid)
+
+    return fn

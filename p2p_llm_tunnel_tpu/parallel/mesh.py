@@ -18,7 +18,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-AXES = ("dp", "tp", "sp")
+AXES = ("dp", "ep", "tp", "sp")
 
 
 def make_mesh(

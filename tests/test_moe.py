@@ -123,3 +123,29 @@ def test_mixtral_preset_and_converter_registered():
     cfg = get_config("mixtral-8x7b")
     assert cfg.n_experts == 8 and cfg.n_experts_per_tok == 2
     assert "mixtral" in CONVERTERS
+
+
+def test_moe_engine_with_ep_mesh(cpu_devices):
+    """EngineConfig.ep reaches the expert-parallel sharding: expert weights
+    land ep-sharded and generation works end to end."""
+    import asyncio
+
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny-moe", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2, ep=2)
+    )
+    assert "ep" in str(eng.params["blocks"]["moe_gate"].sharding.spec)
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"experts"), max_new_tokens=4,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 4

@@ -1,0 +1,145 @@
+"""Ulysses all-to-all sequence parallelism vs the dense oracle (CPU mesh).
+
+The second SP strategy of SURVEY §5 (ring attention is the first); pinned
+to ops/attention.causal_attention over every knob ring cannot do: pad
+masks and sliding windows survive because each device attends over the
+full sequence for its head shard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.models.config import get_config
+from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+from p2p_llm_tunnel_tpu.ops.attention import causal_attention
+from p2p_llm_tunnel_tpu.ops.ulysses_attention import make_ulysses_attention
+from p2p_llm_tunnel_tpu.parallel import make_mesh
+
+
+def _qkv(b=2, t=16, h=4, kh=2, d=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, kh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, kh, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sp", [2])
+def test_matches_dense_oracle(cpu_devices, sp):
+    q, k, v = _qkv()
+    valid = jnp.ones((2, 16), bool)
+    mesh = make_mesh(sp=sp, devices=cpu_devices[:sp])
+    ulysses = make_ulysses_attention(mesh, "sp")
+    want = causal_attention(q, k, v, valid)
+    got = jax.jit(lambda *a: ulysses(*a))(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pad_mask_and_window_supported(cpu_devices):
+    """The two capabilities ring attention lacks: ragged pad masks and
+    sliding windows both match the dense oracle."""
+    q, k, v = _qkv(seed=1)
+    valid = jnp.arange(16)[None, :] < jnp.array([[10], [16]])
+    mesh = make_mesh(sp=2, devices=cpu_devices[:2])
+    ulysses = make_ulysses_attention(mesh, "sp")
+    for window in (None, 4):
+        want = causal_attention(q, k, v, valid, window=window)
+        got = jax.jit(
+            lambda q_, k_, v_, va: ulysses(q_, k_, v_, va, window=window)
+        )(q, k, v, valid)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"window={window}")
+
+
+def test_rejects_indivisible_heads(cpu_devices):
+    q, k, v = _qkv(h=4, kh=2)
+    mesh = make_mesh(sp=4, devices=cpu_devices[:4])
+    ulysses = make_ulysses_attention(mesh, "sp")
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses(q, k, v, jnp.ones((2, 16), bool))
+
+
+def test_full_model_prefill_ulysses(cpu_devices):
+    """End-to-end prefill with sp_mode='ulysses' matches the unsharded
+    forward — including on the WINDOWED gemma-style config that the ring
+    path must reject."""
+    for preset in ("tiny", "tiny-gemma"):
+        cfg = get_config(preset, sp_mode="ulysses")
+        params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        valid = jnp.arange(16)[None, :] < jnp.array([[12], [16]])
+        want, _, _ = prefill(cfg, params, tokens, valid)
+        mesh = make_mesh(sp=2, devices=cpu_devices[:2])
+        got, _, _ = jax.jit(
+            lambda p, tok, va: prefill(cfg, p, tok, va, mesh=mesh)
+        )(params, tokens, valid)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"ulysses prefill diverges on {preset}",
+        )
+
+
+def test_ring_still_rejects_windows(cpu_devices):
+    cfg = get_config("tiny-gemma")  # windowed, sp_mode defaults to ring
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    mesh = make_mesh(sp=2, devices=cpu_devices[:2])
+    with pytest.raises(NotImplementedError, match="ring"):
+        prefill(cfg, params, jnp.zeros((2, 16), jnp.int32),
+                jnp.ones((2, 16), bool), mesh=mesh)
+
+
+def test_engine_sp_ulysses_generates(cpu_devices):
+    import asyncio
+
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", decode_steps=2,
+                                sp=2, sp_mode="ulysses")
+    )
+    assert eng.mcfg.sp_mode == "ulysses"
+
+    async def main():
+        await eng.start()
+        toks = []
+        async for ev in eng.generate(list(b"ulysses"), max_new_tokens=5,
+                                     stop_ids=()):
+            toks.append(ev.token_id)
+        await eng.stop()
+        return toks
+
+    toks = asyncio.run(asyncio.wait_for(main(), 120))
+    assert len(toks) == 5
+
+
+def test_ulysses_composes_with_tp(cpu_devices):
+    """tp×sp mesh: heads shard on tp outside the all_to_all; numerics still
+    match the dense oracle (each tp shard swaps only its own head slice)."""
+    q, k, v = _qkv(h=4, kh=4, t=16)
+    valid = jnp.ones((2, 16), bool)
+    mesh = make_mesh(tp=2, sp=2, devices=cpu_devices[:4])
+    ulysses = make_ulysses_attention(mesh, "sp", head_axis="tp")
+    want = causal_attention(q, k, v, valid)
+    got = jax.jit(lambda *a: ulysses(*a))(q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_explicit_model_cfg_sp_mode_not_reverted(cpu_devices):
+    """An explicitly-ulysses model_cfg must survive a default EngineConfig
+    (the engine only promotes NON-default sp_mode choices)."""
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny", sp_mode="ulysses")
+    eng = InferenceEngine(
+        model_cfg=cfg,
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", sp=2),
+    )
+    assert eng.mcfg.sp_mode == "ulysses"
