@@ -91,9 +91,33 @@ def build_parser() -> argparse.ArgumentParser:
                        help="tensor-parallel degree over the device mesh")
     serve.add_argument("--ckpt", default=_env("TUNNEL_CKPT"),
                        help="orbax checkpoint path (default: random init)")
-    serve.add_argument("--quant", choices=("none", "int8"),
+    serve.add_argument("--quant", choices=("none", "int8", "w8a8"),
                        default=_env("TUNNEL_QUANT", "none"),
-                       help="weight quantization (int8 halves HBM traffic)")
+                       help="weight quantization: int8 halves decode HBM "
+                            "traffic; w8a8 also quantizes activations "
+                            "(int8 MXU dots)")
+    serve.add_argument("--kv-quant", choices=("none", "int8"),
+                       default=_env("TUNNEL_KV_QUANT", "none"),
+                       help="KV-cache quantization (halves the long-context "
+                            "KV read term)")
+    serve.add_argument("--prefill-act-quant", action="store_true",
+                       default=_env("TUNNEL_PREFILL_ACT_QUANT", "") == "1",
+                       help="with --quant int8: run PREFILL activations "
+                            "int8 too (2x MXU rate where prefill is "
+                            "compute-bound); decode stays weight-only")
+    serve.add_argument("--flash-decode", action="store_true",
+                       default=_env("TUNNEL_FLASH_DECODE", "") == "1",
+                       help="use the Pallas decode-attention kernel on "
+                            "tileable shapes")
+    serve.add_argument("--sp", type=int, default=int(_env("TUNNEL_SP", "1")),
+                       help="sequence-parallel degree for prefill "
+                            "(long-context)")
+    serve.add_argument("--sp-mode", choices=("ring", "ulysses"),
+                       default=_env("TUNNEL_SP_MODE", "ring"),
+                       help="SP strategy: ring (ppermute KV rotation) or "
+                            "ulysses (all_to_all; supports sliding windows)")
+    serve.add_argument("--ep", type=int, default=int(_env("TUNNEL_EP", "1")),
+                       help="expert-parallel degree for MoE models")
     serve.add_argument("--tokenizer", default=_env("TUNNEL_TOKENIZER"),
                        help="HF tokenizer path for real checkpoints "
                             "(default: byte-level)")
@@ -222,8 +246,14 @@ async def _engine_backend(args):
                     max_seq=args.max_seq,
                     decode_steps=args.decode_steps,
                     tp=args.tp,
+                    sp=args.sp,
+                    sp_mode=args.sp_mode,
+                    ep=args.ep,
                     ckpt_path=args.ckpt,
                     quant=args.quant,
+                    kv_quant=args.kv_quant,
+                    prefill_act_quant=args.prefill_act_quant,
+                    flash_decode=args.flash_decode,
                     seed=seed,
                 )
             )

@@ -193,8 +193,10 @@ def _prefill_attention_fn(cfg: ModelConfig, mesh, t: int):
     """Pick the prefill attention implementation for this (config, mesh).
 
     Returns ``fn(q, k, v, valid, window) -> [B,T,H,D]``.  Selection:
-    - ring attention when the mesh has an ``sp`` axis > 1 (sequence sharded
-      over the ICI ring; long-context serving — SURVEY §5);
+    - sp axis > 1 → sequence parallelism, strategy per ``cfg.sp_mode``:
+      "ulysses" (all_to_all head/sequence swap; windows and pad masks
+      work) or "ring" (ppermute KV rotation over the ICI ring; rejects
+      sliding windows) — SURVEY §5's two long-context strategies;
     - the Pallas flash kernel when shapes tile, wrapped in shard_map over
       the head axes when a ``tp`` axis > 1 is present (pallas_call is not
       GSPMD-partitioned — VERDICT r2 item 6);
