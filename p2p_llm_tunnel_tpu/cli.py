@@ -100,15 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
                        default=_env("TUNNEL_KV_QUANT", "none"),
                        help="KV-cache quantization (halves the long-context "
                             "KV read term)")
-    serve.add_argument("--prefill-act-quant", action="store_true",
+    serve.add_argument("--prefill-act-quant",
+                       action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFILL_ACT_QUANT", "") == "1",
                        help="with --quant int8: run PREFILL activations "
                             "int8 too (2x MXU rate where prefill is "
-                            "compute-bound); decode stays weight-only")
-    serve.add_argument("--flash-decode", action="store_true",
+                            "compute-bound); decode stays weight-only "
+                            "(--no-prefill-act-quant overrides the env)")
+    serve.add_argument("--flash-decode",
+                       action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_FLASH_DECODE", "") == "1",
                        help="use the Pallas decode-attention kernel on "
-                            "tileable shapes")
+                            "tileable shapes (--no-flash-decode overrides "
+                            "the env)")
     serve.add_argument("--sp", type=int, default=int(_env("TUNNEL_SP", "1")),
                        help="sequence-parallel degree for prefill "
                             "(long-context)")
