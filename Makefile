@@ -1,10 +1,14 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native test test-unit test-local test-race bench serve proxy signal multichip
+.PHONY: native native-san test test-unit test-local test-race bench serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
+
+native-san:        ## ASan+UBSan self-test of the C++ codec (fuzz included)
+	scripts/build-native.sh sanitize
+	native/build/tunnel_frames_test
 
 test: test-unit test-local
 
@@ -17,7 +21,8 @@ test-local:        ## hermetic 4-process end-to-end over real sockets
 # A2's TSan-equivalent CI job: asyncio debug mode surfaces never-awaited
 # coroutines, non-threadsafe loop calls, and >100ms callback stalls; the -W
 # flag turns the resulting RuntimeWarnings into test failures.
-test-race:         ## concurrency suites under asyncio debug mode
+test-race:         ## concurrency suites under asyncio debug mode + native sanitizers
+	-$(MAKE) native-san  # best-effort: no C++ toolchain must not block the Python suites
 	PYTHONASYNCIODEBUG=1 python -W error::RuntimeWarning -m pytest \
 		tests/test_engine_stress.py tests/test_transport_net.py \
 		tests/test_transport_lossy.py tests/test_flow_control.py \

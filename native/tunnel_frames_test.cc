@@ -1,0 +1,221 @@
+// Sanitizer self-test for the native frame codec (SURVEY §5 "race
+// detection / sanitizers": the C++ rebuild loses Rust's language-level
+// memory safety — reference tunnel/src/protocol.rs gets bounds checks from
+// the language; this binary is the ASan/UBSan equivalent CI job).
+//
+// Build + run:  make native-san   (g++ -fsanitize=address,undefined)
+//
+// Covers every extern-"C" entry point with nominal, boundary, and
+// adversarial inputs, then a deterministic pseudo-random fuzz loop over
+// tf_batch_parse — the parser that faces attacker-controlled bytes off the
+// TCP transport.  Exit code 0 = all assertions passed and no sanitizer
+// report fired.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int32_t tf_encode_frame(uint8_t, uint32_t, const uint8_t*, uint32_t, uint8_t*,
+                        uint32_t);
+int32_t tf_decode_frame(const uint8_t*, uint32_t, uint8_t*, uint32_t*,
+                        uint32_t*);
+int32_t tf_chunk_body(uint8_t, uint32_t, const uint8_t*, uint32_t, uint32_t,
+                      uint8_t*, uint32_t, uint32_t*);
+int32_t tf_batch_parse(const uint8_t*, uint32_t, uint32_t, uint32_t*,
+                       uint32_t*, uint32_t, uint32_t*);
+uint32_t tf_max_frame_size();
+}
+
+namespace {
+
+constexpr uint32_t kHeader = 5;
+
+// xorshift32: deterministic fuzz input, no libc rand() state.
+uint32_t rng_state = 0x9e3779b9u;
+uint32_t next_u32() {
+  uint32_t x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  return rng_state = x;
+}
+
+void test_encode_decode_roundtrip() {
+  uint8_t payload[256];
+  for (int i = 0; i < 256; ++i) payload[i] = static_cast<uint8_t>(i);
+  uint8_t out[512];
+  int32_t n = tf_encode_frame(21, 0xDEADBEEFu, payload, 256, out, sizeof out);
+  assert(n == static_cast<int32_t>(kHeader + 256));
+  uint8_t t;
+  uint32_t sid, plen;
+  assert(tf_decode_frame(out, static_cast<uint32_t>(n), &t, &sid, &plen) == 0);
+  assert(t == 21 && sid == 0xDEADBEEFu && plen == 256);
+  assert(std::memcmp(out + kHeader, payload, 256) == 0);
+
+  // Header-only frame (REQ_END), stream_id extremes.
+  n = tf_encode_frame(12, 0u, nullptr, 0, out, sizeof out);
+  assert(n == static_cast<int32_t>(kHeader));
+  assert(tf_decode_frame(out, kHeader, &t, &sid, &plen) == 0 && sid == 0);
+  n = tf_encode_frame(12, UINT32_MAX, nullptr, 0, out, sizeof out);
+  assert(n > 0);
+  assert(tf_decode_frame(out, kHeader, &t, &sid, &plen) == 0 &&
+         sid == UINT32_MAX);
+}
+
+void test_encode_limits() {
+  std::vector<uint8_t> big(tf_max_frame_size(), 0xAB);
+  std::vector<uint8_t> out(tf_max_frame_size() + 8);
+  // Exactly max size passes; one byte over fails.
+  uint32_t max_payload = tf_max_frame_size() - kHeader;
+  assert(tf_encode_frame(11, 1, big.data(), max_payload, out.data(),
+                         static_cast<uint32_t>(out.size())) ==
+         static_cast<int32_t>(tf_max_frame_size()));
+  assert(tf_encode_frame(11, 1, big.data(), max_payload + 1, out.data(),
+                         static_cast<uint32_t>(out.size())) == -2 /*TOO_LARGE*/);
+  // Undersized output buffer is refused, not overrun.
+  assert(tf_encode_frame(11, 1, big.data(), 64, out.data(), 32) ==
+         -4 /*BUFFER_TOO_SMALL*/);
+}
+
+void test_decode_malformed() {
+  uint8_t t;
+  uint32_t sid, plen;
+  uint8_t buf[8] = {10, 0, 0, 0, 1, 'x', 'y', 'z'};
+  assert(tf_decode_frame(buf, 4, &t, &sid, &plen) == -1 /*TOO_SHORT*/);
+  assert(tf_decode_frame(buf, 0, &t, &sid, &plen) == -1);
+  buf[0] = 77;  // unknown type byte
+  assert(tf_decode_frame(buf, 8, &t, &sid, &plen) == -3 /*UNKNOWN_TYPE*/);
+}
+
+void test_chunk_body() {
+  std::vector<uint8_t> body(100000);
+  for (size_t i = 0; i < body.size(); ++i)
+    body[i] = static_cast<uint8_t>(next_u32());
+  std::vector<uint8_t> out(body.size() + 4096);
+  uint32_t n_frames = 0;
+  int32_t written =
+      tf_chunk_body(21, 7, body.data(), static_cast<uint32_t>(body.size()),
+                    65408, out.data(), static_cast<uint32_t>(out.size()),
+                    &n_frames);
+  assert(written > 0 && n_frames == 2);  // 65408 + 34592
+  // Re-parse what chunking wrote and reassemble.
+  uint32_t offs[8], lens[8], consumed = 0;
+  int32_t found = tf_batch_parse(out.data(), static_cast<uint32_t>(written),
+                                 tf_max_frame_size(), offs, lens, 8, &consumed);
+  assert(found == 2 && consumed == static_cast<uint32_t>(written));
+  std::vector<uint8_t> rebuilt;
+  for (int i = 0; i < found; ++i) {
+    uint8_t t;
+    uint32_t sid, plen;
+    assert(tf_decode_frame(out.data() + offs[i], lens[i], &t, &sid, &plen) ==
+           0);
+    assert(t == 21 && sid == 7);
+    rebuilt.insert(rebuilt.end(), out.data() + offs[i] + kHeader,
+                   out.data() + offs[i] + kHeader + plen);
+  }
+  assert(rebuilt == body);
+
+  // Degenerate chunk sizes refused.
+  assert(tf_chunk_body(21, 7, body.data(), 100, 0, out.data(),
+                       static_cast<uint32_t>(out.size()),
+                       &n_frames) == -2);
+  assert(tf_chunk_body(21, 7, body.data(), 100, tf_max_frame_size(),
+                       out.data(), static_cast<uint32_t>(out.size()),
+                       &n_frames) == -2);
+  // Output capacity exactly one byte short of the second frame.
+  written = tf_chunk_body(21, 7, body.data(), 1000, 600, out.data(),
+                          4 + kHeader + 600 + 4 + kHeader + 400 - 1, &n_frames);
+  assert(written == -4);
+}
+
+void test_batch_parse_partials() {
+  // Two frames back-to-back; feed in every prefix length and confirm the
+  // parser never reads past `len` and reports consumed correctly.
+  uint8_t frames[64];
+  uint32_t pos = 0;
+  for (int f = 0; f < 2; ++f) {
+    uint8_t frame[16];
+    int32_t n = tf_encode_frame(3, static_cast<uint32_t>(f),
+                                reinterpret_cast<const uint8_t*>("hi"), 2,
+                                frame, sizeof frame);
+    assert(n > 0);
+    frames[pos++] = 0;
+    frames[pos++] = 0;
+    frames[pos++] = 0;
+    frames[pos++] = static_cast<uint8_t>(n);
+    std::memcpy(frames + pos, frame, static_cast<size_t>(n));
+    pos += static_cast<uint32_t>(n);
+  }
+  for (uint32_t len = 0; len <= pos; ++len) {
+    uint32_t offs[4], lens[4], consumed = 0;
+    int32_t found =
+        tf_batch_parse(frames, len, tf_max_frame_size(), offs, lens, 4,
+                       &consumed);
+    assert(found >= 0 && consumed <= len);
+    int expected = len >= pos ? 2 : (len >= pos / 2 ? 1 : 0);
+    assert(found == expected);
+  }
+  // max_frames smaller than available: parser stops, consumed covers only
+  // the frames it reported.
+  uint32_t offs[1], lens[1], consumed = 0;
+  assert(tf_batch_parse(frames, pos, tf_max_frame_size(), offs, lens, 1,
+                        &consumed) == 1);
+  assert(consumed == pos / 2);
+}
+
+void test_batch_parse_hostile() {
+  // Length prefix larger than max_frame → rejected (DoS guard).
+  uint8_t evil[8] = {0xFF, 0xFF, 0xFF, 0xFF, 1, 2, 3, 4};
+  uint32_t offs[4], lens[4], consumed = 0;
+  assert(tf_batch_parse(evil, 8, tf_max_frame_size(), offs, lens, 4,
+                        &consumed) == -2);
+  // Length prefix below the header size → rejected.
+  uint8_t tiny[8] = {0, 0, 0, 2, 1, 2, 3, 4};
+  assert(tf_batch_parse(tiny, 8, tf_max_frame_size(), offs, lens, 4,
+                        &consumed) == -1);
+}
+
+void fuzz_batch_parse(int iters) {
+  std::vector<uint8_t> buf(4096);
+  std::vector<uint32_t> offs(128), lens(128);
+  for (int it = 0; it < iters; ++it) {
+    uint32_t len = next_u32() % buf.size();
+    for (uint32_t i = 0; i < len; ++i)
+      buf[i] = static_cast<uint8_t>(next_u32());
+    uint32_t consumed = 0;
+    int32_t found =
+        tf_batch_parse(buf.data(), len, tf_max_frame_size(), offs.data(),
+                       lens.data(), 128, &consumed);
+    assert(consumed <= len);
+    if (found >= 0) {
+      // Every reported frame must lie fully inside the consumed region.
+      for (int i = 0; i < found; ++i)
+        assert(offs[static_cast<size_t>(i)] + lens[static_cast<size_t>(i)] <=
+               consumed);
+    }
+    // Decode whatever was found — must never touch memory past the buffer.
+    for (int i = 0; found > 0 && i < found; ++i) {
+      uint8_t t;
+      uint32_t sid, plen;
+      tf_decode_frame(buf.data() + offs[static_cast<size_t>(i)],
+                      lens[static_cast<size_t>(i)], &t, &sid, &plen);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  test_encode_decode_roundtrip();
+  test_encode_limits();
+  test_decode_malformed();
+  test_chunk_body();
+  test_batch_parse_partials();
+  test_batch_parse_hostile();
+  fuzz_batch_parse(20000);
+  std::printf("native codec sanitizer self-test: OK\n");
+  return 0;
+}
