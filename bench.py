@@ -93,53 +93,26 @@ def _probe_platform(timeout: float) -> str:
     return "cpu"
 
 
-async def _one_client(
-    port: int, prompt: str, max_tokens: int, results: list, idx: int
-) -> None:
-    from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+_CLIENT_MOD = None
 
-    body = json.dumps(
-        {
-            "model": "bench",
-            "messages": [{"role": "user", "content": prompt}],
-            "max_tokens": max_tokens,
-            "stream": True,
-            "temperature": 0.0,
-            "ignore_eos": True,
-        }
-    ).encode()
-    t0 = time.monotonic()
-    resp = await http_request(
-        "POST",
-        f"http://127.0.0.1:{port}/v1/chat/completions",
-        {"content-type": "application/json"},
-        body,
-        timeout=600.0,
-    )
-    assert resp.status == 200, f"client {idx}: HTTP {resp.status}"
-    ttft = None
-    n_tokens = 0
-    buf = b""
-    async for chunk in resp.iter_chunks():
-        buf += chunk
-        while b"\n\n" in buf:
-            event, buf = buf.split(b"\n\n", 1)
-            if not event.startswith(b"data: "):
-                continue
-            data = event[6:]
-            if data == b"[DONE]":
-                continue
-            payload = json.loads(data)
-            delta = payload["choices"][0]["delta"]
-            # First delta (the role chunk) marks first-token arrival; with a
-            # full-size vocab + random weights most content deltas are empty.
-            if ttft is None and delta:
-                ttft = time.monotonic() - t0
-            if delta.get("content"):
-                n_tokens += 1
-    results.append(
-        {"ttft_s": ttft, "tokens": n_tokens, "wall_s": time.monotonic() - t0}
-    )
+
+def _one_client(port: int, prompt: str, max_tokens: int, results: list, idx: int):
+    """The SSE client (token/TTFT definitions) lives in ONE place —
+    scripts/bench_clients.py — used both by the out-of-process load
+    generator and by this module's warmup / BENCH_INPROC_CLIENTS paths, so
+    the metric definition cannot drift between them."""
+    global _CLIENT_MOD
+    if _CLIENT_MOD is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "scripts", "bench_clients.py",
+        )
+        spec = importlib.util.spec_from_file_location("bench_clients", path)
+        _CLIENT_MOD = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_CLIENT_MOD)
+    return _CLIENT_MOD.one_client(port, prompt, max_tokens, results, idx)
 
 
 def _model_flops_params(model: str):
@@ -246,16 +219,41 @@ async def _run_attempt(model: str) -> dict:
 
             jax.profiler.start_trace(profile_dir)
             profiling = True
-        results: list = []
+        # The client fan-out runs in its OWN process so the server stack
+        # (proxy + tunnel + serve + engine host path) never competes with
+        # client-side SSE parsing for this interpreter — the reference is
+        # always load-tested from external processes too (curl in
+        # scripts/test-tunnel.sh).  BENCH_INPROC_CLIENTS=1 restores the
+        # old in-process fan-out for debugging.
         tokens_before = global_metrics.counter("engine_tokens_total")
         t_start = time.monotonic()
-        await asyncio.gather(
-            *(
-                _one_client(port, f"{prompt} ({i})", max_tokens, results, i)
-                for i in range(clients)
+        if os.environ.get("BENCH_INPROC_CLIENTS") == "1":
+            results: list = []
+            await asyncio.gather(
+                *(
+                    _one_client(port, f"{prompt} ({i})", max_tokens, results, i)
+                    for i in range(clients)
+                )
             )
-        )
-        wall = time.monotonic() - t_start
+            wall = time.monotonic() - t_start
+        else:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            cfg = json.dumps({
+                "port": port, "clients": clients,
+                "max_tokens": max_tokens, "prompt": prompt,
+            })
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, os.path.join(repo, "scripts", "bench_clients.py"),
+                cfg,
+                stdout=asyncio.subprocess.PIPE,
+                env=dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu"),
+            )
+            out, _ = await proc.communicate()
+            if proc.returncode != 0:
+                raise RuntimeError(f"loadgen exited rc={proc.returncode}")
+            payload = json.loads(out.decode().strip().splitlines()[-1])
+            results = payload["results"]
+            wall = payload["wall_s"]  # child-side fan-out wall (excludes spawn)
         engine_tokens = global_metrics.counter("engine_tokens_total") - tokens_before
         _log(f"measured {engine_tokens:.0f} tokens in {wall:.1f}s")
     finally:

@@ -23,6 +23,7 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 from p2p_llm_tunnel_tpu.endpoints import http11
 from p2p_llm_tunnel_tpu.protocol.frames import (
     INITIAL_CREDIT,
+    MAX_BODY_CHUNK,
     Agree,
     Hello,
     MessageType,
@@ -124,6 +125,93 @@ def http_backend(upstream_url: str, advertise_prefix: str = "/") -> Backend:
     return backend
 
 
+async def _coalesce(
+    chunks: AsyncIterator[bytes], max_bytes: int = MAX_BODY_CHUNK
+) -> AsyncIterator[bytes]:
+    """Merge backlogged body chunks into fewer, larger frame payloads.
+
+    A pump task drains the backend iterator into a queue at its own pace;
+    each yield hands over EVERYTHING currently queued (capped at
+    ``max_bytes``, the single-frame payload limit).  When the consumer
+    (frame encode → tunnel send → flow-control debit) keeps up, chunks pass
+    through 1:1 with no added latency — the first chunk of a stream is
+    yielded the moment it arrives, so TTFT is unaffected.  When the producer
+    runs ahead (a TPU decode burst lands 512 tokens at once while the
+    per-frame path does its asyncio hops), the backlog rides ONE frame
+    instead of one-per-token.  Chunk *contents* are untouched: an SSE
+    consumer sees the same byte stream and the same event count.
+
+    The reference has no analog — its per-chunk costs sit in SCTP inside
+    the webrtc crate (serve.rs:263-277 forwards chunks 1:1); here the
+    per-frame cost is Python asyncio, which at 1800+ tok/s × 32 streams is
+    material (PERF.md).
+    """
+    queue: asyncio.Queue = asyncio.Queue()
+    _done = object()
+    # Byte-bounded buffer: the pump must NOT outrun the consumer without
+    # limit, or it would defeat the flow-control backpressure the direct
+    # `async for` used to provide (a stalled WAN client on an ignore_eos
+    # stream would otherwise buffer the whole generation in this queue).
+    # The pump pauses while more than ~4 frames' worth is in flight; the
+    # consumer reopens the window as it drains.
+    max_buffer = 4 * max_bytes
+    buffered = 0
+    space = asyncio.Event()
+    space.set()
+
+    async def pump() -> None:
+        nonlocal buffered
+        try:
+            async for c in chunks:
+                while buffered >= max_buffer:
+                    space.clear()
+                    await space.wait()
+                buffered += len(c)
+                queue.put_nowait(c)
+        except Exception as e:  # propagate mid-stream backend failures
+            queue.put_nowait(e)
+        finally:
+            # Unconditional terminator — also on CancelledError and other
+            # BaseExceptions, so the consumer can never block forever on a
+            # dead pump (the queue is unbounded, put_nowait cannot fail).
+            queue.put_nowait(_done)
+
+    def _consumed(c: bytes) -> None:
+        nonlocal buffered
+        buffered -= len(c)
+        if buffered < max_buffer:
+            space.set()
+
+    task = asyncio.create_task(pump())
+    try:
+        while True:
+            item = await queue.get()
+            if item is _done:
+                return
+            if isinstance(item, Exception):
+                raise item
+            _consumed(item)
+            buf = [item]
+            size = len(item)
+            while size < max_bytes and not queue.empty():
+                nxt = queue.get_nowait()
+                if nxt is _done or isinstance(nxt, Exception):
+                    yield b"".join(buf)
+                    if nxt is _done:
+                        return
+                    raise nxt
+                _consumed(nxt)
+                buf.append(nxt)
+                size += len(nxt)
+            yield b"".join(buf) if len(buf) > 1 else item
+    finally:
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
 async def _handle_request(
     channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
     flow: FlowControl,
@@ -164,7 +252,7 @@ async def _handle_request_inner(
         TunnelMessage.res_headers(ResponseHeaders(stream_id, status, headers)).encode()
     )
     try:
-        async for chunk in chunks:
+        async for chunk in _coalesce(chunks):
             await flow.consume(stream_id, len(chunk))
             for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
                 await channel.send(frame)

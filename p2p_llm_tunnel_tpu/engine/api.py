@@ -111,21 +111,30 @@ class EngineAPI:
     async def _openai_stream(
         self, prompt_ids, kwargs, object_name: str, completion_id: str
     ) -> AsyncIterator[bytes]:
+        # Per-token cost matters at 1800+ tok/s x 32 streams: fold the
+        # stream-constant envelope once and splice only the delta/finish in.
+        # ``created`` is stamped once per stream (OpenAI semantics: chunks of
+        # one completion share a created time).
+        head = (
+            'data: {"id": ' + json.dumps(completion_id)
+            + ', "object": ' + json.dumps(object_name)
+            + f', "created": {int(time.time())}'
+            + ', "model": ' + json.dumps(self.model_name)
+            + ', "choices": [{"index": 0, "delta": '
+        )
+
         def chunk(delta, finish):
             return (
-                "data: "
-                + json.dumps(
-                    {
-                        "id": completion_id,
-                        "object": object_name,
-                        "created": int(time.time()),
-                        "model": self.model_name,
-                        "choices": [
-                            {"index": 0, "delta": delta, "finish_reason": finish}
-                        ],
-                    }
-                )
-                + "\n\n"
+                head + json.dumps(delta) + ', "finish_reason": '
+                + json.dumps(finish) + "}]}\n\n"
+            ).encode()
+
+        content_head = head + '{"content": '
+
+        def content_chunk(text):  # the hot path: one per decoded token
+            return (
+                content_head + json.dumps(text)
+                + '}, "finish_reason": null}]}\n\n'
             ).encode()
 
         finish_reason = "stop"
@@ -139,7 +148,7 @@ class EngineAPI:
                 yield chunk({"role": "assistant"}, None)
                 first = False
             if ev.text:
-                yield chunk({"content": ev.text}, None)
+                yield content_chunk(ev.text)
             if ev.finish_reason is not None:
                 finish_reason = ev.finish_reason
         yield chunk({}, finish_reason)

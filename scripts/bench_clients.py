@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Out-of-process load generator for bench.py.
+
+Runs the concurrent SSE clients in their OWN process so the server's event
+loop (proxy + tunnel + serve + engine host path) is not competing with
+client-side HTTP parsing for the same interpreter — the reference's load
+(curl / external clients) never shares a process with the tunnel either
+(scripts/test-tunnel.sh:88-96 drives it from separate curl processes).
+
+Protocol: argv JSON config in, one JSON line out on stdout:
+    {"results": [{"ttft_s": .., "tokens": N, "wall_s": ..} ...],
+     "wall_s": total_fanout_wall}
+
+Counts are CLIENT-side: a token is one SSE data event with non-empty
+delta.content, TTFT is the first delta of any kind — same definitions as
+the in-process bench client (bench.py _one_client).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+
+async def one_client(port: int, prompt: str, max_tokens: int, results: list,
+                     idx: int) -> None:
+    from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+
+    body = json.dumps(
+        {
+            "model": "bench",
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_tokens,
+            "stream": True,
+            "temperature": 0.0,
+            "ignore_eos": True,
+        }
+    ).encode()
+    t0 = time.monotonic()
+    resp = await http_request(
+        "POST",
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        {"content-type": "application/json"},
+        body,
+        timeout=600.0,
+    )
+    assert resp.status == 200, f"client {idx}: HTTP {resp.status}"
+    ttft = None
+    n_tokens = 0
+    buf = b""
+    async for chunk in resp.iter_chunks():
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            if not event.startswith(b"data: "):
+                continue
+            data = event[6:]
+            if data == b"[DONE]":
+                continue
+            payload = json.loads(data)
+            delta = payload["choices"][0]["delta"]
+            if ttft is None and delta:
+                ttft = time.monotonic() - t0
+            if delta.get("content"):
+                n_tokens += 1
+    results.append(
+        {"ttft_s": ttft, "tokens": n_tokens, "wall_s": time.monotonic() - t0}
+    )
+
+
+async def main() -> None:
+    cfg = json.loads(sys.argv[1])
+    port = int(cfg["port"])
+    clients = int(cfg["clients"])
+    max_tokens = int(cfg["max_tokens"])
+    prompt = cfg["prompt"]
+    results: list = []
+    t0 = time.monotonic()
+    await asyncio.gather(
+        *(
+            one_client(port, f"{prompt} ({i})", max_tokens, results, i)
+            for i in range(clients)
+        )
+    )
+    wall = time.monotonic() - t0
+    print(json.dumps({"results": results, "wall_s": wall}), flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -25,7 +25,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: still yields the key comparisons.
 GRID = [
     ("base-32x16", {}),
-    ("pf8-off", {"BENCH_PREFILL_ACT_QUANT": "0"}),
+    ("rows16", {"BENCH_PREFILL_ROWS": "16"}),
     ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
     ("steps8", {"BENCH_DECODE_STEPS": "8"}),
@@ -37,6 +37,10 @@ GRID = [
     ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                      "BENCH_CLIENTS": "16", "BENCH_KV_QUANT": "int8"}),
     ("w8a8", {"BENCH_QUANT": "w8a8"}),
+    # Last: this config's fresh bf16-prefill compile hung for 430+s on the
+    # tunneled chip once (04:52 wedge) — if it wedges the tunnel again it
+    # must not cost the configs above.
+    ("pf8-off", {"BENCH_PREFILL_ACT_QUANT": "0"}),
 ]
 
 

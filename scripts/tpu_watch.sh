@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Watch for the tunneled TPU to come back, then run the perf sweep.
+#
+# The axon device tunnel wedges intermittently (it died mid-round in r4's
+# first session and again at ~04:52 in the second); this watcher probes with
+# a short-timeout subprocess every PROBE_INTERVAL seconds and launches
+# scripts/perf_sweep.py once a real matmul succeeds.  Probe subprocesses are
+# disposable — a hung probe is killed by `timeout`, never wedging the
+# watcher itself.
+set -u
+cd "$(dirname "$0")/.."
+PROBE_INTERVAL="${PROBE_INTERVAL:-120}"
+MARKER="${MARKER:-/tmp/tpu_back.marker}"
+rm -f "$MARKER"
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((128, 128)); (x @ x).block_until_ready()
+" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) TPU back — launching sweep" >&2
+    touch "$MARKER"
+    exec python scripts/perf_sweep.py
+  fi
+  echo "$(date -u +%H:%M:%S) TPU still unreachable" >&2
+  sleep "$PROBE_INTERVAL"
+done

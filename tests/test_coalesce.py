@@ -1,0 +1,162 @@
+"""Tests for the serve-side body-chunk coalescer (endpoints/serve._coalesce).
+
+The coalescer merges backlogged SSE chunks into fewer frame payloads without
+changing the byte stream, first-chunk latency, or mid-stream error
+semantics (reference behavior contract: serve.rs:263-284)."""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints.serve import _coalesce
+
+
+async def collect(it):
+    out = []
+    async for x in it:
+        out.append(x)
+    return out
+
+
+def test_passthrough_when_consumer_keeps_up():
+    async def run():
+        async def slow_producer():
+            for i in range(5):
+                yield f"chunk{i}".encode()
+                await asyncio.sleep(0.01)  # consumer drains before the next
+
+        out = await collect(_coalesce(slow_producer()))
+        assert out == [f"chunk{i}".encode() for i in range(5)]
+
+    asyncio.run(run())
+
+
+def test_backlog_merges_into_one_payload():
+    async def run():
+        async def burst_producer():
+            for _ in range(100):
+                yield b"x"  # no await: all queued before the consumer runs
+
+        out = await collect(_coalesce(burst_producer()))
+        # First chunk may pass through alone (it was yielded the moment it
+        # arrived); everything backlogged after it arrives merged.
+        assert b"".join(out) == b"x" * 100
+        assert len(out) < 100
+
+    asyncio.run(run())
+
+
+def test_respects_max_bytes_cap():
+    async def run():
+        async def producer():
+            for _ in range(10):
+                yield b"a" * 400
+
+        out = await collect(_coalesce(producer(), max_bytes=1000))
+        assert b"".join(out) == b"a" * 4000
+        # The cap is checked before appending, so a payload stays below
+        # cap + one chunk.
+        assert all(len(c) < 1000 + 400 for c in out)
+
+    asyncio.run(run())
+
+
+def test_first_chunk_not_delayed():
+    """TTFT contract: the first chunk must be yielded without waiting for
+    the producer to finish or pause."""
+
+    async def run():
+        gate = asyncio.Event()
+
+        async def producer():
+            yield b"first"
+            await gate.wait()  # blocks until the test releases it
+            yield b"second"
+
+        agen = _coalesce(producer())
+        first = await asyncio.wait_for(agen.__anext__(), timeout=1.0)
+        assert first == b"first"
+        gate.set()
+        rest = await collect(agen)
+        assert rest == [b"second"]
+
+    asyncio.run(run())
+
+
+def test_midstream_exception_propagates_after_buffered_bytes():
+    """A backend failure mid-stream must surface as an exception (the serve
+    handler turns it into an ERROR frame) — but only after every chunk that
+    preceded it has been delivered."""
+
+    class Boom(RuntimeError):
+        pass
+
+    async def run():
+        async def producer():
+            yield b"ok1"
+            yield b"ok2"
+            raise Boom("upstream died")
+
+        got = []
+        with pytest.raises(Boom):
+            async for c in _coalesce(producer()):
+                got.append(c)
+        assert b"".join(got) == b"ok1ok2"
+
+    asyncio.run(run())
+
+
+def test_consumer_cancellation_stops_pump():
+    async def run():
+        cancelled = asyncio.Event()
+
+        async def producer():
+            try:
+                while True:
+                    yield b"data"
+                    await asyncio.sleep(0.005)
+            finally:
+                cancelled.set()
+
+        agen = _coalesce(producer())
+        assert await agen.__anext__() == b"data"
+        await agen.aclose()
+        await asyncio.wait_for(cancelled.wait(), timeout=1.0)
+
+    asyncio.run(run())
+
+
+def test_pump_backpressure_bounds_buffering():
+    """The pump must pause once ~4 frames' worth is buffered, not drain an
+    unbounded producer into memory while the consumer is stalled (the
+    flow-control guarantee the direct `async for` used to provide)."""
+
+    async def run():
+        produced = 0
+
+        async def producer():
+            nonlocal produced
+            for _ in range(1000):
+                produced += 1
+                yield b"x" * 100
+
+        agen = _coalesce(producer(), max_bytes=200)  # buffer cap = 800 bytes
+        first = await agen.__anext__()
+        assert first  # consumer takes one payload, then stalls
+        await asyncio.sleep(0.05)  # give the pump every chance to run ahead
+        # <= cap/chunk + consumed + queued-before-cap slack, far below 1000.
+        assert produced < 30, f"pump ran unbounded: produced {produced} chunks"
+        await agen.aclose()
+
+    asyncio.run(run())
+
+
+def test_empty_stream():
+    async def run():
+        async def producer():
+            return
+            yield  # pragma: no cover
+
+        assert await collect(_coalesce(producer())) == []
+
+    asyncio.run(run())
