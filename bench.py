@@ -62,6 +62,7 @@ SAFE_OVERRIDES = {
     "BENCH_FLASH_DECODE": "0",
     "BENCH_KV_QUANT": "none",
     "BENCH_QUANT": "int8",
+    "BENCH_PREFIX_CACHE": "0",
 }
 
 
@@ -155,6 +156,12 @@ async def _run_attempt(model: str) -> dict:
     # An int8 KV cache forces the einsum decode path; record what ran.
     flash_decode = (os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
                     and kv_quant != "int8")
+    # Automatic prefix caching — on by default here AND in the serve CLI
+    # (TUNNEL_PREFIX_CACHE), so the benched config is the deployed default.
+    # The bench prompts share a prefix the way real traffic shares system
+    # prompts; the result JSON records the knob + hit counts so the number
+    # is interpretable, and the sweep's pfx-off row isolates its effect.
+    prefix_cache = os.environ.get("BENCH_PREFIX_CACHE", "1") == "1"
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
@@ -180,7 +187,7 @@ async def _run_attempt(model: str) -> dict:
             decode_steps=decode_steps, decode_steps_eager=eager_steps,
             prefill_rows=prefill_rows, quant=quant,
             prefill_act_quant=pf8, flash_decode=flash_decode,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, prefix_cache=prefix_cache,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -301,6 +308,10 @@ async def _run_attempt(model: str) -> dict:
         "prefill_act_quant": pf8,
         "kv_quant": kv_quant,
         "flash_decode": flash_decode,
+        "prefix_cache": prefix_cache,
+        "prefix_hit_tokens": global_metrics.counter(
+            "engine_prefix_hit_tokens_total"
+        ),
         "clients": clients,
         "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,
         "engine_tokens": engine_tokens,

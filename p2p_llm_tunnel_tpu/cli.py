@@ -113,6 +113,15 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the Pallas decode-attention kernel on "
                             "tileable shapes (--no-flash-decode overrides "
                             "the env)")
+    serve.add_argument("--prefix-cache",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_PREFIX_CACHE", "1") == "1",
+                       help="automatic prefix caching (default ON, matching "
+                            "bench.py): reuse prompt-prefix KV across "
+                            "requests (shared system prompts, resent "
+                            "conversations); pure latency optimization, "
+                            "outputs unchanged; disable with "
+                            "--no-prefix-cache or TUNNEL_PREFIX_CACHE=0")
     serve.add_argument("--sp", type=int, default=int(_env("TUNNEL_SP", "1")),
                        help="sequence-parallel degree for prefill "
                             "(long-context)")
@@ -258,6 +267,7 @@ async def _engine_backend(args):
                     kv_quant=args.kv_quant,
                     prefill_act_quant=args.prefill_act_quant,
                     flash_decode=args.flash_decode,
+                    prefix_cache=args.prefix_cache,
                     seed=seed,
                 )
             )

@@ -95,6 +95,22 @@ class EngineConfig:
     # doubles throughput; decode stays weight-only (it is HBM-bound, w8a8
     # measured at parity there — PERF.md) for best accuracy per token.
     prefill_act_quant: bool = False
+    # Automatic prefix caching (engine/prefix_cache.py): prompt KV is saved
+    # in blocks of ``min_prefill_bucket`` tokens keyed by content; a new
+    # request's longest cached prefix is copied into its slot and only the
+    # tail is prefilled (chunk_prefill_into_cache) — the TTFT lever for
+    # shared-system-prompt and resent-conversation workloads.
+    prefix_cache: bool = False
+    # Pool capacity in blocks (block 0 is scratch).  Sized so HBM cost is
+    # modest: 128 blocks x 16 tokens of 8B bf16 KV ~= 0.27 GB.
+    prefix_pool_blocks: int = 128
+    # How many tail buckets the chunk-prefill path supports: buckets
+    # min_prefill_bucket * 2^i for i < prefix_tail_buckets.  Requests whose
+    # post-match tail exceeds the largest bucket take the plain full-prefill
+    # path instead — each bucket is one compiled program (warmed up front,
+    # never on the serving path), and prefix reuse pays most when tails are
+    # short anyway.
+    prefix_tail_buckets: int = 2
 
 
 @dataclass
@@ -211,6 +227,45 @@ class InferenceEngine:
             self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
         self.scheduler = Scheduler(b, s)
 
+        # Prefix cache: host index + device block pool + jitted copy ops.
+        self._prefix = None
+        if self.ecfg.prefix_cache and self.ecfg.sp > 1:
+            # chunk_prefill_into_cache has no sequence-parallel attention
+            # path; silently bypassing ring/Ulysses on cache hits would
+            # defeat sp's memory scaling on exactly the long prompts it
+            # exists for.
+            log.warning("prefix cache disabled: not supported with sp>1")
+        elif self.ecfg.prefix_cache:
+            from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+                PrefixIndex,
+                init_pool,
+                make_copy_ops,
+            )
+
+            blk = self.ecfg.min_prefill_bucket
+            self._prefix_block = blk
+            self._prefix_max_blocks = max(1, s // blk)
+            # Static tail buckets the chunk program compiles for; longer
+            # tails fall back to plain prefill (see prefix_tail_buckets).
+            self._chunk_buckets = [
+                blk * (2 ** i)
+                for i in range(max(1, self.ecfg.prefix_tail_buckets))
+                if blk * (2 ** i) <= s
+            ]
+            self._prefix = PrefixIndex(blk, self.ecfg.prefix_pool_blocks)
+            self._pool = init_pool(
+                self.kv_cache, blk, self.ecfg.prefix_pool_blocks
+            )
+            if self.mesh is not None:
+                from p2p_llm_tunnel_tpu.parallel.sharding import shard_kv_cache
+
+                # Pool leaves are rank-congruent with cache leaves (K axis
+                # in the same place), so the cache specs apply verbatim.
+                self._pool = shard_kv_cache(self._pool, self.mesh)
+            self._copy_in, self._copy_out = make_copy_ops(
+                blk, self._prefix_max_blocks
+            )
+
         # Prefill may run a hotter quant mode than decode (prefill_act_quant):
         # a separate static config for the prefill program only.
         self._prefill_mcfg = self.mcfg
@@ -248,6 +303,9 @@ class InferenceEngine:
         )
         self._jit_prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1,), static_argnums=()
+        )
+        self._jit_chunk_prefill = jax.jit(
+            self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=()
         )
 
         # Device-side decode carry (created lazily) + host override patch.
@@ -301,6 +359,21 @@ class InferenceEngine:
         first = sampling.sample(last_logits, samp, key)
         return first, kv_cache
 
+    def _chunk_prefill_fn(
+        self, params, kv_cache, tokens, lengths, starts, slots, samp, key
+    ):
+        """Tail-only prefill against reused history KV (prefix-cache path)."""
+        from p2p_llm_tunnel_tpu.models.transformer import (
+            chunk_prefill_into_cache,
+        )
+
+        last_logits, kv_cache = chunk_prefill_into_cache(
+            self._prefill_mcfg, params, tokens, lengths, starts, kv_cache,
+            slots,
+        )
+        first = sampling.sample(last_logits, samp, key)
+        return first, kv_cache
+
     # -- lifecycle --------------------------------------------------------
 
     async def start(self) -> None:
@@ -341,6 +414,46 @@ class InferenceEngine:
         log.info(
             "decode warmup: %d view×steps variants compiled in %.1fs",
             len(views) * len(steps), time.monotonic() - t0,
+        )
+        if self._prefix is not None:
+            await loop.run_in_executor(self._executor, self._warm_prefix)
+
+    def _warm_prefix(self) -> None:
+        """Compile the prefix-cache programs (both copy ops + the smallest
+        tail-bucket chunk prefill) against scratch rows so none of them
+        cold-compiles on the serving path (executor thread)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
+
+        t0 = time.monotonic()
+        pids, bnos = pad_ids([0], [0], self._prefix_max_blocks, scratch=None)
+        self.kv_cache = self._copy_in(
+            self.kv_cache, self._pool, self._scratch_slot, pids, bnos
+        )
+        pids, bnos = pad_ids([0], [0], self._prefix_max_blocks, scratch=0)
+        self._pool = self._copy_out(
+            self._pool, self.kv_cache, self._scratch_slot, pids, bnos
+        )
+        nb = self.ecfg.prefill_rows
+        samp = sampling.SamplingParams(
+            temperature=jnp.zeros((nb,), jnp.float32),
+            top_k=jnp.zeros((nb,), jnp.int32),
+            top_p=jnp.ones((nb,), jnp.float32),
+        )
+        for t in self._chunk_buckets:
+            first, self.kv_cache = self._jit_chunk_prefill(
+                self.params,
+                self.kv_cache,
+                jnp.zeros((nb, t), jnp.int32),
+                jnp.ones((nb,), jnp.int32),
+                jnp.zeros((nb,), jnp.int32),
+                jnp.full((nb,), self._scratch_slot, jnp.int32),
+                samp,
+                self._next_key(),
+            )
+            jax.block_until_ready(first)
+        log.info(
+            "prefix-cache warmup: copy ops + chunk-prefill%s compiled "
+            "in %.1fs", self._chunk_buckets, time.monotonic() - t0,
         )
 
     # -- public API -------------------------------------------------------
@@ -419,7 +532,10 @@ class InferenceEngine:
             b *= 2
         return min(b, self.ecfg.max_seq)
 
-    def _dispatch_prefill_batch(self, runs: List[RunningSlot], t: int):
+    def _dispatch_prefill_batch(
+        self, runs: List[RunningSlot], t: int,
+        hists: Optional[List[int]] = None,
+    ):
         """Non-blocking: dispatch one bucket of admitted prompts as ONE XLA
         call; returns the on-device first-token array WITHOUT fetching it.
 
@@ -428,20 +544,29 @@ class InferenceEngine:
         host↔device RTT — serial chunk round trips were the r3 TTFT
         bottleneck (VERDICT Weak #2).  Rows are padded to a power of two to
         bound compile count; pad rows scatter into the scratch slot.
+
+        With ``hists`` (prefix-cache path) row i's first ``hists[i]`` tokens
+        are already in the cache (copied from the block pool before this
+        dispatch, same executor → device order) and only the tail is
+        computed, via the chunk-prefill program; ``t`` then buckets the
+        TAIL length.
         """
         n = len(runs)
         nb = max(self.ecfg.prefill_rows, n)
         tokens = np.zeros((nb, t), np.int32)
         lengths = np.ones((nb,), np.int32)
+        starts = np.zeros((nb,), np.int32)
         slots = np.full((nb,), self._scratch_slot, np.int32)
         temp = np.zeros((nb,), np.float32)
         top_k = np.zeros((nb,), np.int32)
         top_p = np.ones((nb,), np.float32)
         total = 0
         for i, run in enumerate(runs):
-            ids = run.request.prompt_ids
+            hist = hists[i] if hists is not None else 0
+            ids = run.request.prompt_ids[hist:]
             tokens[i, : len(ids)] = ids
             lengths[i] = len(ids)
+            starts[i] = hist
             slots[i] = run.slot
             temp[i] = run.request.temperature
             top_k[i] = run.request.top_k
@@ -452,15 +577,27 @@ class InferenceEngine:
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
         )
-        first, self.kv_cache = self._jit_prefill(
-            self.params,
-            self.kv_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
-            jnp.asarray(slots),
-            samp,
-            self._next_key(),
-        )
+        if hists is not None:
+            first, self.kv_cache = self._jit_chunk_prefill(
+                self.params,
+                self.kv_cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(starts),
+                jnp.asarray(slots),
+                samp,
+                self._next_key(),
+            )
+        else:
+            first, self.kv_cache = self._jit_prefill(
+                self.params,
+                self.kv_cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(slots),
+                samp,
+                self._next_key(),
+            )
         global_metrics.inc("engine_prefill_tokens_total", total)
         return first
 
@@ -589,6 +726,44 @@ class InferenceEngine:
             self._positions[slot] = out.cache_len - 1
         self._emit(out, tok, evicted)
 
+    def _prefix_copy_in(self, run: RunningSlot, pool_ids: List[int]) -> None:
+        """Copy matched pool blocks into the run's slot (executor thread)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
+
+        pids, bnos = pad_ids(
+            pool_ids, list(range(len(pool_ids))),
+            self._prefix_max_blocks, scratch=None,
+        )
+        self.kv_cache = self._copy_in(
+            self.kv_cache, self._pool, run.slot, pids, bnos
+        )
+
+    def _prefix_insert(self, run: RunningSlot) -> None:
+        """Save this run's now-prefilled full prompt blocks into the pool
+        (executor thread); blocks already pooled are skipped."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
+
+        missing = self._prefix.missing(run.request.prompt_ids)
+        if not missing:
+            return
+        keys = [k for _, k in missing]
+        blk_nos = [i for i, _ in missing]
+        pool_ids = self._prefix.allocate(keys)
+        if not pool_ids:
+            return
+        # allocate() may return a PREFIX of the request when the pool is
+        # smaller than the prompt; insert exactly the blocks that got ids.
+        blk_nos = blk_nos[: len(pool_ids)]
+        pids, bnos = pad_ids(
+            pool_ids, blk_nos, self._prefix_max_blocks, scratch=0
+        )
+        self._pool = self._copy_out(
+            self._pool, self.kv_cache, run.slot, pids, bnos
+        )
+        global_metrics.inc(
+            "engine_prefix_saved_blocks_total", len(pool_ids)
+        )
+
     async def _admit_pending(self, loop) -> None:
         """Batched prefill: one XLA call per prompt-length bucket chunk.
 
@@ -596,26 +771,64 @@ class InferenceEngine:
         fetch in dispatch order — so the device computes chunk n+1 while
         chunk n's first-token block rides the RTT back to the host, and the
         earliest arrivals' first tokens emit as soon as their own chunk
-        lands rather than after the whole admission wave."""
+        lands rather than after the whole admission wave.
+
+        With the prefix cache on, each admitted prompt is first matched
+        against the block pool; matched runs get their history KV copied
+        into their slot (dispatched before their prefill, same executor →
+        same device order) and are grouped by TAIL-length bucket instead.
+        After a run's prefill lands, its uncached full blocks are saved
+        back to the pool — off the TTFT-critical path.
+        """
         admitted = self.scheduler.admit()
         if not admitted:
             return
-        groups: Dict[int, List[RunningSlot]] = {}
+        hist_of: Dict[int, int] = {}
+        pool_ids_of: Dict[int, List[int]] = {}
         for run in admitted:
-            t = self._bucket(len(run.request.prompt_ids))
-            groups.setdefault(t, []).append(run)
-        chunked: List[Tuple[int, List[RunningSlot]]] = []
+            hist = 0
+            if self._prefix is not None:
+                hist, ids = self._prefix.match(run.request.prompt_ids)
+                if hist and (
+                    len(run.request.prompt_ids) - hist
+                    > self._chunk_buckets[-1]
+                ):
+                    # Tail longer than any compiled chunk bucket: take the
+                    # plain path — NEVER cold-compile on the serving path.
+                    hist, ids = 0, []
+                if hist:
+                    pool_ids_of[run.slot] = ids
+                    global_metrics.inc(
+                        "engine_prefix_hit_tokens_total", hist
+                    )
+            hist_of[run.slot] = hist
+        # Group by (tail bucket, cached?): cached runs use the chunk-prefill
+        # program, whose bucket is the tail length.
+        groups: Dict[Tuple[int, bool], List[RunningSlot]] = {}
+        for run in admitted:
+            hist = hist_of[run.slot]
+            t = self._bucket(len(run.request.prompt_ids) - hist)
+            groups.setdefault((t, hist > 0), []).append(run)
+        chunked: List[Tuple[int, bool, List[RunningSlot]]] = []
         pr = self.ecfg.prefill_rows
-        for t, runs in sorted(groups.items()):
+        for (t, cached), runs in sorted(groups.items()):
             for i in range(0, len(runs), pr):
-                chunked.append((t, runs[i : i + pr]))
+                chunked.append((t, cached, runs[i : i + pr]))
         dispatched = []
-        for t, runs in chunked:
+        for t, cached, runs in chunked:
             t0 = time.monotonic()
+            if cached:
+                for run in runs:
+                    await loop.run_in_executor(
+                        self._executor, self._prefix_copy_in,
+                        run, pool_ids_of[run.slot],
+                    )
+            hists = [hist_of[r.slot] for r in runs] if cached else None
             first_dev = await loop.run_in_executor(
-                self._executor, self._dispatch_prefill_batch, runs, t
+                self._executor, self._dispatch_prefill_batch, runs, t, hists
             )
             dispatched.append((runs, first_dev, t0))
+        inserts: List[RunningSlot] = []
         for runs, first_dev, t0 in dispatched:
             firsts = await loop.run_in_executor(
                 self._executor,
@@ -633,6 +846,16 @@ class InferenceEngine:
                     continue
                 self._admit_one(run)
                 self._account_token(run.slot, int(first))
+                if self._prefix is not None:
+                    inserts.append(run)
+        # Pool inserts run after EVERY first token of the wave is out —
+        # they only pay off future admissions, so they must not sit between
+        # a chunk's fetch and the next chunk's (the TTFT-critical path).
+        for run in inserts:
+            if self.scheduler.slots[run.slot] is run:
+                await loop.run_in_executor(
+                    self._executor, self._prefix_insert, run
+                )
 
     async def _process_burst(self, sampled: np.ndarray, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.

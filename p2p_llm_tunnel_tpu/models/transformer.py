@@ -404,6 +404,112 @@ def prefill_into_cache(
     return last, out
 
 
+def chunk_prefill_into_cache(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [Bp, T] right-padded TAIL tokens
+    lengths: jnp.ndarray,  # [Bp] real tail lengths
+    starts: jnp.ndarray,  # [Bp] history length per row (tail begins here)
+    kv_cache: KVCache,
+    slots: jnp.ndarray,  # [Bp] cache slot per prompt
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Prefill only the TAIL of each prompt against reused history KV.
+
+    The prefix-cache admission path (engine/prefix_cache.py): positions
+    ``[0, starts)`` of each row's cache slot already hold KV copied from the
+    block pool; this computes the remaining ``lengths`` tokens at global
+    positions ``starts + i`` (RoPE included), scatters their KV, and
+    attends each tail query to history + the causal part of the tail
+    (ops/attention.history_attention).  With ``starts == 0`` it computes
+    the same result as ``prefill_into_cache`` — pinned by
+    tests/test_prefix_cache.py against that oracle.
+
+    Like decode_step, the cache is carried through the layer scan so tail
+    writes stay in-place; attention reads the cache row back (one fused
+    (layer, view) dynamic_slice), which covers history and tail in a
+    single read.
+
+    Scope limits (the engine enforces both):
+    - No sequence-parallel path: under an sp>1 mesh the engine disables
+      prefix matching entirely, so cache-hit admissions never bypass
+      ring/Ulysses attention.  Plain einsum attention here partitions fine
+      under tp-only meshes (GSPMD splits the head axes).
+    - Attention reads the full cache row (S = max_seq) rather than a
+      kv_view bucket; at the current serving contexts the tail-chunk score
+      matrix is small, but a long-context config (max_seq >= 4096) should
+      grow a static view argument mirroring decode_step's before relying
+      on this path — noted in PERF.md.
+
+    Returns last-real-tail-token logits [Bp, V] and the updated cache.
+    """
+    b, t = tokens.shape
+    s = kv_cache["k"].shape[2]
+    x = _embed(cfg, params, tokens)
+    pos = starts[:, None] + jnp.arange(t)[None, :]  # [Bp,T] global positions
+    layer_idx = jnp.arange(cfg.n_layers)
+    quant = kv_cache_is_quantized(kv_cache)
+    rows = slots[:, None]  # [Bp,1] broadcasts against pos [Bp,T]
+
+    from p2p_llm_tunnel_tpu.ops.attention import history_attention
+
+    def step(carry, xs):
+        x, cache = carry
+        blk, idx = xs
+        h = _norm(cfg, x, blk["attn_norm"])
+        q, k, v = _qkv(cfg, blk, h, pos)  # rope at global positions
+        cache = dict(cache)
+        if quant:
+            kq, k_s = _quant_kv(k)
+            vq, v_s = _quant_kv(v)
+            cache["k"] = cache["k"].at[idx, rows, pos].set(kq)
+            cache["v"] = cache["v"].at[idx, rows, pos].set(vq)
+            cache["k_scale"] = cache["k_scale"].at[idx, rows, pos].set(k_s)
+            cache["v_scale"] = cache["v_scale"].at[idx, rows, pos].set(v_s)
+        else:
+            cache["k"] = cache["k"].at[idx, rows, pos].set(k)
+            cache["v"] = cache["v"].at[idx, rows, pos].set(v)
+        # One fused (layer) slice, then row gather: [Bp, S, K, D].
+        zero = jnp.zeros((), idx.dtype)
+        start5 = (idx, zero, zero, zero, zero)
+        lshape = (1,) + cache["k"].shape[1:]
+        k_all = jax.lax.dynamic_slice(cache["k"], start5, lshape)[0][slots]
+        v_all = jax.lax.dynamic_slice(cache["v"], start5, lshape)[0][slots]
+        if quant:
+            sshape = (1,) + cache["k_scale"].shape[1:]
+            k_s_all = jax.lax.dynamic_slice(
+                cache["k_scale"], start5[:4], sshape)[0][slots]
+            v_s_all = jax.lax.dynamic_slice(
+                cache["v_scale"], start5[:4], sshape)[0][slots]
+            k_all = (k_all.astype(jnp.float32) * k_s_all[..., None]).astype(x.dtype)
+            v_all = (v_all.astype(jnp.float32) * v_s_all[..., None]).astype(x.dtype)
+        attn = history_attention(
+            q, k_all, v_all, starts,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            window=_layer_window(cfg, idx, s),
+        )
+        attn = mm(attn.reshape(b, t, -1), blk["wo"], cfg.act_quant)
+        if cfg.post_norms:
+            attn = _norm(cfg, attn, blk["post_attn_norm"])
+        x = x + attn
+        h = _norm(cfg, x, blk["mlp_norm"])
+        mlp = _mlp(cfg, blk, h)
+        if cfg.post_norms:
+            mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+        x = x + mlp
+        return (x, cache), None
+
+    (x, new_cache), _ = jax.lax.scan(
+        step, (x, dict(kv_cache)), (params["blocks"], layer_idx)
+    )
+    x = _norm(cfg, x, params["final_norm"])
+    logits = _logits(cfg, params, x)  # [Bp,T,V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    return last, new_cache
+
+
 # ---------------------------------------------------------------------------
 # decode
 # ---------------------------------------------------------------------------

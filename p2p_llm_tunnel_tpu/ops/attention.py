@@ -75,6 +75,57 @@ def causal_attention(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
+def history_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Chunk attention for prefill-with-history (prefix caching).
+
+    Row ``r``'s query ``t`` sits at global position ``g = starts[r] + t``;
+    cache index ``j`` is attendable iff ``j <= g`` (and within the sliding
+    window when set).  With ``starts == 0`` this degenerates to causal
+    prefill over the cache; with ``T == 1`` it equals ``cached_attention``.
+    The cache row must already hold this chunk's own KV at positions
+    ``[starts, starts+T)`` (callers scatter before attending) plus the
+    reused history at ``[0, starts)``.
+
+    Pad queries (t >= the row's real tail length) produce junk outputs the
+    caller discards; their global positions exceed every real query's, so
+    the junk KV they wrote is never attended by real queries — the same
+    overwrite-before-read argument as ``prefill_into_cache``.
+
+    q [B,T,H,D]; k/v_cache [B,S,K,D]; starts [B] int32.
+    """
+    b, t, h, d = q.shape
+    kh = k_cache.shape[2]
+    g_heads = h // kh
+    if scale is None:
+        scale = d**-0.5
+
+    q5 = q.reshape(b, t, kh, g_heads, d)
+    scores = _gqa_scores(q5, k_cache, scale)  # [B,K,G,T,S]
+    scores = _softcap(scores, softcap)
+
+    s = k_cache.shape[1]
+    g = starts[:, None] + jnp.arange(t)[None, :]  # [B,T] global query pos
+    j = jnp.arange(s)[None, None, :]  # [1,1,S]
+    mask = j <= g[:, :, None]  # [B,T,S]
+    if window is not None:
+        mask &= (g[:, :, None] - j) < window
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = _gqa_out(probs, v_cache)
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
 def cached_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,

@@ -25,6 +25,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: still yields the key comparisons.
 GRID = [
     ("base-32x16", {}),
+    ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
     ("rows16", {"BENCH_PREFILL_ROWS": "16"}),
     ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
