@@ -138,6 +138,23 @@ def build_parser() -> argparse.ArgumentParser:
                        default=int(_env("TUNNEL_REPLICAS", "1")),
                        help="data-parallel engine replicas behind a router, "
                             "one per device round-robin")
+    serve.add_argument("--coordinator",
+                       default=_env("TUNNEL_COORDINATOR")
+                       or _env("MEGASCALE_COORDINATOR_ADDRESS"),
+                       help="multi-host: jax.distributed coordinator "
+                            "host:port; run the same serve command on "
+                            "every host (env TUNNEL_COORDINATOR)")
+    serve.add_argument("--num-processes", type=int,
+                       default=int(_env("TUNNEL_NUM_PROCESSES", "0")),
+                       help="multi-host: total process count")
+    serve.add_argument("--process-id", type=int,
+                       default=int(_env("TUNNEL_PROCESS_ID", "-1")),
+                       help="multi-host: this process's rank")
+    serve.add_argument("--dp-dcn", type=int,
+                       default=int(_env("TUNNEL_DP_DCN", "1")),
+                       help="data-parallel degree ACROSS hosts (DCN tier); "
+                            "tp/sp/ep stay slice-local on ICI "
+                            "(parallel/distributed.py)")
 
     proxy = sub.add_parser("proxy", help="consumer peer: local HTTP port")
     common(proxy)
@@ -245,7 +262,31 @@ async def _engine_backend(args):
 
     import jax
 
-    devices = jax.devices()
+    mesh = None
+    if args.coordinator:
+        # Multi-host: join the runtime FIRST (jax.devices() becomes global),
+        # then build the DCN-aware mesh — dp across hosts, tp/sp/ep on ICI.
+        # A partial flag set must error loudly, not silently start an
+        # independent single-host server on every pod host.
+        if args.num_processes <= 0 or args.process_id < 0:
+            raise SystemExit(
+                "--coordinator requires --num-processes > 0 and "
+                "--process-id >= 0 (or TUNNEL_NUM_PROCESSES / "
+                "TUNNEL_PROCESS_ID)"
+            )
+        from p2p_llm_tunnel_tpu.parallel.distributed import (
+            init_distributed,
+            make_hybrid_mesh,
+        )
+
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
+        mesh = make_hybrid_mesh(
+            tp=args.tp, dp_dcn=args.dp_dcn, sp=args.sp, ep=args.ep
+        )
+    # Replica placement must use THIS host's devices: after a multi-host
+    # join, jax.devices() is global and mostly non-addressable here.
+    devices = jax.local_devices()
 
     def make_engine(seed: int) -> InferenceEngine:
         # Replica i lives on device i (round-robin): its params/KV arrays
@@ -253,6 +294,7 @@ async def _engine_backend(args):
         with jax.default_device(devices[seed % len(devices)]):
             return InferenceEngine(
                 tokenizer=tokenizer,
+                mesh=mesh,
                 engine_cfg=EngineConfig(
                     model=args.model,
                     num_slots=args.slots,

@@ -213,10 +213,29 @@ def convert_hf_gemma2(
     }
 
 
+def convert_hf_qwen2(
+    state: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.bfloat16
+) -> Params:
+    """HF Qwen2 layout → stacked pytree: llama's mapping plus the QKV
+    biases (``self_attn.{q,k,v}_proj.bias``), which llama lacks — dropping
+    them silently would corrupt real-weight generations."""
+    params = convert_hf_llama(state, cfg, dtype)
+    l = cfg.n_layers
+
+    def b(name: str, i: int) -> np.ndarray:
+        return np.asarray(state[f"model.layers.{i}.self_attn.{name}.bias"])
+
+    params["blocks"]["bq"] = _stack([b("q_proj", i) for i in range(l)], dtype)
+    params["blocks"]["bk"] = _stack([b("k_proj", i) for i in range(l)], dtype)
+    params["blocks"]["bv"] = _stack([b("v_proj", i) for i in range(l)], dtype)
+    return params
+
+
 CONVERTERS = {
     "llama": convert_hf_llama,
     "gemma2": convert_hf_gemma2,
     "mixtral": convert_hf_mixtral,
+    "qwen2": convert_hf_qwen2,
 }
 
 

@@ -38,6 +38,9 @@ class ModelConfig:
     # layer windowed); ignored when sliding_window is None.
     window_pattern: str = "alternating"
     embed_scale: bool = False  # multiply embeddings by sqrt(dim)
+    # qwen2-style additive bias on the Q/K/V projections only (o_proj and
+    # MLP stay bias-free); adds bq/bk/bv leaves to the block pytree.
+    attn_bias: bool = False
     # attention score scale; None → 1/sqrt(head_dim)
     query_scale: Optional[float] = None
     # Use the Pallas flash kernel for prefill attention when the backend is
@@ -169,7 +172,7 @@ def mistral_7b() -> ModelConfig:
 
 
 def qwen2_7b() -> ModelConfig:
-    """Qwen2-7B: llama-style blocks, large vocab, tied=false, theta=1e6."""
+    """Qwen2-7B: llama-style blocks + QKV bias, large vocab, theta=1e6."""
     return ModelConfig(
         name="qwen2-7b",
         vocab_size=152064,
@@ -181,6 +184,22 @@ def qwen2_7b() -> ModelConfig:
         ffn_dim=18944,
         rope_theta=1000000.0,
         norm_eps=1e-6,
+        attn_bias=True,
+    )
+
+
+def tiny_qwen() -> ModelConfig:
+    """Tiny config exercising the qwen2 code path (QKV bias) on CPU."""
+    return ModelConfig(
+        name="tiny-qwen",
+        vocab_size=256 + 3,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        ffn_dim=128,
+        attn_bias=True,
     )
 
 
@@ -237,6 +256,7 @@ def mixtral_8x7b() -> ModelConfig:
 
 PRESETS = {
     "tiny": tiny,
+    "tiny-qwen": tiny_qwen,
     "tiny-moe": tiny_moe,
     "mixtral-8x7b": mixtral_8x7b,
     "tiny-gemma": tiny_gemma,

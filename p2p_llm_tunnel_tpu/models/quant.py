@@ -163,6 +163,17 @@ def _build_params_quantized(cfg, key: jax.Array) -> Params:
     if cfg.post_norms:
         blocks["post_attn_norm"] = jnp.zeros((l, dm), dtype)
         blocks["post_mlp_norm"] = jnp.zeros((l, dm), dtype)
+    if getattr(cfg, "attn_bias", False):
+        # Biases stay bf16 — 1-D, bandwidth-trivial, not worth quantizing.
+        bkey = jax.random.fold_in(key, 77)
+        blocks["bq"] = (jax.random.normal(bkey, (l, h * hd), jnp.float32)
+                        * dm**-0.5).astype(dtype)
+        blocks["bk"] = (jax.random.normal(jax.random.fold_in(bkey, 1),
+                                          (l, kh * hd), jnp.float32)
+                        * dm**-0.5).astype(dtype)
+        blocks["bv"] = (jax.random.normal(jax.random.fold_in(bkey, 2),
+                                          (l, kh * hd), jnp.float32)
+                        * dm**-0.5).astype(dtype)
     params: Params = {
         "embed": qdense(keys[7], (v, dm), dm, (v,)),  # per-row: gather + tied head
         "blocks": blocks,

@@ -72,6 +72,12 @@ def init_params(
     if cfg.post_norms:
         blocks["post_attn_norm"] = jnp.zeros((l, dm), dtype)
         blocks["post_mlp_norm"] = jnp.zeros((l, dm), dtype)
+    if cfg.attn_bias:
+        # qwen2: bias on Q/K/V projections only.  Random init (not zeros)
+        # so tests exercise a bias that actually changes the output.
+        blocks["bq"] = dense(jax.random.fold_in(key, 50), (l, h * hd), dm)
+        blocks["bk"] = dense(jax.random.fold_in(key, 51), (l, kh * hd), dm)
+        blocks["bv"] = dense(jax.random.fold_in(key, 52), (l, kh * hd), dm)
 
     params: Params = {
         "embed": dense(keys[7], (v, dm), dm),
@@ -144,9 +150,16 @@ def _mlp(cfg: ModelConfig, blk, h):
 def _qkv(cfg: ModelConfig, blk, h, positions):
     b, t, _ = h.shape
     aq = cfg.act_quant
-    q = mm(h, blk["wq"], aq).reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = mm(h, blk["wk"], aq).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
-    v = mm(h, blk["wv"], aq).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    q = mm(h, blk["wq"], aq)
+    k = mm(h, blk["wk"], aq)
+    v = mm(h, blk["wv"], aq)
+    if cfg.attn_bias:  # qwen2: additive bias on the Q/K/V projections
+        q = q + blk["bq"].astype(q.dtype)
+        k = k + blk["bk"].astype(k.dtype)
+        v = v + blk["bv"].astype(v.dtype)
+    q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v

@@ -86,6 +86,12 @@ def param_pspecs(
     if cfg.post_norms:
         blocks["post_attn_norm"] = P(None, None)
         blocks["post_mlp_norm"] = P(None, None)
+    if cfg.attn_bias:
+        # [L, H*hd]/[L, K*hd]: shard the output-feature axis with the
+        # column-parallel wq/wk/wv they add onto.
+        blocks["bq"] = P(None, "tp")
+        blocks["bk"] = P(None, "tp")
+        blocks["bv"] = P(None, "tp")
     specs: Dict[str, Any] = {
         "embed": maybe_q(
             "embed", P("tp", None),  # [V, Dm] vocab-sharded

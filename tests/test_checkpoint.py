@@ -111,6 +111,43 @@ def test_convert_hf_gemma2_shapes(cpu_devices):
     assert jax.tree.map(lambda x: x.shape, ref) == jax.tree.map(lambda x: x.shape, params)
 
 
+def test_convert_hf_qwen2_biases(cpu_devices):
+    """Qwen2 = llama mapping + QKV biases; the biases must land in the
+    tree AND change the forward pass (a silently-dropped bias would be
+    invisible to a shapes-only check)."""
+    cfg = get_config("tiny-qwen")
+    state = _fake_hf_llama_state(cfg, seed=3)
+    rng = np.random.default_rng(9)
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}.self_attn."
+        state[p + "q_proj.bias"] = rng.standard_normal(
+            cfg.n_heads * cfg.head_dim).astype(np.float32) * 0.5
+        state[p + "k_proj.bias"] = rng.standard_normal(
+            cfg.n_kv_heads * cfg.head_dim).astype(np.float32) * 0.5
+        state[p + "v_proj.bias"] = rng.standard_normal(
+            cfg.n_kv_heads * cfg.head_dim).astype(np.float32) * 0.5
+    params = convert_hf("qwen2", state, cfg, jnp.float32)
+
+    ref = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    assert jax.tree.map(lambda x: x.shape, params) == jax.tree.map(
+        lambda x: x.shape, ref
+    )
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"]["bq"][0]),
+        state["model.layers.0.self_attn.q_proj.bias"],
+    )
+
+    tokens = jnp.array([[1, 2, 3, 4]])
+    valid = jnp.ones_like(tokens, bool)
+    logits, _, _ = prefill(cfg, params, tokens, valid)
+    zeroed = dict(params)
+    zeroed["blocks"] = dict(params["blocks"])
+    for name in ("bq", "bk", "bv"):
+        zeroed["blocks"][name] = jnp.zeros_like(params["blocks"][name])
+    logits0, _, _ = prefill(cfg, zeroed, tokens, valid)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits0))
+
+
 def test_convert_unknown_family():
     with pytest.raises(KeyError):
         convert_hf("mystery", {}, get_config("tiny"))

@@ -121,8 +121,9 @@ def test_cli_engine_knobs_reach_engine_config(monkeypatch):
     captured = {}
 
     class FakeEngine:
-        def __init__(self, tokenizer=None, engine_cfg=None):
+        def __init__(self, tokenizer=None, engine_cfg=None, mesh=None):
             captured["cfg"] = engine_cfg
+            captured["mesh"] = mesh
             self.mcfg = type("M", (), {"name": "tiny"})()
 
         async def start(self):

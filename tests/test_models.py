@@ -16,7 +16,8 @@ from p2p_llm_tunnel_tpu.models.transformer import (
 )
 
 
-@pytest.fixture(scope="module", params=["tiny", "tiny-gemma", "tiny-moe"])
+@pytest.fixture(scope="module",
+                params=["tiny", "tiny-gemma", "tiny-moe", "tiny-qwen"])
 def model(request):
     cfg = get_config(request.param)
     params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
