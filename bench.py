@@ -162,6 +162,9 @@ async def _run_attempt(model: str) -> dict:
     # prompts; the result JSON records the knob + hit counts so the number
     # is interpretable, and the sweep's pfx-off row isolates its effect.
     prefix_cache = os.environ.get("BENCH_PREFIX_CACHE", "1") == "1"
+    # Chunked prefill: off by default (bench prompts are short); the
+    # long-context sweep configs turn it on.
+    prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0"))
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
@@ -188,6 +191,7 @@ async def _run_attempt(model: str) -> dict:
             prefill_rows=prefill_rows, quant=quant,
             prefill_act_quant=pf8, flash_decode=flash_decode,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
+            prefill_chunk=prefill_chunk,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -208,6 +212,12 @@ async def _run_attempt(model: str) -> dict:
     port = await asyncio.wait_for(ready, 30.0)
 
     prompt = "Benchmark this tunnel with a steady stream of tokens."
+    # Long-prompt runs (chunked-prefill / long-context configs): repeat the
+    # base text to ~BENCH_PROMPT_TOKENS byte-tokens.
+    want_tokens = int(os.environ.get("BENCH_PROMPT_TOKENS", "0"))
+    if want_tokens > 0:
+        reps = max(1, want_tokens // (len(prompt) + 1))
+        prompt = " ".join([prompt] * reps)
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     profiling = False

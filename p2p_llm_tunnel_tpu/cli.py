@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the Pallas decode-attention kernel on "
                             "tileable shapes (--no-flash-decode overrides "
                             "the env)")
+    serve.add_argument("--prefill-chunk", type=int,
+                       default=int(_env("TUNNEL_PREFILL_CHUNK", "0")),
+                       help="chunked prefill: prompts longer than this many "
+                            "tokens advance one segment of this size per "
+                            "engine step, interleaved with decode (0 = "
+                            "whole-prompt prefill)")
     serve.add_argument("--prefix-cache",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFIX_CACHE", "1") == "1",
@@ -310,6 +316,7 @@ async def _engine_backend(args):
                     prefill_act_quant=args.prefill_act_quant,
                     flash_decode=args.flash_decode,
                     prefix_cache=args.prefix_cache,
+                    prefill_chunk=args.prefill_chunk,
                     seed=seed,
                 )
             )

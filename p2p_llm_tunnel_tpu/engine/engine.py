@@ -111,6 +111,14 @@ class EngineConfig:
     # never on the serving path), and prefix reuse pays most when tails are
     # short anyway.
     prefix_tail_buckets: int = 2
+    # Chunked prefill (vLLM-style prefill/decode interleaving): prompts
+    # whose (post-prefix-match) tail exceeds this many tokens advance one
+    # fixed-size segment per engine-loop iteration instead of prefilling in
+    # a single call — a 2k-token prompt no longer stalls every running
+    # decode stream for its whole prefill.  One extra compiled program
+    # (the segment width); the LAST segment's logits sample the first
+    # token.  0 disables (prompts prefill whole, the pre-r4 behavior).
+    prefill_chunk: int = 0
 
 
 @dataclass
@@ -227,6 +235,14 @@ class InferenceEngine:
             self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
         self.scheduler = Scheduler(b, s)
 
+        if self.ecfg.prefill_chunk > 0 and self.ecfg.sp > 1:
+            # Same scope limit as the prefix cache below: the chunk-prefill
+            # program has no sequence-parallel attention path, and silently
+            # bypassing ring/Ulysses on long prompts would defeat sp's
+            # memory scaling exactly where it matters.
+            log.warning("chunked prefill disabled: not supported with sp>1")
+            self.ecfg = dc_replace(self.ecfg, prefill_chunk=0)
+
         # Prefix cache: host index + device block pool + jitted copy ops.
         self._prefix = None
         if self.ecfg.prefix_cache and self.ecfg.sp > 1:
@@ -282,6 +298,10 @@ class InferenceEngine:
         self._top_p = np.ones((rows,), np.float32)
 
         self._requests: Dict[int, _ActiveRequest] = {}
+        # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
+        # each loop iteration advances up to prefill_rows of these by ONE
+        # prefill_chunk-token segment (see _dispatch_segments).
+        self._segmented: Dict[int, Tuple[RunningSlot, int]] = {}
         self._next_request_id = 1
         self._key = jax.random.fold_in(key, 1)
         self._wake = asyncio.Event()
@@ -417,9 +437,35 @@ class InferenceEngine:
         )
         if self._prefix is not None:
             await loop.run_in_executor(self._executor, self._warm_prefix)
+        if self.ecfg.prefill_chunk > 0:
+            await loop.run_in_executor(
+                self._executor, self._warm_chunk_program,
+                self.ecfg.prefill_chunk,
+            )
+
+    def _warm_chunk_program(self, t: int) -> None:
+        """Compile the chunk-prefill program at tail width ``t`` against
+        scratch rows (executor thread)."""
+        nb = self.ecfg.prefill_rows
+        samp = sampling.SamplingParams(
+            temperature=jnp.zeros((nb,), jnp.float32),
+            top_k=jnp.zeros((nb,), jnp.int32),
+            top_p=jnp.ones((nb,), jnp.float32),
+        )
+        first, self.kv_cache = self._jit_chunk_prefill(
+            self.params,
+            self.kv_cache,
+            jnp.zeros((nb, t), jnp.int32),
+            jnp.ones((nb,), jnp.int32),
+            jnp.zeros((nb,), jnp.int32),
+            jnp.full((nb,), self._scratch_slot, jnp.int32),
+            samp,
+            self._next_key(),
+        )
+        jax.block_until_ready(first)
 
     def _warm_prefix(self) -> None:
-        """Compile the prefix-cache programs (both copy ops + the smallest
+        """Compile the prefix-cache programs (both copy ops + every
         tail-bucket chunk prefill) against scratch rows so none of them
         cold-compiles on the serving path (executor thread)."""
         from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
@@ -433,24 +479,8 @@ class InferenceEngine:
         self._pool = self._copy_out(
             self._pool, self.kv_cache, self._scratch_slot, pids, bnos
         )
-        nb = self.ecfg.prefill_rows
-        samp = sampling.SamplingParams(
-            temperature=jnp.zeros((nb,), jnp.float32),
-            top_k=jnp.zeros((nb,), jnp.int32),
-            top_p=jnp.ones((nb,), jnp.float32),
-        )
         for t in self._chunk_buckets:
-            first, self.kv_cache = self._jit_chunk_prefill(
-                self.params,
-                self.kv_cache,
-                jnp.zeros((nb, t), jnp.int32),
-                jnp.ones((nb,), jnp.int32),
-                jnp.zeros((nb,), jnp.int32),
-                jnp.full((nb,), self._scratch_slot, jnp.int32),
-                samp,
-                self._next_key(),
-            )
-            jax.block_until_ready(first)
+            self._warm_chunk_program(t)
         log.info(
             "prefix-cache warmup: copy ops + chunk-prefill%s compiled "
             "in %.1fs", self._chunk_buckets, time.monotonic() - t0,
@@ -551,22 +581,25 @@ class InferenceEngine:
         computed, via the chunk-prefill program; ``t`` then buckets the
         TAIL length.
         """
+        if hists is not None:
+            rows = [
+                (run, hist, run.request.prompt_ids[hist:], True)
+                for run, hist in zip(runs, hists)
+            ]
+            return self._dispatch_chunk_rows(rows, t)
         n = len(runs)
         nb = max(self.ecfg.prefill_rows, n)
         tokens = np.zeros((nb, t), np.int32)
         lengths = np.ones((nb,), np.int32)
-        starts = np.zeros((nb,), np.int32)
         slots = np.full((nb,), self._scratch_slot, np.int32)
         temp = np.zeros((nb,), np.float32)
         top_k = np.zeros((nb,), np.int32)
         top_p = np.ones((nb,), np.float32)
         total = 0
         for i, run in enumerate(runs):
-            hist = hists[i] if hists is not None else 0
-            ids = run.request.prompt_ids[hist:]
+            ids = run.request.prompt_ids
             tokens[i, : len(ids)] = ids
             lengths[i] = len(ids)
-            starts[i] = hist
             slots[i] = run.slot
             temp[i] = run.request.temperature
             top_k[i] = run.request.top_k
@@ -577,27 +610,61 @@ class InferenceEngine:
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
         )
-        if hists is not None:
-            first, self.kv_cache = self._jit_chunk_prefill(
-                self.params,
-                self.kv_cache,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
-                jnp.asarray(starts),
-                jnp.asarray(slots),
-                samp,
-                self._next_key(),
-            )
-        else:
-            first, self.kv_cache = self._jit_prefill(
-                self.params,
-                self.kv_cache,
-                jnp.asarray(tokens),
-                jnp.asarray(lengths),
-                jnp.asarray(slots),
-                samp,
-                self._next_key(),
-            )
+        first, self.kv_cache = self._jit_prefill(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(slots),
+            samp,
+            self._next_key(),
+        )
+        global_metrics.inc("engine_prefill_tokens_total", total)
+        return first
+
+    def _dispatch_chunk_rows(self, rows, t: int):
+        """Pack rows of ``(run, start, segment_ids, sample?)`` into ONE
+        chunk-prefill dispatch at tail width ``t`` (executor thread) — the
+        single home of the padding/scratch-slot/sampling-row packing shared
+        by the prefix-cache tail path and chunked-prefill segments.
+
+        Non-sampled rows (mid-prompt segments) get zeroed sampling params;
+        the caller discards their returned token.
+        """
+        nb = max(self.ecfg.prefill_rows, len(rows))
+        tokens = np.zeros((nb, t), np.int32)
+        lengths = np.ones((nb,), np.int32)
+        starts = np.zeros((nb,), np.int32)
+        slots = np.full((nb,), self._scratch_slot, np.int32)
+        temp = np.zeros((nb,), np.float32)
+        top_k = np.zeros((nb,), np.int32)
+        top_p = np.ones((nb,), np.float32)
+        total = 0
+        for i, (run, start, seg, sample) in enumerate(rows):
+            tokens[i, : len(seg)] = seg
+            lengths[i] = len(seg)
+            starts[i] = start
+            slots[i] = run.slot
+            if sample:
+                temp[i] = run.request.temperature
+                top_k[i] = run.request.top_k
+                top_p[i] = run.request.top_p
+            total += len(seg)
+        samp = sampling.SamplingParams(
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+        )
+        first, self.kv_cache = self._jit_chunk_prefill(
+            self.params,
+            self.kv_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(starts),
+            jnp.asarray(slots),
+            samp,
+            self._next_key(),
+        )
         global_metrics.inc("engine_prefill_tokens_total", total)
         return first
 
@@ -677,15 +744,27 @@ class InferenceEngine:
             top_k=jnp.array(self._top_k),
             top_p=jnp.array(self._top_p),
         )
+        # INACTIVE rows are parked at position >= max_seq every dispatch:
+        # decode_step writes KV at every row's carry position, and a stale
+        # carry pointing into a slot that a chunk-prefill segment has
+        # already written would silently corrupt that prompt's context
+        # (whole-prompt prefill rewrites the full prefix after any junk;
+        # segments do not).  OOB scatter positions are dropped by XLA, so
+        # parked rows write nothing; activation ov-patches the real
+        # position back in.
+        inactive = ~self._active_mask
+        ov_mask = self._ov_mask | inactive
+        park = self.ecfg.max_seq
+        ov_pos = np.where(inactive, park, self._positions)
         sampled, self._dev_tokens, self._dev_positions, self.kv_cache = (
             self._jit_decode(
                 self.params,
                 self.kv_cache,
                 self._dev_tokens,
                 self._dev_positions,
-                jnp.array(self._ov_mask),
+                jnp.array(ov_mask),
                 jnp.array(self._last_token),
-                jnp.array(self._positions),
+                jnp.array(ov_pos),
                 samp,
                 self._next_key(),
                 self._kv_view_bucket() if view is None else view,
@@ -693,9 +772,14 @@ class InferenceEngine:
             )
         )
         self._ov_mask[:] = False  # patch consumed by this dispatch
+        # Rows must ALSO have been active at dispatch time to be accounted:
+        # a chunk-prefilling slot holds its request-id long before its
+        # device carry is real, so the burst in flight when its final
+        # segment lands would otherwise be credited as its tokens.
         assign = [
-            run.request.request_id if run is not None else None
-            for run in self.scheduler.slots
+            run.request.request_id
+            if run is not None and self._active_mask[i] else None
+            for i, run in enumerate(self.scheduler.slots)
         ] + [None]  # scratch row
         return sampled, assign
 
@@ -789,24 +873,43 @@ class InferenceEngine:
             hist = 0
             if self._prefix is not None:
                 hist, ids = self._prefix.match(run.request.prompt_ids)
-                if hist and (
-                    len(run.request.prompt_ids) - hist
-                    > self._chunk_buckets[-1]
-                ):
-                    # Tail longer than any compiled chunk bucket: take the
-                    # plain path — NEVER cold-compile on the serving path.
-                    hist, ids = 0, []
                 if hist:
                     pool_ids_of[run.slot] = ids
-                    global_metrics.inc(
-                        "engine_prefix_hit_tokens_total", hist
-                    )
             hist_of[run.slot] = hist
+        # Long tails go to the chunked-prefill queue: they advance one
+        # segment per loop iteration (interleaved with decode bursts)
+        # instead of stalling this admission wave.  Their prefix copy-in
+        # dispatches NOW so it precedes every segment in executor order.
+        # (Routed BEFORE the tail-bucket cap below: segments use the
+        # prefill_chunk-wide program, so a long tail composes with any
+        # history length.)
+        if self.ecfg.prefill_chunk > 0:
+            for run in list(admitted):
+                hist = hist_of[run.slot]
+                if len(run.request.prompt_ids) - hist > self.ecfg.prefill_chunk:
+                    if hist:
+                        await loop.run_in_executor(
+                            self._executor, self._prefix_copy_in,
+                            run, pool_ids_of[run.slot],
+                        )
+                        global_metrics.inc(
+                            "engine_prefix_hit_tokens_total", hist
+                        )
+                    self._segmented[run.slot] = (run, hist)
+                    admitted.remove(run)
         # Group by (tail bucket, cached?): cached runs use the chunk-prefill
-        # program, whose bucket is the tail length.
+        # program, whose bucket is the tail length.  A matched prefix whose
+        # tail exceeds every compiled chunk bucket is dropped back to the
+        # plain path — NEVER cold-compile on the serving path.
         groups: Dict[Tuple[int, bool], List[RunningSlot]] = {}
         for run in admitted:
             hist = hist_of[run.slot]
+            if hist and (
+                len(run.request.prompt_ids) - hist > self._chunk_buckets[-1]
+            ):
+                hist = hist_of[run.slot] = 0
+            if hist:
+                global_metrics.inc("engine_prefix_hit_tokens_total", hist)
             t = self._bucket(len(run.request.prompt_ids) - hist)
             groups.setdefault((t, hist > 0), []).append(run)
         chunked: List[Tuple[int, bool, List[RunningSlot]]] = []
@@ -857,6 +960,64 @@ class InferenceEngine:
                     self._executor, self._prefix_insert, run
                 )
 
+    def _dispatch_segments(self):
+        """Advance up to ``prefill_rows`` chunked-prefill slots by ONE
+        segment each, as one chunk-prefill call (executor thread).
+
+        Returns (rows, first_dev) where rows is [(run, was_final)] in row
+        order, or None when nothing is pending.  Every segment pads to the
+        same ``prefill_chunk`` bucket — one compiled program; a final
+        (short) segment's pad positions write junk KV past the prompt end,
+        which decode overwrites before it ever becomes attendable (the
+        standard prefill pad argument).
+        """
+        if not self._segmented:
+            return None
+        chunk = self.ecfg.prefill_chunk
+        picked: List[Tuple[RunningSlot, int]] = []
+        for slot in list(self._segmented):
+            run, start = self._segmented[slot]
+            if self.scheduler.slots[slot] is not run:  # cancelled
+                del self._segmented[slot]
+                continue
+            picked.append((run, start))
+            if len(picked) == self.ecfg.prefill_rows:
+                break
+        if not picked:
+            return None
+        chunk_rows = []
+        rows: List[Tuple[RunningSlot, bool]] = []
+        for run, start in picked:
+            ids = run.request.prompt_ids
+            seg = ids[start : start + chunk]
+            final = start + len(seg) >= len(ids)
+            if final:
+                del self._segmented[run.slot]
+            else:
+                self._segmented[run.slot] = (run, start + len(seg))
+            chunk_rows.append((run, start, seg, final))
+            rows.append((run, final))
+        first = self._dispatch_chunk_rows(chunk_rows, chunk)
+        global_metrics.inc("engine_prefill_segments_total", len(rows))
+        return rows, first
+
+    async def _finish_segments(self, loop, seg) -> None:
+        """Fetch a segment dispatch's sampled block; activate final rows."""
+        rows, first_dev = seg
+        firsts = await loop.run_in_executor(
+            self._executor,
+            lambda: np.asarray(jax.device_get(first_dev)),
+        )
+        for (run, final), first in zip(rows, firsts[: len(rows)]):
+            if not final or self.scheduler.slots[run.slot] is not run:
+                continue
+            self._admit_one(run)
+            self._account_token(run.slot, int(first))
+            if self._prefix is not None:
+                await loop.run_in_executor(
+                    self._executor, self._prefix_insert, run
+                )
+
     async def _process_burst(self, sampled: np.ndarray, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.
 
@@ -899,6 +1060,15 @@ class InferenceEngine:
             global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
             global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
 
+            # One chunked-prefill segment per iteration, dispatched before
+            # the decode burst: long prompts make steady progress while
+            # every running stream keeps decoding — the interleave that
+            # bounds how long one big prompt can stall the batch.
+            seg = (
+                await loop.run_in_executor(self._executor, self._dispatch_segments)
+                if self._segmented else None
+            )
+
             # Pipeline: dispatch burst n (returns immediately; carry stays
             # on device), THEN fetch+process burst n-1 — the ~90 ms RTT of
             # the fetch overlaps with burst n computing.  Dispatch runs on
@@ -924,5 +1094,9 @@ class InferenceEngine:
                     "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                 )
                 await self._process_burst(sampled, assign)
+            if seg is not None:
+                # Fetched after the decode work above, so the segment's
+                # device→host RTT rides under real compute.
+                await self._finish_segments(loop, seg)
             in_flight = current
         log.info("engine loop stopped")

@@ -37,6 +37,16 @@ GRID = [
     ("kv-int8", {"BENCH_KV_QUANT": "int8"}),
     ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                      "BENCH_CLIENTS": "16", "BENCH_KV_QUANT": "int8"}),
+    # Long prompts (~1k tokens): whole-prompt prefill vs 256-token chunked
+    # segments interleaved with decode (TTFT fairness under mixed load).
+    ("longprompt", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
+                    "BENCH_CLIENTS": "16", "BENCH_PROMPT_TOKENS": "1024",
+                    "BENCH_MAX_TOKENS": "64"}),
+    ("longprompt-chunked", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
+                            "BENCH_CLIENTS": "16",
+                            "BENCH_PROMPT_TOKENS": "1024",
+                            "BENCH_MAX_TOKENS": "64",
+                            "BENCH_PREFILL_CHUNK": "256"}),
     ("w8a8", {"BENCH_QUANT": "w8a8"}),
     # Last: this config's fresh bf16-prefill compile hung for 430+s on the
     # tunneled chip once (04:52 wedge) — if it wedges the tunnel again it

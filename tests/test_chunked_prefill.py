@@ -1,0 +1,132 @@
+"""Chunked prefill (EngineConfig.prefill_chunk): long prompts advance one
+segment per engine-loop iteration, interleaved with decode.
+
+Contract: a pure scheduling change — tokens must be EXACTLY what
+whole-prompt prefill produces, with or without the prefix cache."""
+
+import asyncio
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+
+def _cfg(**kw):
+    base = dict(model="tiny", num_slots=4, max_seq=256, dtype="float32",
+                min_prefill_bucket=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _gen(eng, prompt, max_new=6):
+    out = []
+    async for ev in eng.generate(prompt, max_new_tokens=max_new, stop_ids=()):
+        out.append(ev.token_id)
+    return out
+
+
+def test_segmented_matches_whole_prefill():
+    prompt = list(range(1, 120))  # 119 tokens >> chunk of 32
+
+    async def run(chunk):
+        eng = InferenceEngine(engine_cfg=_cfg(prefill_chunk=chunk))
+        await eng.start()
+        out = await _gen(eng, prompt)
+        await eng.stop()
+        return out
+
+    global_metrics.reset()
+    whole = asyncio.run(run(0))
+    seg = asyncio.run(run(32))
+    assert seg == whole
+    # The long prompt really went through the segment machinery.
+    assert global_metrics.counter("engine_prefill_segments_total") >= 4
+
+
+def test_segmented_interleaves_with_decode():
+    """A short request submitted WITH a long one must finish while the
+    long one is still prefilling — and BOTH must produce exactly their
+    solo-run tokens: decode bursts running during segmentation must not
+    corrupt the segmenting slot's KV (inactive rows park their cache
+    writes out of range), nor be mis-credited to it at activation."""
+    long_prompt = list(range(1, 200))  # 199 tokens = 13 segments
+    short_prompt = [1, 2, 3]
+
+    async def solo(prompt, max_new):
+        eng = InferenceEngine(engine_cfg=_cfg(prefill_chunk=0))
+        await eng.start()
+        out = await _gen(eng, prompt, max_new)
+        await eng.stop()
+        return out
+
+    async def run():
+        eng = InferenceEngine(engine_cfg=_cfg(prefill_chunk=16,
+                                              decode_steps=2))
+        await eng.start()
+        order = []
+        toks = {}
+
+        async def gen(tag, prompt, max_new):
+            toks[tag] = await _gen(eng, prompt, max_new)
+            order.append(tag)
+
+        await asyncio.gather(
+            gen("long", long_prompt, 6),
+            gen("short", short_prompt, 8),
+        )
+        await eng.stop()
+        return order, toks
+
+    order, toks = asyncio.run(run())
+    assert order == ["short", "long"]
+    assert toks["long"] == asyncio.run(solo(long_prompt, 6))
+    assert toks["short"] == asyncio.run(solo(short_prompt, 8))
+
+
+def test_segmented_composes_with_prefix_cache():
+    base = list(range(1, 90))  # cached prefix source
+
+    async def run(prefix_cache, chunk):
+        eng = InferenceEngine(engine_cfg=_cfg(
+            prefill_chunk=chunk, prefix_cache=prefix_cache,
+            prefix_pool_blocks=32,
+        ))
+        await eng.start()
+        outs = [await _gen(eng, base + [91, 92, 93] + list(range(94, 160)))]
+        # Second request shares the long prefix -> history + segments.
+        outs.append(await _gen(eng, base + [99, 98] + list(range(94, 160))))
+        await eng.stop()
+        hits = eng._prefix.hits if eng._prefix else 0
+        return outs, hits
+
+    (outs_plain, _) = asyncio.run(run(False, 0))
+    (outs_seg, hits) = asyncio.run(run(True, 32))
+    assert outs_seg == outs_plain
+    assert hits >= 1  # the second request matched pooled blocks
+
+
+def test_segmented_cancellation_mid_prefill():
+    """Cancelling a consumer while its prompt is mid-segments must free the
+    slot and not wedge the loop."""
+
+    async def run():
+        eng = InferenceEngine(engine_cfg=_cfg(prefill_chunk=16))
+        await eng.start()
+
+        async def doomed():
+            async for ev in eng.generate(list(range(1, 200)),
+                                         max_new_tokens=8, stop_ids=()):
+                pass
+
+        task = asyncio.create_task(doomed())
+        await asyncio.sleep(0.05)  # a few segments in
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        # Engine still serves fresh requests afterwards.
+        out = await _gen(eng, [1, 2, 3], max_new=3)
+        await eng.stop()
+        return out
+
+    assert len(asyncio.run(run())) == 3
