@@ -75,22 +75,30 @@ def _budget_s() -> float:
     return float(os.environ.get("BENCH_BUDGET_S", "480"))
 
 
-def _probe_platform(timeout: float) -> str:
+def _probe_platform(timeout: float, attempts: int = 1) -> str:
     """Detect the accelerator platform in a SUBPROCESS: the axon PJRT plugin
     force-initialises the tunneled chip on first jax.devices() in every
-    process, which can hang — the parent must never import jax itself."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            timeout=timeout,
-        )
-        out = proc.stdout.decode().strip().splitlines()
-        if proc.returncode == 0 and out:
-            return out[-1]
-    except subprocess.TimeoutExpired:
-        _log(f"platform probe timed out after {timeout:.0f}s")
+    process, which can hang — the parent must never import jax itself.
+
+    The device tunnel wedges transiently (r4: twice); a probe that runs a
+    real matmul distinguishes alive from wedged, and retrying catches the
+    flaky-but-recovering case without burning the whole budget."""
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "x = jnp.ones((128, 128)); (x @ x).block_until_ready();"
+                 "print(jax.devices()[0].platform)"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                timeout=timeout,
+            )
+            out = proc.stdout.decode().strip().splitlines()
+            if proc.returncode == 0 and out:
+                return out[-1]
+        except subprocess.TimeoutExpired:
+            _log(f"platform probe {i + 1}/{attempts} timed out "
+                 f"after {timeout:.0f}s")
     return "cpu"
 
 
@@ -384,8 +392,9 @@ def main() -> None:
     # children re-force it through jax.config (the only override that wins).
     force_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     model = os.environ.get("BENCH_MODEL")
+    platform = None
     if not model:
-        platform = _probe_platform(timeout=min(150.0, budget / 3))
+        platform = _probe_platform(timeout=min(90.0, budget / 5), attempts=2)
         _log(f"platform probe: {platform}")
         # The driver target is defined on llama3-8b (int8 fits a 16 GB
         # chip); CPU-only environments get the tiny correctness run.
@@ -405,6 +414,11 @@ def main() -> None:
                    BENCH_SINGLE_DEADLINE=str(remaining - 10))
         if model.endswith("-safe"):
             env.update(SAFE_OVERRIDES)
+        if model == "tiny" and errors:
+            # Last-resort correctness datapoint: earlier attempts failing
+            # usually means the device tunnel is wedged — a tiny attempt on
+            # the same wedged device would hang identically, so force CPU.
+            force_cpu = True
         if force_cpu:
             env["BENCH_FORCE_CPU"] = "1"
         try:
@@ -424,6 +438,10 @@ def main() -> None:
                 result = json.loads(lines[-1])
                 if errors:
                     result["fallback_from"] = errors
+                if platform is not None:
+                    result["platform_probe"] = platform
+                if force_cpu:
+                    result["forced_cpu"] = True
                 print(json.dumps(result))
                 return
             except json.JSONDecodeError:

@@ -417,8 +417,10 @@ class InferenceEngine:
         (kv-view bucket × burst size).  Run BEFORE serving traffic so no
         compile ever lands inside a request; with the persistent compilation
         cache the cost is one-time per config, not per process.  The dummy
-        bursts write junk KV at position 0 of idle rows — harmless, prefill
-        overwrites a slot's whole prefix on admission."""
+        bursts write NOTHING: every row is idle, and _dispatch_decode parks
+        inactive rows' cache-write positions out of range (chunked-prefill
+        segments made idle-row junk writes unsafe — see the parking comment
+        there)."""
         loop = asyncio.get_running_loop()
         views = self._view_buckets()
         steps = {self.ecfg.decode_steps}

@@ -23,18 +23,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: (label, env overrides).  Ordered cheap-insight-first so a blown budget
 #: still yields the key comparisons.
+#: Ordered DECISION-VALUE-first so a blown budget still yields the key
+#: comparisons: default-config validation, the prefix-cache ablation, the
+#: throughput levers (slots/steps/flash), the long-context pair (VERDICT
+#: item 4's 2048-within-15% bar), then the chunked-prefill fairness pair,
+#: then nice-to-haves.
 GRID = [
     ("base-32x16", {}),
     ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
-    ("rows16", {"BENCH_PREFILL_ROWS": "16"}),
     ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
-    ("steps8", {"BENCH_DECODE_STEPS": "8"}),
-    ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
     ("ctx2048", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                  "BENCH_CLIENTS": "16"}),
-    ("kv-int8", {"BENCH_KV_QUANT": "int8"}),
     ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                      "BENCH_CLIENTS": "16", "BENCH_KV_QUANT": "int8"}),
     # Long prompts (~1k tokens): whole-prompt prefill vs 256-token chunked
@@ -47,6 +48,15 @@ GRID = [
                             "BENCH_PROMPT_TOKENS": "1024",
                             "BENCH_MAX_TOKENS": "64",
                             "BENCH_PREFILL_CHUNK": "256"}),
+    ("steps8", {"BENCH_DECODE_STEPS": "8"}),
+    ("steps32", {"BENCH_DECODE_STEPS": "32"}),
+    # Same config as base with a jax.profiler trace of the measured
+    # window — the on-chip evidence VERDICT r3 item 1 asked for
+    # (profile_out/ is gitignored; findings go to PERF.md).
+    ("base-profiled", {"BENCH_PROFILE_DIR": "profile_out"}),
+    ("gemma2-2b", {"BENCH_MODEL": "gemma2-2b"}),
+    ("rows16", {"BENCH_PREFILL_ROWS": "16"}),
+    ("kv-int8", {"BENCH_KV_QUANT": "int8"}),
     ("w8a8", {"BENCH_QUANT": "w8a8"}),
     # Last: this config's fresh bf16-prefill compile hung for 430+s on the
     # tunneled chip once (04:52 wedge) — if it wedges the tunnel again it
@@ -56,7 +66,7 @@ GRID = [
 
 
 def main() -> None:
-    budget = float(os.environ.get("SWEEP_BUDGET_S", "1800"))
+    budget = float(os.environ.get("SWEEP_BUDGET_S", "3600"))
     per_run = float(os.environ.get("SWEEP_RUN_S", "420"))
     t0 = time.monotonic()
     out_path = os.path.join(REPO, "PERF_SWEEP.jsonl")
@@ -67,8 +77,9 @@ def main() -> None:
             print(f"budget exhausted before {label}", file=sys.stderr)
             break
         deadline = min(per_run, remaining - 10)
-        env = dict(os.environ, BENCH_MODEL="llama3-8b",
-                   BENCH_SINGLE="llama3-8b",
+        model = overrides.get("BENCH_MODEL", "llama3-8b")
+        env = dict(os.environ, BENCH_MODEL=model,
+                   BENCH_SINGLE=model,
                    BENCH_SINGLE_DEADLINE=str(deadline), **overrides)
         print(f"=== {label} (deadline {deadline:.0f}s) ===", file=sys.stderr,
               flush=True)
