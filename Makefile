@@ -6,9 +6,10 @@
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
 
-native-san:        ## ASan+UBSan self-test of the C++ codec (fuzz included)
+native-san:        ## ASan+UBSan self-tests of the C++ codec + ARQ core
 	scripts/build-native.sh sanitize
 	native/build/tunnel_frames_test
+	native/build/tunnel_arq_test
 
 test: test-unit test-local
 

@@ -1,0 +1,157 @@
+// Native ARQ / congestion-control core for the reliable-UDP transport.
+//
+// The C++ twin of p2p_llm_tunnel_tpu/transport/arq.py PyArq — the Python
+// file is the reference semantics, this is the native runtime used when
+// built (the reference tunnel gets the equivalent machinery natively from
+// SCTP inside the webrtc crate).  Both implementations are driven through
+// the same randomized oracle in tests/test_arq.py, which fails on ANY
+// divergence in decisions, so keep formulas and constants in lockstep.
+//
+// The core owns bookkeeping only: sequence numbers, send times, retry
+// counts, Jacobson/Karels RTT estimation (Karn's rule), AIMD congestion
+// window with once-per-RTT multiplicative decrease, and cwnd-paced
+// oldest-first retransmit scheduling with per-retry exponential backoff.
+// Packet bytes stay with the caller.  All times are caller-supplied
+// monotonic seconds; the core never reads a clock.
+//
+// Build: scripts/build-native.sh  (g++ -O2 -shared -fPIC)
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+
+namespace {
+
+constexpr double kRtoMin = 0.15;
+constexpr double kRtoMax = 2.0;
+constexpr double kCwndInit = 32.0;
+constexpr double kCwndMin = 4.0;
+constexpr int kMaxBackoffExp = 4;
+
+inline bool seq_lt(uint32_t a, uint32_t b) {
+  // a < b in mod-2^32 sequence space.
+  return static_cast<uint32_t>(a - b) > 0x7FFFFFFFu;
+}
+
+struct Entry {
+  uint32_t seq;
+  double sent_at;
+  uint32_t tries;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct ArqState {
+  std::deque<Entry> inflight;  // send (== seq) order
+  double srtt = -1.0;          // <0 = no sample yet
+  double rttvar = 0.0;
+  double rto = kRtoMax / 2.0;
+  double cwnd = kCwndInit;
+  double ssthresh;
+  double cwnd_cap;
+  double last_backoff = 0.0;
+  uint64_t retransmits = 0;
+
+  explicit ArqState(double cap) : ssthresh(cap), cwnd_cap(cap) {}
+
+  void rtt_sample(double rtt) {
+    if (srtt < 0) {
+      srtt = rtt;
+      rttvar = rtt / 2.0;
+    } else {
+      rttvar = 0.75 * rttvar + 0.25 * ((srtt > rtt) ? srtt - rtt : rtt - srtt);
+      srtt = 0.875 * srtt + 0.125 * rtt;
+    }
+    double r = srtt + 4.0 * rttvar;
+    if (r < kRtoMin) r = kRtoMin;
+    if (r > kRtoMax) r = kRtoMax;
+    rto = r;
+  }
+
+  void on_timeout_loss(double now) {
+    // `srtt or rto` in the Python reference: falsy (unset OR exactly 0.0)
+    // falls back to rto — match it exactly for the oracle.
+    const double rtt = srtt <= 0 ? rto : srtt;
+    if (now - last_backoff < rtt) return;
+    last_backoff = now;
+    ssthresh = cwnd / 2.0;
+    if (ssthresh < kCwndMin) ssthresh = kCwndMin;
+    cwnd = ssthresh;
+  }
+};
+
+ArqState* arq_new(double cwnd_cap) { return new ArqState(cwnd_cap); }
+
+void arq_free(ArqState* s) { delete s; }
+
+void arq_set_cwnd_cap(ArqState* s, double cap) {
+  s->cwnd_cap = cap;
+  if (s->ssthresh > cap) s->ssthresh = cap;
+}
+
+void arq_on_send(ArqState* s, uint32_t seq, double now) {
+  s->inflight.push_back(Entry{seq, now, 0});
+}
+
+int32_t arq_on_ack(ArqState* s, uint32_t cum, double now, uint32_t* acked_out,
+                   uint32_t cap) {
+  uint32_t n = 0;
+  while (!s->inflight.empty() && seq_lt(s->inflight.front().seq, cum)) {
+    const Entry e = s->inflight.front();
+    s->inflight.pop_front();
+    if (n < cap) acked_out[n] = e.seq;
+    ++n;
+    if (e.tries == 0) s->rtt_sample(now - e.sent_at);  // Karn's rule
+  }
+  if (n > 0) {
+    if (s->cwnd < s->ssthresh) {
+      s->cwnd += static_cast<double>(n);  // slow start
+    } else {
+      s->cwnd += static_cast<double>(n) / s->cwnd;  // congestion avoidance
+    }
+    if (s->cwnd > s->cwnd_cap) s->cwnd = s->cwnd_cap;
+  }
+  return static_cast<int32_t>(n <= cap ? n : cap);
+}
+
+int32_t arq_due(ArqState* s, double now, uint32_t* seqs_out, uint32_t cap) {
+  double w = s->cwnd < s->cwnd_cap ? s->cwnd : s->cwnd_cap;
+  int budget = static_cast<int>(w);
+  if (budget < static_cast<int>(kCwndMin)) budget = static_cast<int>(kCwndMin);
+  int32_t n = 0;
+  for (Entry& e : s->inflight) {
+    if (n >= budget || static_cast<uint32_t>(n) >= cap) break;
+    int exp = e.tries < kMaxBackoffExp ? static_cast<int>(e.tries)
+                                       : kMaxBackoffExp;
+    double rto = s->rto * static_cast<double>(1u << exp);
+    if (rto > kRtoMax) rto = kRtoMax;
+    if (now - e.sent_at >= rto) {
+      s->on_timeout_loss(now);
+      e.sent_at = now;
+      e.tries += 1;
+      s->retransmits += 1;
+      seqs_out[n++] = e.seq;
+    }
+  }
+  return n;
+}
+
+int32_t arq_can_send(const ArqState* s) {
+  double w = s->cwnd < s->cwnd_cap ? s->cwnd : s->cwnd_cap;
+  return s->inflight.size() < static_cast<size_t>(w) ? 1 : 0;
+}
+
+int32_t arq_in_flight(const ArqState* s) {
+  return static_cast<int32_t>(s->inflight.size());
+}
+
+double arq_srtt(const ArqState* s) { return s->srtt; }
+double arq_rttvar(const ArqState* s) { return s->rttvar; }
+double arq_rto(const ArqState* s) { return s->rto; }
+double arq_cwnd(const ArqState* s) { return s->cwnd; }
+double arq_ssthresh(const ArqState* s) { return s->ssthresh; }
+uint64_t arq_retransmits(const ArqState* s) { return s->retransmits; }
+
+}  // extern "C"

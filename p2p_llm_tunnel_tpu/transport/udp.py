@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 
 from p2p_llm_tunnel_tpu.transport import relay as relay_mod
 from p2p_llm_tunnel_tpu.transport import stun
+from p2p_llm_tunnel_tpu.transport.arq import CWND_MIN, RTO_MIN, make_arq
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.transport.crypto import CryptoError, SecureBox
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
@@ -55,10 +56,9 @@ REPLAY_WINDOW = 4096  # counters older than max-seen minus this are dropped
 
 MTU_PAYLOAD = 1200  # fragment payload bytes per datagram
 WINDOW = 512  # hard cap on unacked packets in flight (cwnd never exceeds it)
-RTO_MIN = 0.15
-RTO_MAX = 2.0
-CWND_INIT = 32  # initial congestion window (packets)
-CWND_MIN = 4  # floor after multiplicative decrease
+# RTO/cwnd constants live with the ARQ core (transport/arq.py, mirrored in
+# native/tunnel_arq.cc); imported here for the maintenance tick and the
+# SO_RCVBUF-derived cwnd cap.
 KEEPALIVE_INTERVAL = 5.0
 DEAD_TIMEOUT = 15.0
 PUNCH_INTERVAL = 0.25
@@ -95,21 +95,14 @@ class UdpChannel(Channel):
         self._peer_addr: Optional[Tuple[str, int]] = None
         self._established = asyncio.Event()
 
-        # sender state
+        # sender state: ARQ/congestion bookkeeping lives in the swappable
+        # core (transport/arq.py — native C++ when built, Python reference
+        # otherwise); this class keeps only the packet BYTES per seq.
         self._next_seq = 0
-        self._unacked: Dict[int, Tuple[bytes, float, int]] = {}  # seq → (pkt, sent_at, tries)
+        self._arq = make_arq(float(WINDOW))
+        self._unacked: Dict[int, bytes] = {}  # seq → sealed packet
         self._window_free = asyncio.Event()
         self._window_free.set()
-
-        # congestion control (Jacobson RTO + AIMD window)
-        self._srtt: Optional[float] = None
-        self._rttvar = 0.0
-        self._rto = RTO_MAX / 2  # conservative until the first RTT sample
-        self._cwnd = float(CWND_INIT)
-        self._cwnd_cap = float(WINDOW)  # tightened by bind() from SO_RCVBUF
-        self._ssthresh = float(WINDOW)
-        self._last_backoff = 0.0
-        self._retransmits = 0  # total, for tests/metrics
 
         # receiver state
         self._recv_next = 0
@@ -156,9 +149,9 @@ class UdpChannel(Channel):
             except OSError:
                 pass
             rcvbuf = sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF)
-            ch._cwnd_cap = float(
+            ch._arq.set_cwnd_cap(float(
                 max(CWND_MIN, min(WINDOW, rcvbuf // (2 * MTU_PAYLOAD)))
-            )
+            ))
         return ch
 
     @property
@@ -169,13 +162,14 @@ class UdpChannel(Channel):
     def congestion_stats(self) -> dict:
         """Live ARQ/congestion state (observability + loss-injection tests)."""
         return {
-            "srtt": self._srtt,
-            "rttvar": self._rttvar,
-            "rto": self._rto,
-            "cwnd": self._cwnd,
-            "ssthresh": self._ssthresh,
-            "retransmits": self._retransmits,
-            "in_flight": len(self._unacked),
+            "srtt": self._arq.srtt,
+            "rttvar": self._arq.rttvar,
+            "rto": self._arq.rto,
+            "cwnd": self._arq.cwnd,
+            "ssthresh": self._arq.ssthresh,
+            "retransmits": self._arq.retransmits,
+            "in_flight": self._arq.in_flight,
+            "native_arq": type(self._arq).__name__ == "NativeArq",
         }
 
     def set_session(self, box: SecureBox) -> None:
@@ -316,7 +310,7 @@ class UdpChannel(Channel):
         offsets = range(0, len(data), MTU_PAYLOAD) if data else [0]
         frags = [data[o : o + MTU_PAYLOAD] for o in offsets]
         for i, frag in enumerate(frags):
-            while len(self._unacked) >= int(min(self._cwnd_cap, self._cwnd)):
+            while not self._arq.can_send():
                 self._window_free.clear()
                 await self._window_free.wait()
                 if self.is_closed:
@@ -325,7 +319,8 @@ class UdpChannel(Channel):
             self._next_seq = (self._next_seq + 1) & 0xFFFFFFFF
             fin = 1 if i == len(frags) - 1 else 0
             pkt = _DATA_HDR.pack(PT_DATA, seq, fin) + frag
-            self._unacked[seq] = (pkt, time.monotonic(), 0)
+            self._unacked[seq] = pkt
+            self._arq.on_send(seq, time.monotonic())
             self._send_raw(pkt, self._peer_addr)
 
     # -- receiving ---------------------------------------------------------
@@ -399,47 +394,13 @@ class UdpChannel(Channel):
             self.close()
 
     def _handle_ack(self, cum: int) -> None:
-        # cumulative: everything strictly below `cum` is delivered.
-        now = time.monotonic()
-        newly_acked = 0
-        for seq in [s for s in self._unacked if _seq_lt(s, cum)]:
-            pkt, sent_at, tries = self._unacked.pop(seq)
-            newly_acked += 1
-            if tries == 0:
-                # Karn's rule: only never-retransmitted packets give an
-                # unambiguous RTT sample.
-                self._rtt_sample(now - sent_at)
-        if newly_acked:
-            # AIMD growth: slow start doubles per RTT (+1 per acked packet),
-            # congestion avoidance adds ~1 packet per RTT (+n/cwnd).
-            if self._cwnd < self._ssthresh:
-                self._cwnd = min(self._cwnd_cap, self._cwnd + newly_acked)
-            else:
-                self._cwnd = min(
-                    self._cwnd_cap, self._cwnd + newly_acked / self._cwnd
-                )
-        if len(self._unacked) < int(min(self._cwnd_cap, self._cwnd)):
+        # Cumulative: everything strictly below `cum` is delivered.  The
+        # ARQ core does the bookkeeping (Karn RTT sampling, AIMD growth);
+        # this side just drops the acked packet bytes and wakes senders.
+        for seq in self._arq.on_ack(cum, time.monotonic()):
+            self._unacked.pop(seq, None)
+        if self._arq.can_send():
             self._window_free.set()
-
-    def _rtt_sample(self, rtt: float) -> None:
-        """Jacobson/Karels estimator: rto = srtt + 4·rttvar, clamped."""
-        if self._srtt is None:
-            self._srtt = rtt
-            self._rttvar = rtt / 2
-        else:
-            self._rttvar = 0.75 * self._rttvar + 0.25 * abs(self._srtt - rtt)
-            self._srtt = 0.875 * self._srtt + 0.125 * rtt
-        self._rto = min(RTO_MAX, max(RTO_MIN, self._srtt + 4 * self._rttvar))
-
-    def _on_timeout_loss(self, now: float) -> None:
-        """Multiplicative decrease, at most once per RTT (a whole window lost
-        to one congestion event must not collapse cwnd to the floor)."""
-        if now - self._last_backoff < (self._srtt or self._rto):
-            return
-        self._last_backoff = now
-        self._ssthresh = max(float(CWND_MIN), self._cwnd / 2)
-        self._cwnd = self._ssthresh
-        log.debug("congestion backoff: cwnd=%.0f rto=%.3f", self._cwnd, self._rto)
 
     def _handle_data(self, seq: int, fin: bool, payload: bytes) -> None:
         if _seq_lt(seq, self._recv_next):
@@ -472,12 +433,12 @@ class UdpChannel(Channel):
                 # should read per-channel congestion_stats instead.
                 # Retransmits are a COUNTER (incremented at retransmit time
                 # below) so they aggregate correctly across channels.
-                global_metrics.set_gauge("transport_cwnd", self._cwnd)
+                global_metrics.set_gauge("transport_cwnd", self._arq.cwnd)
                 global_metrics.set_gauge(
-                    "transport_srtt_ms", (self._srtt or 0.0) * 1000.0
+                    "transport_srtt_ms", (self._arq.srtt or 0.0) * 1000.0
                 )
                 global_metrics.set_gauge(
-                    "transport_in_flight", float(len(self._unacked))
+                    "transport_in_flight", float(self._arq.in_flight)
                 )
                 if self._established.is_set():
                     if now - self._last_heard > DEAD_TIMEOUT:
@@ -485,34 +446,19 @@ class UdpChannel(Channel):
                                     DEAD_TIMEOUT)
                         self.close()
                         return
-                    # Pace retransmissions by the (just-halved) cwnd: a
-                    # whole-window burst loss expires in one tick, and
-                    # resending it all back-to-back would blast the same
-                    # burst into the queue that just dropped it.  Unsent
-                    # expirees go out on later ticks (their sent_at is
-                    # untouched), naturally staggered.
-                    budget = max(CWND_MIN, int(min(self._cwnd, self._cwnd_cap)))
-                    resent = 0
-                    # Oldest-first in mod-2^32 sequence space: in-flight
-                    # seqs live in [next_seq - W, next_seq), so this key is
-                    # smallest for the packet the peer's cumulative ACK is
-                    # blocked on — a plain numeric sort would invert at the
-                    # u32 wrap and starve it of the per-tick budget.
-                    base = self._next_seq
-                    for seq, (pkt, sent_at, tries) in sorted(
-                        self._unacked.items(),
-                        key=lambda kv: (kv[0] - base) & 0xFFFFFFFF,
-                    ):
-                        if resent >= budget:
-                            break
-                        # Estimated RTO with exponential backoff per retry.
-                        rto = min(RTO_MAX, self._rto * (2 ** min(tries, 4)))
-                        if now - sent_at >= rto:
-                            self._on_timeout_loss(now)
-                            self._unacked[seq] = (pkt, now, tries + 1)
-                            self._retransmits += 1
-                            global_metrics.inc("transport_retransmits_total")
-                            resent += 1
+                    # The ARQ core picks what to resend: expired (per-retry
+                    # backed-off RTO) packets, oldest-first in mod-2^32
+                    # order, paced by a cwnd-sized per-tick budget, with
+                    # the once-per-RTT multiplicative decrease applied
+                    # internally.
+                    due = self._arq.due(now)
+                    if due:
+                        global_metrics.inc(
+                            "transport_retransmits_total", len(due)
+                        )
+                    for seq in due:
+                        pkt = self._unacked.get(seq)
+                        if pkt is not None:
                             self._send_raw(pkt, self._peer_addr)
                     # Keepalive gates on time-since-last-SENT and uses PUNCH
                     # (which elicits a PUNCH_ACK), so an idle-but-healthy

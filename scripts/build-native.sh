@@ -5,11 +5,16 @@ cd "$(dirname "$0")/../native"
 mkdir -p build
 g++ -O2 -Wall -Wextra -shared -fPIC tunnel_frames.cc -o build/libtunnelframes.so
 echo "built native/build/libtunnelframes.so"
+g++ -O2 -Wall -Wextra -shared -fPIC tunnel_arq.cc -o build/libtunnelarq.so
+echo "built native/build/libtunnelarq.so"
 
 if [[ "${1:-}" == "sanitize" ]]; then
-  # ASan+UBSan self-test binary (make native-san): the C++ analog of the
+  # ASan+UBSan self-test binaries (make native-san): the C++ analog of the
   # memory/UB safety Rust gives the reference codec for free.
   g++ -O1 -g -Wall -Wextra -fsanitize=address,undefined -fno-sanitize-recover=all \
     tunnel_frames.cc tunnel_frames_test.cc -o build/tunnel_frames_test
   echo "built native/build/tunnel_frames_test (asan+ubsan)"
+  g++ -O1 -g -Wall -Wextra -fsanitize=address,undefined -fno-sanitize-recover=all \
+    tunnel_arq.cc tunnel_arq_test.cc -o build/tunnel_arq_test
+  echo "built native/build/tunnel_arq_test (asan+ubsan)"
 fi

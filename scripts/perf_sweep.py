@@ -21,8 +21,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: (label, env overrides).  Ordered cheap-insight-first so a blown budget
-#: still yields the key comparisons.
+#: (label, env overrides).
 #: Ordered DECISION-VALUE-first so a blown budget still yields the key
 #: comparisons: default-config validation, the prefix-cache ablation, the
 #: throughput levers (slots/steps/flash), the long-context pair (VERDICT
@@ -78,9 +77,10 @@ def main() -> None:
             break
         deadline = min(per_run, remaining - 10)
         model = overrides.get("BENCH_MODEL", "llama3-8b")
-        env = dict(os.environ, BENCH_MODEL=model,
-                   BENCH_SINGLE=model,
-                   BENCH_SINGLE_DEADLINE=str(deadline), **overrides)
+        env = dict(os.environ)
+        env.update({"BENCH_MODEL": model, "BENCH_SINGLE": model,
+                    "BENCH_SINGLE_DEADLINE": str(deadline)})
+        env.update(overrides)
         print(f"=== {label} (deadline {deadline:.0f}s) ===", file=sys.stderr,
               flush=True)
         try:

@@ -19,7 +19,8 @@ import time
 import pytest
 
 from p2p_llm_tunnel_tpu.transport.crypto import HandshakeKeys
-from p2p_llm_tunnel_tpu.transport.udp import CWND_INIT, WINDOW, UdpChannel
+from p2p_llm_tunnel_tpu.transport.arq import CWND_INIT
+from p2p_llm_tunnel_tpu.transport.udp import WINDOW, UdpChannel
 
 
 def run(coro):
