@@ -7,7 +7,11 @@ loop — one RES_BODY frame per SSE event.
 
 Surfaces (BASELINE.md configs):
 - OpenAI: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
+  (stream + non-stream; temperature/top_k/top_p, frequency_penalty/
+  presence_penalty over generated tokens, string `stop` sequences with
+  boundary-safe matching, ignore_eos)
 - Ollama: GET /api/tags, POST /api/generate, POST /api/chat
+  (NDJSON streaming; options.stop)
 - GET /health
 
 SSE chunk shape matches the conformance fixture tmp/mock_llm.py:36-88.
@@ -58,6 +62,50 @@ def render_chat_prompt(messages) -> str:
     return "\n".join(parts)
 
 
+class _StopMatcher:
+    """Boundary-safe string-stop detection over a token text stream.
+
+    OpenAI's ``stop`` sequences are strings that may span token (and SSE
+    chunk) boundaries; text that could be the PREFIX of a stop is held back
+    until disambiguated, so clients never see any part of a stop sequence
+    (the same contract Ollama/OpenAI upstreams give the reference tunnel).
+    """
+
+    def __init__(self, stops):
+        self._stops = [s for s in (stops or []) if s]
+        self._hold_max = max((len(s) for s in self._stops), default=1) - 1
+        self._buf = ""
+
+    def feed(self, text: str):
+        """Returns (emittable_text, stopped)."""
+        if not self._stops:
+            return text, False
+        self._buf += text
+        first = -1
+        for s in self._stops:
+            i = self._buf.find(s)
+            if i != -1 and (first == -1 or i < first):
+                first = i
+        if first != -1:
+            out, self._buf = self._buf[:first], ""
+            return out, True
+        hold = 0
+        if self._hold_max > 0:
+            for s in self._stops:
+                for k in range(min(len(s) - 1, len(self._buf)), hold, -1):
+                    if self._buf.endswith(s[:k]):
+                        hold = k
+                        break
+        cut = len(self._buf) - hold
+        out, self._buf = self._buf[:cut], self._buf[cut:]
+        return out, False
+
+    def flush(self) -> str:
+        """End of stream: held text was not a stop after all — emit it."""
+        out, self._buf = self._buf, ""
+        return out
+
+
 class EngineAPI:
     """Routes tunneled requests to the engine; one instance per serve peer."""
 
@@ -79,15 +127,61 @@ class EngineAPI:
         temperature = float(body.get("temperature") or 0.0)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        freq_pen = float(body.get("frequency_penalty") or 0.0)
+        pres_pen = float(body.get("presence_penalty") or 0.0)
+        if not (-2.0 <= freq_pen <= 2.0 and -2.0 <= pres_pen <= 2.0):
+            raise ValueError("penalties must be in [-2, 2]")
         kwargs = dict(
             max_new_tokens=max_tokens,
             temperature=temperature,
             top_k=int(body.get("top_k") or 0),
             top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
+            freq_pen=freq_pen,
+            pres_pen=pres_pen,
         )
         if body.get("ignore_eos"):  # vLLM-style benchmarking knob
             kwargs["stop_ids"] = ()
         return kwargs
+
+    @staticmethod
+    def _stop_strings(body: dict) -> list:
+        """OpenAI ``stop`` (str | [str]) or Ollama ``options.stop``."""
+        stop = body.get("stop")
+        if stop is None and isinstance(body.get("options"), dict):
+            stop = body["options"].get("stop")
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            return [stop]
+        if isinstance(stop, list) and all(isinstance(s, str) for s in stop):
+            return [s for s in stop if s]
+        raise ValueError("stop must be a string or a list of strings")
+
+    async def _events(self, prompt_ids, kwargs, stops):
+        """Engine stream with string-stop handling applied.
+
+        Yields ``(text, ev, finish)`` per engine token event: ``text`` is
+        what may be emitted now (may be '' while a potential stop prefix is
+        held), ``finish`` is None mid-stream and set exactly once on the
+        final yield ('stop' for stop strings/tokens, 'length', ...).
+        """
+        m = _StopMatcher(stops)
+        gen = self.engine.generate(prompt_ids, **kwargs)
+        try:
+            async for ev in gen:
+                text, hit = m.feed(ev.text) if ev.text else ("", False)
+                if hit:
+                    yield text, ev, "stop"
+                    return
+                if ev.finish_reason is not None:
+                    yield text + m.flush(), ev, ev.finish_reason
+                    return
+                yield text, ev, None
+        finally:
+            # Deterministic teardown on early exit (stop hit, consumer
+            # cancel): generate()'s finally frees the batch slot NOW, not
+            # whenever the asyncgen finalizer happens to collect it.
+            await gen.aclose()
 
     def _check_prompt(self, prompt_ids) -> None:
         """Reject unservable prompts eagerly (scheduler would raise lazily,
@@ -109,7 +203,7 @@ class EngineAPI:
         }
 
     async def _openai_stream(
-        self, prompt_ids, kwargs, object_name: str, completion_id: str
+        self, prompt_ids, kwargs, stops, object_name: str, completion_id: str
     ) -> AsyncIterator[bytes]:
         # Per-token cost matters at 1800+ tok/s x 32 streams: fold the
         # stream-constant envelope once and splice only the delta/finish in.
@@ -139,7 +233,7 @@ class EngineAPI:
 
         finish_reason = "stop"
         first = True
-        async for ev in self.engine.generate(prompt_ids, **kwargs):
+        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             if first:
                 # OpenAI streams open with a role-only delta chunk; emitting
                 # it when the FIRST token lands (not at accept) also gives
@@ -147,24 +241,24 @@ class EngineAPI:
                 # token's text is empty (mid-codepoint byte, special id).
                 yield chunk({"role": "assistant"}, None)
                 first = False
-            if ev.text:
-                yield content_chunk(ev.text)
-            if ev.finish_reason is not None:
-                finish_reason = ev.finish_reason
+            if text:
+                yield content_chunk(text)
+            if finish is not None:
+                finish_reason = finish
         yield chunk({}, finish_reason)
         yield b"data: [DONE]\n\n"
 
-    async def _openai_complete(self, prompt_ids, kwargs, chat: bool):
-        text = []
+    async def _openai_complete(self, prompt_ids, kwargs, stops, chat: bool):
+        parts = []
         finish_reason = "stop"
         n_tokens = 0
-        async for ev in self.engine.generate(prompt_ids, **kwargs):
+        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             n_tokens += 1
-            if ev.text:
-                text.append(ev.text)
-            if ev.finish_reason is not None:
-                finish_reason = ev.finish_reason
-        content = "".join(text)
+            if text:
+                parts.append(text)
+            if finish is not None:
+                finish_reason = finish
+        content = "".join(parts)
         usage = {
             "prompt_tokens": len(prompt_ids),
             "completion_tokens": n_tokens,
@@ -194,35 +288,39 @@ class EngineAPI:
 
     # -- Ollama ----------------------------------------------------------
 
-    async def _ollama_generate_stream(self, prompt_ids, kwargs) -> AsyncIterator[bytes]:
-        finish = "stop"
-        async for ev in self.engine.generate(prompt_ids, **kwargs):
-            if ev.finish_reason is not None:
-                finish = ev.finish_reason
-            if ev.text:
+    async def _ollama_generate_stream(
+        self, prompt_ids, kwargs, stops
+    ) -> AsyncIterator[bytes]:
+        done_reason = "stop"
+        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
+            if finish is not None:
+                done_reason = finish
+            if text:
                 yield (json.dumps(
-                    {"model": self.model_name, "response": ev.text, "done": False}
+                    {"model": self.model_name, "response": text, "done": False}
                 ) + "\n").encode()
         yield (json.dumps(
             {"model": self.model_name, "response": "", "done": True,
-             "done_reason": finish}
+             "done_reason": done_reason}
         ) + "\n").encode()
 
-    async def _ollama_chat_stream(self, prompt_ids, kwargs) -> AsyncIterator[bytes]:
-        finish = "stop"
-        async for ev in self.engine.generate(prompt_ids, **kwargs):
-            if ev.finish_reason is not None:
-                finish = ev.finish_reason
-            if ev.text:
+    async def _ollama_chat_stream(
+        self, prompt_ids, kwargs, stops
+    ) -> AsyncIterator[bytes]:
+        done_reason = "stop"
+        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
+            if finish is not None:
+                done_reason = finish
+            if text:
                 yield (json.dumps(
                     {"model": self.model_name,
-                     "message": {"role": "assistant", "content": ev.text},
+                     "message": {"role": "assistant", "content": text},
                      "done": False}
                 ) + "\n").encode()
         yield (json.dumps(
             {"model": self.model_name,
              "message": {"role": "assistant", "content": ""},
-             "done": True, "done_reason": finish}
+             "done": True, "done_reason": done_reason}
         ) + "\n").encode()
 
     # -- router ----------------------------------------------------------
@@ -256,6 +354,7 @@ class EngineAPI:
 
         try:
             kwargs = self._gen_kwargs(payload)
+            stops = self._stop_strings(payload)
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")
             )
@@ -269,9 +368,9 @@ class EngineAPI:
                 if stream:
                     cid = f"chatcmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, "chat.completion.chunk", cid
+                        prompt_ids, kwargs, stops, "chat.completion.chunk", cid
                     )
-                return await self._openai_complete(prompt_ids, kwargs, chat=True)
+                return await self._openai_complete(prompt_ids, kwargs, stops, chat=True)
 
             if path == "/v1/completions":
                 prompt = payload.get("prompt", "")
@@ -282,18 +381,18 @@ class EngineAPI:
                 if stream:
                     cid = f"cmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, "text_completion.chunk", cid
+                        prompt_ids, kwargs, stops, "text_completion.chunk", cid
                     )
-                return await self._openai_complete(prompt_ids, kwargs, chat=False)
+                return await self._openai_complete(prompt_ids, kwargs, stops, chat=False)
 
             if path == "/api/generate":
                 prompt_ids = self.engine.tokenizer.encode(str(payload.get("prompt", "")))
                 self._check_prompt(prompt_ids)
                 if stream:
                     return 200, dict(_NDJSON), self._ollama_generate_stream(
-                        prompt_ids, kwargs
+                        prompt_ids, kwargs, stops
                     )
-                text, n, finish = await self._drain(prompt_ids, kwargs)
+                text, n, finish = await self._drain(prompt_ids, kwargs, stops)
                 return _json_response(
                     200, {"model": self.model_name, "response": text, "done": True,
                           "done_reason": finish, "eval_count": n},
@@ -305,9 +404,9 @@ class EngineAPI:
                 self._check_prompt(prompt_ids)
                 if stream:
                     return 200, dict(_NDJSON), self._ollama_chat_stream(
-                        prompt_ids, kwargs
+                        prompt_ids, kwargs, stops
                     )
-                text, n, finish = await self._drain(prompt_ids, kwargs)
+                text, n, finish = await self._drain(prompt_ids, kwargs, stops)
                 return _json_response(
                     200, {"model": self.model_name,
                           "message": {"role": "assistant", "content": text},
@@ -318,15 +417,15 @@ class EngineAPI:
 
         return _error(404, f"unknown path {path}")
 
-    async def _drain(self, prompt_ids, kwargs):
-        parts, n, finish = [], 0, "stop"
-        async for ev in self.engine.generate(prompt_ids, **kwargs):
+    async def _drain(self, prompt_ids, kwargs, stops):
+        parts, n, done = [], 0, "stop"
+        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             n += 1
-            if ev.text:
-                parts.append(ev.text)
-            if ev.finish_reason is not None:
-                finish = ev.finish_reason
-        return "".join(parts), n, finish
+            if text:
+                parts.append(text)
+            if finish is not None:
+                done = finish
+        return "".join(parts), n, done
 
 
 def engine_backend(engine: InferenceEngine, model_name: str | None = None):

@@ -296,6 +296,8 @@ class InferenceEngine:
         self._temp = np.zeros((rows,), np.float32)
         self._top_k = np.zeros((rows,), np.int32)
         self._top_p = np.ones((rows,), np.float32)
+        self._freq_pen = np.zeros((rows,), np.float32)
+        self._pres_pen = np.zeros((rows,), np.float32)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -313,13 +315,14 @@ class InferenceEngine:
             max_workers=1, thread_name_prefix="engine-xla"
         )
 
-        # kv_view (arg 9) and steps (arg 10) are static: one compiled burst
+        # kv_view (arg 10) and steps (arg 11) are static: one compiled burst
         # program per (power-of-2 cache-view bucket, burst size).  The view
         # keeps attention HBM reads tracking actual context length instead
         # of max_seq; the two burst sizes trade throughput (big) against
         # admission latency (small, used while requests wait).
         self._jit_decode = jax.jit(
-            self._decode_fn, donate_argnums=(1, 2, 3), static_argnums=(9, 10)
+            self._decode_fn, donate_argnums=(1, 2, 3, 4),
+            static_argnums=(10, 11),
         )
         self._jit_prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1,), static_argnums=()
@@ -331,45 +334,70 @@ class InferenceEngine:
         # Device-side decode carry (created lazily) + host override patch.
         self._dev_tokens = None
         self._dev_positions = None
+        self._dev_counts = None  # [rows, V] generated-token counts
         self._ov_mask = np.zeros((rows,), bool)
 
     # -- XLA programs -----------------------------------------------------
 
     def _decode_fn(
-        self, params, kv_cache, tokens, positions, ov_mask, ov_tok, ov_pos,
-        samp, key, kv_view, steps,
+        self, params, kv_cache, tokens, positions, counts, ov_mask, ov_tok,
+        ov_pos, samp, key, kv_view, steps,
     ):
         """``decode_steps`` chained steps; sampled tokens feed back on-device.
 
-        ``tokens``/``positions`` are the DEVICE-side carry from the previous
-        call — the host never needs to read them, which is what lets the
-        next burst dispatch while the previous burst's sampled block is
-        still in flight back to the host (~90 ms on the tunneled chip).
-        ``ov_*`` patch slots the host changed since (admissions): where
-        ov_mask is set, the carry is overridden before stepping.
+        ``tokens``/``positions``/``counts`` are the DEVICE-side carry from
+        the previous call — the host never needs to read them, which is
+        what lets the next burst dispatch while the previous burst's
+        sampled block is still in flight back to the host (~90 ms on the
+        tunneled chip).  ``ov_*`` patch slots the host changed since
+        (admissions): where ov_mask is set, the carry is overridden before
+        stepping — including resetting that row's generated-token counts
+        and crediting the prefill-sampled first token.
 
-        Returns (sampled [B,k], tokens', positions', cache').  Slots that
-        finish mid-scan keep computing (their surplus tokens are discarded
-        by the host loop); cache writes past max_seq are dropped by XLA
-        scatter OOB semantics.
+        Returns (sampled [B,k], tokens', positions', counts', cache').
+        Slots that finish mid-scan keep computing (their surplus tokens are
+        discarded by the host loop); cache writes past max_seq are dropped
+        by XLA scatter OOB semantics.
+
+        ``counts`` feeds the OpenAI frequency/presence penalties; both its
+        penalty read and per-step update run under a lax.cond inside
+        sampling.sample / here, so penalty-free batches (the common case)
+        skip the [B,V] traffic.
         """
+        b = tokens.shape[0]
         tokens = jnp.where(ov_mask, ov_tok, tokens)
         positions = jnp.where(ov_mask, ov_pos, positions)
+        any_pen = jnp.any((samp.freq_pen != 0.0) | (samp.pres_pen != 0.0))
+
+        def reset_counts():
+            c = jnp.where(ov_mask[:, None], 0, counts)
+            return c.at[jnp.arange(b), ov_tok].add(jnp.where(ov_mask, 1, 0))
+
+        # The [B,V] reset/credit also hides behind the cond: a row admitted
+        # during a penalty-free dispatch has stale counts, which only matter
+        # if THAT row has penalties — in which case it was active here and
+        # any_pen was true.
+        counts = jax.lax.cond(any_pen, reset_counts, lambda: counts)
 
         def one(carry, step_key):
-            toks, pos, cache = carry
+            toks, pos, cnt, cache = carry
             logits, cache = decode_step(
                 self.mcfg, params, cache, toks, pos, kv_view=kv_view,
                 mesh=self.mesh,
             )
-            sampled = sampling.sample(logits, samp, step_key)
-            return (sampled, pos + 1, cache), sampled
+            sampled = sampling.sample(logits, samp, step_key, counts=cnt)
+            cnt = jax.lax.cond(
+                any_pen,
+                lambda: cnt.at[jnp.arange(b), sampled].add(1),
+                lambda: cnt,
+            )
+            return (sampled, pos + 1, cnt, cache), sampled
 
         keys = jax.random.split(key, steps)
-        (tokens, positions, kv_cache), toks = jax.lax.scan(
-            one, (tokens, positions, kv_cache), keys
+        (tokens, positions, counts, kv_cache), toks = jax.lax.scan(
+            one, (tokens, positions, counts, kv_cache), keys
         )
-        return toks.T, tokens, positions, kv_cache  # [B, k]
+        return toks.T, tokens, positions, counts, kv_cache  # [B, k]
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
@@ -453,6 +481,8 @@ class InferenceEngine:
             temperature=jnp.zeros((nb,), jnp.float32),
             top_k=jnp.zeros((nb,), jnp.int32),
             top_p=jnp.ones((nb,), jnp.float32),
+            freq_pen=jnp.zeros((nb,), jnp.float32),
+            pres_pen=jnp.zeros((nb,), jnp.float32),
         )
         first, self.kv_cache = self._jit_chunk_prefill(
             self.params,
@@ -497,6 +527,8 @@ class InferenceEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        freq_pen: float = 0.0,
+        pres_pen: float = 0.0,
         stop_ids: Optional[Tuple[int, ...]] = None,
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes."""
@@ -511,6 +543,8 @@ class InferenceEngine:
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            freq_pen=freq_pen,
+            pres_pen=pres_pen,
             stop_ids=tuple(stop_ids),
         )
         state = _ActiveRequest(
@@ -607,10 +641,14 @@ class InferenceEngine:
             top_k[i] = run.request.top_k
             top_p[i] = run.request.top_p
             total += len(ids)
+        # Penalties are zero here by construction: the FIRST token has no
+        # generated predecessors, so the prefill sampler needs no counts.
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
+            freq_pen=jnp.zeros((nb,), jnp.float32),
+            pres_pen=jnp.zeros((nb,), jnp.float32),
         )
         first, self.kv_cache = self._jit_prefill(
             self.params,
@@ -656,6 +694,8 @@ class InferenceEngine:
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p),
+            freq_pen=jnp.zeros((nb,), jnp.float32),
+            pres_pen=jnp.zeros((nb,), jnp.float32),
         )
         first, self.kv_cache = self._jit_chunk_prefill(
             self.params,
@@ -735,16 +775,26 @@ class InferenceEngine:
         if self._dev_tokens is None:
             self._dev_tokens = jnp.zeros((rows,), jnp.int32)
             self._dev_positions = jnp.zeros((rows,), jnp.int32)
+            self._dev_counts = jnp.zeros(
+                (rows, self.mcfg.vocab_size), jnp.int32
+            )
         # jnp.array (copy=True) — NOT jnp.asarray — for every persistent host
         # array at the dispatch boundary: on the CPU backend asarray zero-copy
         # ALIASES numpy buffers, so mutating them after dispatch (_ov_mask
         # reset below, _account_token while the burst is still queued) would
         # corrupt what the XLA program reads — a load-dependent
         # nondeterminism (verified empirically; r2 flake).
+        # Penalties are masked by the ACTIVE set at dispatch: eviction never
+        # has to remember to zero per-slot penalty state, and a stale value
+        # from a finished request can't keep the [B,V] penalty path enabled
+        # for later all-greedy batches.
+        active = self._active_mask
         samp = sampling.SamplingParams(
             temperature=jnp.array(self._temp),
             top_k=jnp.array(self._top_k),
             top_p=jnp.array(self._top_p),
+            freq_pen=jnp.array(np.where(active, self._freq_pen, 0.0)),
+            pres_pen=jnp.array(np.where(active, self._pres_pen, 0.0)),
         )
         # INACTIVE rows are parked at position >= max_seq every dispatch:
         # decode_step writes KV at every row's carry position, and a stale
@@ -758,20 +808,20 @@ class InferenceEngine:
         ov_mask = self._ov_mask | inactive
         park = self.ecfg.max_seq
         ov_pos = np.where(inactive, park, self._positions)
-        sampled, self._dev_tokens, self._dev_positions, self.kv_cache = (
-            self._jit_decode(
-                self.params,
-                self.kv_cache,
-                self._dev_tokens,
-                self._dev_positions,
-                jnp.array(ov_mask),
-                jnp.array(self._last_token),
-                jnp.array(ov_pos),
-                samp,
-                self._next_key(),
-                self._kv_view_bucket() if view is None else view,
-                self._burst_steps() if steps is None else steps,
-            )
+        (sampled, self._dev_tokens, self._dev_positions, self._dev_counts,
+         self.kv_cache) = self._jit_decode(
+            self.params,
+            self.kv_cache,
+            self._dev_tokens,
+            self._dev_positions,
+            self._dev_counts,
+            jnp.array(ov_mask),
+            jnp.array(self._last_token),
+            jnp.array(ov_pos),
+            samp,
+            self._next_key(),
+            self._kv_view_bucket() if view is None else view,
+            self._burst_steps() if steps is None else steps,
         )
         self._ov_mask[:] = False  # patch consumed by this dispatch
         # Rows must ALSO have been active at dispatch time to be accounted:
@@ -794,6 +844,8 @@ class InferenceEngine:
         self._temp[i] = req.temperature
         self._top_k[i] = req.top_k
         self._top_p[i] = req.top_p
+        self._freq_pen[i] = req.freq_pen
+        self._pres_pen[i] = req.pres_pen
         # The device-side carry knows nothing about this slot yet; patch it
         # in at the next dispatch.
         self._ov_mask[i] = True

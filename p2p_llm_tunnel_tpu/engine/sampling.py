@@ -19,13 +19,18 @@ class SamplingParams(NamedTuple):
     temperature: jnp.ndarray  # 0 → greedy
     top_k: jnp.ndarray  # 0 → disabled
     top_p: jnp.ndarray  # 1.0 → disabled
+    freq_pen: jnp.ndarray  # OpenAI frequency_penalty, 0 → disabled
+    pres_pen: jnp.ndarray  # OpenAI presence_penalty, 0 → disabled
 
 
-def make_params(batch, temperature=0.0, top_k=0, top_p=1.0) -> SamplingParams:
+def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
+                freq_pen=0.0, pres_pen=0.0) -> SamplingParams:
     return SamplingParams(
         temperature=jnp.full((batch,), temperature, jnp.float32),
         top_k=jnp.full((batch,), top_k, jnp.int32),
         top_p=jnp.full((batch,), top_p, jnp.float32),
+        freq_pen=jnp.full((batch,), freq_pen, jnp.float32),
+        pres_pen=jnp.full((batch,), pres_pen, jnp.float32),
     )
 
 
@@ -33,12 +38,32 @@ def sample(
     logits: jnp.ndarray,  # [B, V] fp32
     params: SamplingParams,
     key: jax.Array,
+    counts: jnp.ndarray = None,  # [B, V] generated-token counts, or None
 ) -> jnp.ndarray:
     """Sample one token per row. Greedy rows (temperature==0) are exact.
 
     The stochastic path (two full [B,V] sorts for top-k/top-p — ~ms-scale at
     a 128k vocab) runs under a ``lax.cond``: an all-greedy batch, the common
-    serving default and the bench workload, pays only the argmax."""
+    serving default and the bench workload, pays only the argmax.  The same
+    discipline applies to the OpenAI frequency/presence penalties: with
+    ``counts`` provided, the [B,V] penalty term runs under its own cond so
+    penalty-free batches skip it entirely.  Penalties apply over GENERATED
+    tokens only (the engine's counts reset at admission), and — matching
+    OpenAI semantics — they shift the logits before temperature, so they
+    bias greedy decoding too.
+    """
+    if counts is not None:
+        def penalize():
+            c = counts.astype(jnp.float32)
+            return logits - (
+                params.freq_pen[:, None] * c
+                + params.pres_pen[:, None] * (c > 0)
+            )
+
+        any_pen = jnp.any(
+            (params.freq_pen != 0.0) | (params.pres_pen != 0.0)
+        )
+        logits = jax.lax.cond(any_pen, penalize, lambda: logits)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     any_stochastic = jnp.any(params.temperature > 0.0)
     return jax.lax.cond(

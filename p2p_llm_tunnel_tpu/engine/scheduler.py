@@ -24,6 +24,8 @@ class GenRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    freq_pen: float = 0.0  # OpenAI frequency_penalty over generated tokens
+    pres_pen: float = 0.0  # OpenAI presence_penalty over generated tokens
     stop_ids: tuple = ()
 
     def __post_init__(self) -> None:

@@ -209,15 +209,16 @@ def native_available() -> bool:
 class NativeArq:
     """ctypes facade over the C++ core; same API as PyArq."""
 
-    #: Enough for a whole 512-packet window acked/expired at once.
-    _BUF_CAP = 1024
-
     def __init__(self, cwnd_cap: float = 512.0):
         if _LIB is None:
             raise RuntimeError("native ARQ library not built")
         self._lib = _LIB
         self._h = ctypes.c_void_p(self._lib.arq_new(float(cwnd_cap)))
-        self._buf = (ctypes.c_uint32 * self._BUF_CAP)()
+        # Result buffer must hold a whole window acked/expired at once —
+        # sized from the cap so PyArq equivalence can't silently truncate
+        # for callers raising WINDOW above the default.
+        self._buf_cap = max(1024, 2 * int(cwnd_cap))
+        self._buf = (ctypes.c_uint32 * self._buf_cap)()
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -233,12 +234,12 @@ class NativeArq:
 
     def on_ack(self, cum: int, now: float) -> List[int]:
         n = self._lib.arq_on_ack(
-            self._h, cum & 0xFFFFFFFF, now, self._buf, self._BUF_CAP
+            self._h, cum & 0xFFFFFFFF, now, self._buf, self._buf_cap
         )
         return list(self._buf[:n])
 
     def due(self, now: float) -> List[int]:
-        n = self._lib.arq_due(self._h, now, self._buf, self._BUF_CAP)
+        n = self._lib.arq_due(self._h, now, self._buf, self._buf_cap)
         return list(self._buf[:n])
 
     def can_send(self) -> bool:

@@ -74,9 +74,12 @@ def main() -> None:
         temperature=jnp.zeros((rows,), jnp.float32),
         top_k=jnp.zeros((rows,), jnp.int32),
         top_p=jnp.ones((rows,), jnp.float32),
+        freq_pen=jnp.zeros((rows,), jnp.float32),
+        pres_pen=jnp.zeros((rows,), jnp.float32),
     )
     tokens = jnp.full((rows,), 5, jnp.int32)
     positions = jnp.full((rows,), pos0, jnp.int32)
+    counts = jnp.zeros((rows, eng.mcfg.vocab_size), jnp.int32)
     ovm = jnp.zeros((rows,), bool)
     ovt = jnp.full((rows,), 5, jnp.int32)
     ovp = jnp.full((rows,), pos0, jnp.int32)
@@ -85,9 +88,9 @@ def main() -> None:
     # Cost/memory analysis of the burst program (non-donating lower to keep
     # the analysis side-effect-free).
     try:
-        lowered = jax.jit(eng._decode_fn, static_argnums=(9, 10)).lower(
-            eng.params, eng.kv_cache, tokens, positions, ovm, ovt, ovp,
-            samp, key, kv_view, steps,
+        lowered = jax.jit(eng._decode_fn, static_argnums=(10, 11)).lower(
+            eng.params, eng.kv_cache, tokens, positions, counts, ovm, ovt,
+            ovp, samp, key, kv_view, steps,
         )
         compiled = lowered.compile()
         ca = compiled.cost_analysis()
@@ -116,13 +119,13 @@ def main() -> None:
 
     t0 = time.monotonic()
     out = eng._jit_decode(
-        eng.params, eng.kv_cache, tokens, positions, ovm, ovt, ovp, samp, key,
-        kv_view, steps,
+        eng.params, eng.kv_cache, tokens, positions, counts, ovm, ovt, ovp,
+        samp, key, kv_view, steps,
     )
     jax.block_until_ready(out)
     t_compile = time.monotonic() - t0
     print(f"compile+first burst: {t_compile:.1f}s", file=sys.stderr, flush=True)
-    sampled, tokens, positions, kv = out
+    sampled, tokens, positions, counts, kv = out
 
     times = []
     if pipeline:
@@ -131,10 +134,10 @@ def main() -> None:
             t0 = time.monotonic()
             if i < iters:
                 cur = eng._jit_decode(
-                    eng.params, kv, tokens, positions, ovm, ovt, ovp,
+                    eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
                     samp, jax.random.fold_in(key, i), kv_view, steps,
                 )
-                sampled, tokens, positions, kv = cur
+                sampled, tokens, positions, counts, kv = cur
             if in_flight is not None:
                 np.asarray(jax.device_get(in_flight))
                 times.append(time.monotonic() - t0)
@@ -142,8 +145,8 @@ def main() -> None:
     else:
         for i in range(iters):
             t0 = time.monotonic()
-            sampled, tokens, positions, kv = eng._jit_decode(
-                eng.params, kv, tokens, positions, ovm, ovt, ovp,
+            sampled, tokens, positions, counts, kv = eng._jit_decode(
+                eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
                 samp, jax.random.fold_in(key, i), kv_view, steps,
             )
             np.asarray(jax.device_get(sampled))

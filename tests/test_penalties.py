@@ -1,0 +1,135 @@
+"""OpenAI frequency/presence penalties: sampler math + engine integration.
+
+Penalties apply over GENERATED tokens only (counts reset at admission) and
+shift logits before temperature, so they bias greedy decoding too."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_llm_tunnel_tpu.engine.api import EngineAPI
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.engine.sampling import make_params, sample
+from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
+
+
+# ---------------------------------------------------------------------------
+# sampler math
+# ---------------------------------------------------------------------------
+
+def test_frequency_penalty_suppresses_repeats():
+    logits = jnp.array([[1.0, 0.9, 0.0, 0.0]])
+    counts = jnp.array([[3, 0, 0, 0]], jnp.int32)
+    # Unpenalized greedy picks 0; a frequency penalty of 0.1*3 drops it
+    # below token 1.
+    p0 = make_params(1)
+    assert int(sample(logits, p0, jax.random.PRNGKey(0), counts)[0]) == 0
+    p1 = make_params(1, freq_pen=0.1)
+    assert int(sample(logits, p1, jax.random.PRNGKey(0), counts)[0]) == 1
+
+
+def test_presence_penalty_is_binary():
+    logits = jnp.array([[1.0, 0.9, 0.0, 0.0]])
+    # Same penalty applied whether the token appeared once or many times.
+    for c in (1, 7):
+        counts = jnp.array([[c, 0, 0, 0]], jnp.int32)
+        p = make_params(1, pres_pen=0.2)
+        assert int(sample(logits, p, jax.random.PRNGKey(0), counts)[0]) == 1
+
+
+def test_no_penalty_ignores_counts():
+    logits = jnp.array([[1.0, 0.9, 0.0, 0.0]])
+    counts = jnp.array([[100, 0, 0, 0]], jnp.int32)
+    assert int(sample(logits, make_params(1), jax.random.PRNGKey(0),
+                      counts)[0]) == 0
+
+
+def test_per_row_penalties_batch_together():
+    logits = jnp.array([[1.0, 0.9, 0.0], [1.0, 0.9, 0.0]])
+    counts = jnp.array([[2, 0, 0], [2, 0, 0]], jnp.int32)
+    from p2p_llm_tunnel_tpu.engine.sampling import SamplingParams
+
+    params = SamplingParams(
+        temperature=jnp.zeros((2,)),
+        top_k=jnp.zeros((2,), jnp.int32),
+        top_p=jnp.ones((2,)),
+        freq_pen=jnp.array([0.5, 0.0]),  # row 0 penalized, row 1 not
+        pres_pen=jnp.zeros((2,)),
+    )
+    out = sample(logits, params, jax.random.PRNGKey(0), counts)
+    assert (int(out[0]), int(out[1])) == (1, 0)
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+def _gen(eng, prompt, max_new=16, **kw):
+    async def run():
+        out = []
+        async for ev in eng.generate(prompt, max_new_tokens=max_new,
+                                     stop_ids=(), **kw):
+            out.append(ev.token_id)
+        return out
+
+    return asyncio.run(run())
+
+
+def test_engine_penalty_reduces_repetition():
+    """Greedy decode of a random tiny model loops quickly; a frequency
+    penalty must strictly reduce repetition, and no-penalty requests are
+    unaffected by penalized ones sharing the batch."""
+    eng = InferenceEngine(engine_cfg=EngineConfig(
+        model="tiny", num_slots=2, max_seq=128, dtype="float32",
+    ))
+    prompt = [1, 2, 3]
+
+    async def run():
+        await eng.start()
+        base = []
+        async for ev in eng.generate(prompt, max_new_tokens=24, stop_ids=()):
+            base.append(ev.token_id)
+        pen = []
+        async for ev in eng.generate(prompt, max_new_tokens=24, stop_ids=(),
+                                     freq_pen=1.5):
+            pen.append(ev.token_id)
+        base2 = []
+        async for ev in eng.generate(prompt, max_new_tokens=24, stop_ids=()):
+            base2.append(ev.token_id)
+        await eng.stop()
+        return base, pen, base2
+
+    base, pen, base2 = asyncio.run(run())
+    assert base == base2  # penalties elsewhere never leak across requests
+    assert len(set(pen)) > len(set(base)), (
+        f"penalty should diversify: base {len(set(base))} uniq, "
+        f"pen {len(set(pen))} uniq"
+    )
+
+
+def test_api_parses_penalties():
+    eng = InferenceEngine(engine_cfg=EngineConfig(
+        model="tiny", num_slots=2, max_seq=128, dtype="float32",
+    ))
+    api = EngineAPI(eng, "tiny")
+
+    async def run():
+        await eng.start()
+        req = RequestHeaders(1, "POST", "/v1/completions", {})
+        body = json.dumps({
+            "prompt": "abc", "max_tokens": 8, "ignore_eos": True,
+            "frequency_penalty": 1.0, "presence_penalty": 0.5,
+        }).encode()
+        status, _, chunks = await api.handle(req, body)
+        out = json.loads([c async for c in chunks][0])
+        bad = json.dumps({"prompt": "abc", "frequency_penalty": 5.0}).encode()
+        bad_status, _, _ = await api.handle(req, bad)
+        await eng.stop()
+        return status, out, bad_status
+
+    status, out, bad_status = asyncio.run(run())
+    assert status == 200 and out["choices"][0]["text"]
+    assert bad_status == 400

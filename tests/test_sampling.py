@@ -54,6 +54,8 @@ def test_mixed_batch_per_slot_params():
         temperature=jnp.array([0.0, 1.0, 0.0]),
         top_k=jnp.array([0, 4, 0]),
         top_p=jnp.array([1.0, 1.0, 1.0]),
+        freq_pen=jnp.zeros((3,)),
+        pres_pen=jnp.zeros((3,)),
     )
     out = np.asarray(sample(logits, params, jax.random.PRNGKey(3)))
     ref = np.argmax(np.asarray(logits), -1)
@@ -76,6 +78,8 @@ def test_all_greedy_batch_skips_stochastic_path():
         temperature=jnp.zeros((4,)),
         top_k=jnp.full((4,), 2, jnp.int32),
         top_p=jnp.full((4,), 0.5),
+        freq_pen=jnp.zeros((4,)),
+        pres_pen=jnp.zeros((4,)),
     )
     out = sample(logits, params, jax.random.PRNGKey(3))
     np.testing.assert_array_equal(
@@ -90,6 +94,8 @@ def test_mixed_greedy_and_stochastic_rows_still_exact():
         temperature=jnp.array([0.0, 1.0, 0.0, 0.0]),
         top_k=jnp.zeros((4,), jnp.int32),
         top_p=jnp.ones((4,)),
+        freq_pen=jnp.zeros((4,)),
+        pres_pen=jnp.zeros((4,)),
     )
     out = np.asarray(sample(logits, params, jax.random.PRNGKey(4)))
     ref = np.argmax(np.asarray(logits), -1)

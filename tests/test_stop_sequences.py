@@ -1,0 +1,178 @@
+"""String stop sequences (OpenAI `stop`, Ollama `options.stop`) at the API
+layer: boundary-safe matching, held-prefix flushing, and end-to-end
+truncation through the engine."""
+
+import asyncio
+import json
+
+from p2p_llm_tunnel_tpu.engine.api import EngineAPI, _StopMatcher
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
+
+
+# ---------------------------------------------------------------------------
+# _StopMatcher
+# ---------------------------------------------------------------------------
+
+def test_matcher_passthrough_without_stops():
+    m = _StopMatcher([])
+    assert m.feed("hello") == ("hello", False)
+
+
+def test_matcher_simple_hit():
+    m = _StopMatcher(["STOP"])
+    assert m.feed("abcSTOPdef") == ("abc", True)
+
+
+def test_matcher_stop_spanning_chunks():
+    m = _StopMatcher(["END"])
+    out1, hit1 = m.feed("abcE")
+    assert (out1, hit1) == ("abc", False)  # 'E' held: could start 'END'
+    out2, hit2 = m.feed("N")
+    assert (out2, hit2) == ("", False)  # 'EN' still a prefix
+    out3, hit3 = m.feed("D tail")
+    assert (out3, hit3) == ("", True)  # completed: nothing after emits
+
+
+def test_matcher_false_prefix_flushes():
+    m = _StopMatcher(["END"])
+    assert m.feed("abcE") == ("abc", False)
+    assert m.feed("xyz") == ("Exyz", False)  # 'E' was not a stop after all
+
+
+def test_matcher_earliest_of_multiple_stops_wins():
+    m = _StopMatcher(["ZZ", "B"])
+    assert m.feed("aBcZZ") == ("a", True)
+
+
+def test_matcher_flush_returns_held_tail():
+    m = _StopMatcher(["LONGSTOP"])
+    out, hit = m.feed("xLONGSTO")
+    assert (out, hit) == ("x", False)
+    assert m.flush() == "LONGSTO"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the engine API
+# ---------------------------------------------------------------------------
+
+def _api():
+    eng = InferenceEngine(engine_cfg=EngineConfig(
+        model="tiny", num_slots=2, max_seq=128, dtype="float32",
+    ))
+    return EngineAPI(eng, "tiny"), eng
+
+
+def _req(path, body):
+    return RequestHeaders(1, "POST", path, {}), json.dumps(body).encode()
+
+
+async def _collect_sse(chunks):
+    events = []
+    async for chunk in chunks:
+        for event in chunk.decode().split("\n\n"):
+            if event.startswith("data: ") and event != "data: [DONE]":
+                events.append(json.loads(event[6:]))
+    return events
+
+
+def test_stop_string_truncates_openai_completion():
+    async def run():
+        api, eng = _api()
+        await eng.start()
+        # Learn the unstopped greedy text first, then stop on a substring
+        # drawn from its middle.
+        req, body = _req("/v1/completions", {
+            "prompt": "hello", "max_tokens": 12, "ignore_eos": True,
+        })
+        _, _, chunks = await api.handle(req, body)
+        full = json.loads([c async for c in chunks][0])
+        text = full["choices"][0]["text"]
+        assert len(text) > 4
+        stop = text[3:5]
+        req, body = _req("/v1/completions", {
+            "prompt": "hello", "max_tokens": 12, "ignore_eos": True,
+            "stop": stop,
+        })
+        _, _, chunks = await api.handle(req, body)
+        stopped = json.loads([c async for c in chunks][0])
+        choice = stopped["choices"][0]
+        await eng.stop()
+        assert stop not in choice["text"]
+        assert text.startswith(choice["text"])
+        assert choice["finish_reason"] == "stop"
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_stop_string_truncates_sse_stream():
+    async def run():
+        api, eng = _api()
+        await eng.start()
+        req, body = _req("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 12, "ignore_eos": True, "stream": True,
+        })
+        _, _, chunks = await api.handle(req, body)
+        events = await _collect_sse(chunks)
+        full = "".join(
+            e["choices"][0]["delta"].get("content", "") for e in events
+        )
+        stop = full[3:5]
+        req, body = _req("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 12, "ignore_eos": True, "stream": True,
+            "stop": [stop],
+        })
+        _, _, chunks = await api.handle(req, body)
+        events = await _collect_sse(chunks)
+        await eng.stop()
+        text = "".join(
+            e["choices"][0]["delta"].get("content", "") for e in events
+        )
+        assert stop not in text and full.startswith(text)
+        finishes = [e["choices"][0]["finish_reason"] for e in events]
+        assert finishes[-1] == "stop"
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_ollama_options_stop():
+    async def run():
+        api, eng = _api()
+        await eng.start()
+        req, body = _req("/api/generate", {
+            "prompt": "hi", "max_new_tokens": 12, "ignore_eos": True,
+            "stream": False,
+        })
+        _, _, chunks = await api.handle(req, body)
+        full = json.loads([c async for c in chunks][0])["response"]
+        stop = full[2:4]
+        req, body = _req("/api/generate", {
+            "prompt": "hi", "max_new_tokens": 12, "ignore_eos": True,
+            "stream": False, "options": {"stop": [stop]},
+        })
+        _, _, chunks = await api.handle(req, body)
+        resp = json.loads([c async for c in chunks][0])
+        await eng.stop()
+        assert stop not in resp["response"]
+        assert resp["done_reason"] == "stop"
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_invalid_stop_rejected_before_stream():
+    async def run():
+        api, eng = _api()
+        await eng.start()
+        req, body = _req("/v1/completions", {
+            "prompt": "x", "stop": 42,
+        })
+        status, _, _ = await api.handle(req, body)
+        await eng.stop()
+        return status
+
+    assert asyncio.run(run()) == 400
