@@ -27,7 +27,8 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 	PYTHONASYNCIODEBUG=1 python -W error::RuntimeWarning -m pytest \
 		tests/test_engine_stress.py tests/test_transport_net.py \
 		tests/test_transport_lossy.py tests/test_flow_control.py \
-		tests/test_reconnect.py -q
+		tests/test_reconnect.py tests/test_coalesce.py \
+		tests/test_chunked_prefill.py tests/test_arq.py -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

@@ -9,7 +9,8 @@ Surfaces (BASELINE.md configs):
 - OpenAI: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
   (stream + non-stream; temperature/top_k/top_p, frequency_penalty/
   presence_penalty over generated tokens, string `stop` sequences with
-  boundary-safe matching, ignore_eos)
+  boundary-safe matching, logprobs/top_logprobs — chat shape + legacy
+  completions shape — and ignore_eos)
 - Ollama: GET /api/tags, POST /api/generate, POST /api/chat
   (NDJSON streaming; options.stop)
 - GET /health
@@ -106,6 +107,20 @@ class _StopMatcher:
         return out
 
 
+def _lp_entry(tokenizer, ev, n_top: int) -> dict:
+    """One OpenAI chat-shape logprobs entry for a token event, with the
+    alternatives sliced to the REQUESTED count (which may be zero even when
+    the chosen-token logprob was computed)."""
+    return {
+        "token": tokenizer.decode_token(ev.token_id),
+        "logprob": ev.logprob,
+        "top_logprobs": [
+            {"token": tokenizer.decode_token(tid), "logprob": tlp}
+            for tid, tlp in (ev.top_logprobs or [])[:n_top]
+        ],
+    }
+
+
 class EngineAPI:
     """Routes tunneled requests to the engine; one instance per serve peer."""
 
@@ -115,9 +130,15 @@ class EngineAPI:
 
     # -- shared generation plumbing --------------------------------------
 
-    def _gen_kwargs(self, body: dict) -> dict:
+    def _gen_kwargs(self, body: dict):
         """Extract sampling/generation controls; raises ValueError on invalid
-        values so the router can 400 *before* any stream starts."""
+        values so the router can 400 *before* any stream starts.
+
+        Returns (engine_kwargs, n_top): ``n_top`` is how many top-logprob
+        ALTERNATIVES the response should render per token — distinct from
+        the engine gate (kwargs['logprobs']), which is >=1 whenever any
+        logprob reporting is on (the chosen-token logprob needs the device
+        computation even with zero alternatives requested)."""
         max_tokens = body.get("max_tokens")
         if max_tokens is None:
             max_tokens = body.get("max_new_tokens")
@@ -131,6 +152,29 @@ class EngineAPI:
         pres_pen = float(body.get("presence_penalty") or 0.0)
         if not (-2.0 <= freq_pen <= 2.0 and -2.0 <= pres_pen <= 2.0):
             raise ValueError("penalties must be in [-2, 2]")
+        # OpenAI: chat uses logprobs(bool)+top_logprobs(int); completions
+        # uses logprobs(int).  Normalize to one int (0 = off); requesting
+        # logprobs without top_logprobs still returns the chosen-token
+        # logprob (n=... clamped to >=1 when the bool is set).
+        from p2p_llm_tunnel_tpu.engine.sampling import TOP_LOGPROBS_CAP
+
+        raw_lp = body.get("logprobs")
+        if isinstance(raw_lp, bool):
+            n_top = int(body.get("top_logprobs") or 0) if raw_lp else 0
+            lp_on = raw_lp
+        elif raw_lp is None:
+            n_top, lp_on = 0, False
+        else:
+            # Legacy /v1/completions: logprobs=N (N may be 0 = chosen-token
+            # logprob only, no alternatives).
+            n_top, lp_on = int(raw_lp), True
+        if not 0 <= n_top <= TOP_LOGPROBS_CAP:
+            raise ValueError(
+                f"logprobs/top_logprobs must be in [0, {TOP_LOGPROBS_CAP}]"
+            )
+        # Engine gate: >=1 enables the device-side logprob computation; the
+        # RESPONSE slices alternatives to n_top (possibly zero).
+        n_lp = max(1, n_top) if lp_on else 0
         kwargs = dict(
             max_new_tokens=max_tokens,
             temperature=temperature,
@@ -138,10 +182,11 @@ class EngineAPI:
             top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
             freq_pen=freq_pen,
             pres_pen=pres_pen,
+            logprobs=n_lp,
         )
         if body.get("ignore_eos"):  # vLLM-style benchmarking knob
             kwargs["stop_ids"] = ()
-        return kwargs
+        return kwargs, n_top
 
     @staticmethod
     def _stop_strings(body: dict) -> list:
@@ -203,7 +248,8 @@ class EngineAPI:
         }
 
     async def _openai_stream(
-        self, prompt_ids, kwargs, stops, object_name: str, completion_id: str
+        self, prompt_ids, kwargs, stops, n_top: int, chat: bool,
+        object_name: str, completion_id: str,
     ) -> AsyncIterator[bytes]:
         # Per-token cost matters at 1800+ tok/s x 32 streams: fold the
         # stream-constant envelope once and splice only the delta/finish in.
@@ -231,8 +277,34 @@ class EngineAPI:
                 + '}, "finish_reason": null}]}\n\n'
             ).encode()
 
+        tok = self.engine.tokenizer
+
+        def lp_chunk(text, events):
+            # Logprobs shape per endpoint family: chat chunks carry the
+            # modern {"content": [...]} object; legacy completions chunks
+            # carry the tokens/token_logprobs/top_logprobs arrays — the
+            # SAME shapes their non-stream counterparts return.
+            if chat:
+                lp_obj = {"content": [_lp_entry(tok, e, n_top) for e in events]}
+            else:
+                lp_obj = {
+                    "tokens": [tok.decode_token(e.token_id) for e in events],
+                    "token_logprobs": [e.logprob for e in events],
+                    "top_logprobs": [
+                        {tok.decode_token(tid): tlp
+                         for tid, tlp in (e.top_logprobs or [])[:n_top]}
+                        for e in events
+                    ],
+                }
+            return (
+                head + json.dumps({"content": text})
+                + ', "logprobs": ' + json.dumps(lp_obj)
+                + ', "finish_reason": null}]}\n\n'
+            ).encode()
+
         finish_reason = "stop"
         first = True
+        pending_lp = []  # events for tokens whose text is still held
         async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             if first:
                 # OpenAI streams open with a role-only delta chunk; emitting
@@ -241,21 +313,31 @@ class EngineAPI:
                 # token's text is empty (mid-codepoint byte, special id).
                 yield chunk({"role": "assistant"}, None)
                 first = False
+            if ev is not None and ev.logprob is not None:
+                pending_lp.append(ev)
             if text:
-                yield content_chunk(text)
+                if pending_lp:
+                    yield lp_chunk(text, pending_lp)
+                    pending_lp = []
+                else:
+                    yield content_chunk(text)
             if finish is not None:
                 finish_reason = finish
         yield chunk({}, finish_reason)
         yield b"data: [DONE]\n\n"
 
-    async def _openai_complete(self, prompt_ids, kwargs, stops, chat: bool):
+    async def _openai_complete(self, prompt_ids, kwargs, stops, n_top: int,
+                               chat: bool):
         parts = []
         finish_reason = "stop"
         n_tokens = 0
+        lp_entries = []
         async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             n_tokens += 1
             if text:
                 parts.append(text)
+            if ev is not None and ev.logprob is not None:
+                lp_entries.append(ev)
             if finish is not None:
                 finish_reason = finish
         content = "".join(parts)
@@ -264,15 +346,31 @@ class EngineAPI:
             "completion_tokens": n_tokens,
             "total_tokens": len(prompt_ids) + n_tokens,
         }
+        tok = self.engine.tokenizer
         if chat:
             choice = {
                 "index": 0,
                 "message": {"role": "assistant", "content": content},
                 "finish_reason": finish_reason,
             }
+            if lp_entries:
+                choice["logprobs"] = {"content": [
+                    _lp_entry(tok, e, n_top) for e in lp_entries
+                ]}
             obj_name = "chat.completion"
         else:
             choice = {"index": 0, "text": content, "finish_reason": finish_reason}
+            if lp_entries:
+                # Legacy /v1/completions logprobs shape.
+                choice["logprobs"] = {
+                    "tokens": [tok.decode_token(e.token_id) for e in lp_entries],
+                    "token_logprobs": [e.logprob for e in lp_entries],
+                    "top_logprobs": [
+                        {tok.decode_token(tid): tlp
+                         for tid, tlp in (e.top_logprobs or [])[:n_top]}
+                        for e in lp_entries
+                    ],
+                }
             obj_name = "text_completion"
         return _json_response(
             200,
@@ -353,7 +451,7 @@ class EngineAPI:
             return _error(400, f"invalid JSON body: {e}")
 
         try:
-            kwargs = self._gen_kwargs(payload)
+            kwargs, n_top = self._gen_kwargs(payload)
             stops = self._stop_strings(payload)
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")
@@ -368,9 +466,10 @@ class EngineAPI:
                 if stream:
                     cid = f"chatcmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, stops, "chat.completion.chunk", cid
+                        prompt_ids, kwargs, stops, n_top, True,
+                        "chat.completion.chunk", cid,
                     )
-                return await self._openai_complete(prompt_ids, kwargs, stops, chat=True)
+                return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=True)
 
             if path == "/v1/completions":
                 prompt = payload.get("prompt", "")
@@ -381,9 +480,10 @@ class EngineAPI:
                 if stream:
                     cid = f"cmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, stops, "text_completion.chunk", cid
+                        prompt_ids, kwargs, stops, n_top, False,
+                        "text_completion.chunk", cid,
                     )
-                return await self._openai_complete(prompt_ids, kwargs, stops, chat=False)
+                return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=False)
 
             if path == "/api/generate":
                 prompt_ids = self.engine.tokenizer.encode(str(payload.get("prompt", "")))

@@ -126,6 +126,10 @@ class TokenEvent:
     token_id: int
     text: str
     finish_reason: Optional[str] = None  # "stop" | "length" on the last event
+    # Set when the request asked for logprobs: log P(token) under the raw
+    # model distribution, plus the top-N (id, logprob) alternatives.
+    logprob: Optional[float] = None
+    top_logprobs: Optional[List[Tuple[int, float]]] = None
 
 
 @dataclass
@@ -298,6 +302,7 @@ class InferenceEngine:
         self._top_p = np.ones((rows,), np.float32)
         self._freq_pen = np.zeros((rows,), np.float32)
         self._pres_pen = np.zeros((rows,), np.float32)
+        self._logprobs = np.zeros((rows,), np.int32)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -379,6 +384,8 @@ class InferenceEngine:
         # any_pen was true.
         counts = jax.lax.cond(any_pen, reset_counts, lambda: counts)
 
+        any_lp = jnp.any(samp.logprobs > 0)
+
         def one(carry, step_key):
             toks, pos, cnt, cache = carry
             logits, cache = decode_step(
@@ -391,13 +398,24 @@ class InferenceEngine:
                 lambda: cnt.at[jnp.arange(b), sampled].add(1),
                 lambda: cnt,
             )
-            return (sampled, pos + 1, cnt, cache), sampled
+            lp = jax.lax.cond(
+                any_lp,
+                lambda: sampling.logprob_data(logits, sampled),
+                lambda: sampling.empty_logprob_data(b),
+            )
+            return (sampled, pos + 1, cnt, cache), (sampled, lp)
 
         keys = jax.random.split(key, steps)
-        (tokens, positions, counts, kv_cache), toks = jax.lax.scan(
+        (tokens, positions, counts, kv_cache), (toks, lps) = jax.lax.scan(
             one, (tokens, positions, counts, kv_cache), keys
         )
-        return toks.T, tokens, positions, counts, kv_cache  # [B, k]
+        # [k, ...] scan stacking -> [B, k, ...] row-major for the host.
+        lp_out = (
+            lps[0].T,                     # chosen logprob [B, k]
+            jnp.swapaxes(lps[1], 0, 1),   # top ids [B, k, CAP]
+            jnp.swapaxes(lps[2], 0, 1),   # top logprobs [B, k, CAP]
+        )
+        return toks.T, lp_out, tokens, positions, counts, kv_cache  # [B, k]
 
     def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
         last_logits, kv_cache = prefill_into_cache(
@@ -405,7 +423,12 @@ class InferenceEngine:
             mesh=self.mesh,
         )
         first = sampling.sample(last_logits, samp, key)
-        return first, kv_cache
+        lp = jax.lax.cond(
+            jnp.any(samp.logprobs > 0),
+            lambda: sampling.logprob_data(last_logits, first),
+            lambda: sampling.empty_logprob_data(first.shape[0]),
+        )
+        return first, lp, kv_cache
 
     def _chunk_prefill_fn(
         self, params, kv_cache, tokens, lengths, starts, slots, samp, key
@@ -420,7 +443,12 @@ class InferenceEngine:
             slots,
         )
         first = sampling.sample(last_logits, samp, key)
-        return first, kv_cache
+        lp = jax.lax.cond(
+            jnp.any(samp.logprobs > 0),
+            lambda: sampling.logprob_data(last_logits, first),
+            lambda: sampling.empty_logprob_data(first.shape[0]),
+        )
+        return first, lp, kv_cache
 
     # -- lifecycle --------------------------------------------------------
 
@@ -458,8 +486,8 @@ class InferenceEngine:
         for view in views:
             for k in sorted(steps):
                 def _one(view=view, k=k):
-                    sampled, _ = self._dispatch_decode(view=view, steps=k)
-                    jax.block_until_ready(sampled)
+                    outs, _ = self._dispatch_decode(view=view, steps=k)
+                    jax.block_until_ready(outs[0])
                 await loop.run_in_executor(self._executor, _one)
         log.info(
             "decode warmup: %d view×steps variants compiled in %.1fs",
@@ -483,8 +511,9 @@ class InferenceEngine:
             top_p=jnp.ones((nb,), jnp.float32),
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
+            logprobs=jnp.zeros((nb,), jnp.int32),
         )
-        first, self.kv_cache = self._jit_chunk_prefill(
+        first, _lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
             jnp.zeros((nb, t), jnp.int32),
@@ -529,6 +558,7 @@ class InferenceEngine:
         top_p: float = 1.0,
         freq_pen: float = 0.0,
         pres_pen: float = 0.0,
+        logprobs: int = 0,
         stop_ids: Optional[Tuple[int, ...]] = None,
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes."""
@@ -545,6 +575,7 @@ class InferenceEngine:
             top_p=top_p,
             freq_pen=freq_pen,
             pres_pen=pres_pen,
+            logprobs=logprobs,
             stop_ids=tuple(stop_ids),
         )
         state = _ActiveRequest(
@@ -570,7 +601,8 @@ class InferenceEngine:
 
     # -- engine loop ------------------------------------------------------
 
-    def _emit(self, run: RunningSlot, token_id: int, evicted: bool) -> None:
+    def _emit(self, run: RunningSlot, token_id: int, evicted: bool,
+              lp_info=None) -> None:
         rid = run.request.request_id
         state = self._requests.get(rid)
         if state is None:
@@ -586,7 +618,18 @@ class InferenceEngine:
         if evicted:
             finish = "stop" if is_stop else "length"
         text = "" if is_stop else state.decoder.push(token_id)
-        state.queue.put_nowait(TokenEvent(token_id, text, finish))
+        logprob = tops = None
+        # Stop-token events carry no content (text forced empty), so they
+        # get no logprobs entry either — keeps the entries aligned 1:1
+        # with content tokens in both stream and non-stream responses.
+        if lp_info is not None and run.request.logprobs > 0 and not is_stop:
+            chosen, top_ids, top_lps = lp_info
+            logprob = float(chosen)
+            n = min(run.request.logprobs, len(top_ids))
+            tops = [(int(top_ids[j]), float(top_lps[j])) for j in range(n)]
+        state.queue.put_nowait(
+            TokenEvent(token_id, text, finish, logprob, tops)
+        )
 
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -641,6 +684,9 @@ class InferenceEngine:
             top_k[i] = run.request.top_k
             top_p[i] = run.request.top_p
             total += len(ids)
+        lps = np.zeros((nb,), np.int32)
+        for i, run in enumerate(runs):
+            lps[i] = run.request.logprobs
         # Penalties are zero here by construction: the FIRST token has no
         # generated predecessors, so the prefill sampler needs no counts.
         samp = sampling.SamplingParams(
@@ -649,8 +695,9 @@ class InferenceEngine:
             top_p=jnp.asarray(top_p),
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
+            logprobs=jnp.asarray(lps),
         )
-        first, self.kv_cache = self._jit_prefill(
+        first, lp, self.kv_cache = self._jit_prefill(
             self.params,
             self.kv_cache,
             jnp.asarray(tokens),
@@ -660,7 +707,7 @@ class InferenceEngine:
             self._next_key(),
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first
+        return first, lp
 
     def _dispatch_chunk_rows(self, rows, t: int):
         """Pack rows of ``(run, start, segment_ids, sample?)`` into ONE
@@ -679,6 +726,7 @@ class InferenceEngine:
         temp = np.zeros((nb,), np.float32)
         top_k = np.zeros((nb,), np.int32)
         top_p = np.ones((nb,), np.float32)
+        lps = np.zeros((nb,), np.int32)
         total = 0
         for i, (run, start, seg, sample) in enumerate(rows):
             tokens[i, : len(seg)] = seg
@@ -689,6 +737,7 @@ class InferenceEngine:
                 temp[i] = run.request.temperature
                 top_k[i] = run.request.top_k
                 top_p[i] = run.request.top_p
+                lps[i] = run.request.logprobs
             total += len(seg)
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(temp),
@@ -696,8 +745,9 @@ class InferenceEngine:
             top_p=jnp.asarray(top_p),
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
+            logprobs=jnp.asarray(lps),
         )
-        first, self.kv_cache = self._jit_chunk_prefill(
+        first, lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
             jnp.asarray(tokens),
@@ -708,7 +758,7 @@ class InferenceEngine:
             self._next_key(),
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first
+        return first, lp
 
     def _view_buckets(self) -> List[int]:
         """The full set of kv-view buckets this engine can ever dispatch:
@@ -795,6 +845,7 @@ class InferenceEngine:
             top_p=jnp.array(self._top_p),
             freq_pen=jnp.array(np.where(active, self._freq_pen, 0.0)),
             pres_pen=jnp.array(np.where(active, self._pres_pen, 0.0)),
+            logprobs=jnp.array(np.where(active, self._logprobs, 0)),
         )
         # INACTIVE rows are parked at position >= max_seq every dispatch:
         # decode_step writes KV at every row's carry position, and a stale
@@ -808,8 +859,8 @@ class InferenceEngine:
         ov_mask = self._ov_mask | inactive
         park = self.ecfg.max_seq
         ov_pos = np.where(inactive, park, self._positions)
-        (sampled, self._dev_tokens, self._dev_positions, self._dev_counts,
-         self.kv_cache) = self._jit_decode(
+        (sampled, lp_out, self._dev_tokens, self._dev_positions,
+         self._dev_counts, self.kv_cache) = self._jit_decode(
             self.params,
             self.kv_cache,
             self._dev_tokens,
@@ -833,7 +884,7 @@ class InferenceEngine:
             if run is not None and self._active_mask[i] else None
             for i, run in enumerate(self.scheduler.slots)
         ] + [None]  # scratch row
-        return sampled, assign
+        return (sampled, lp_out), assign
 
     def _admit_one(self, run: RunningSlot) -> None:
         """Set up host slot state after prefill admission."""
@@ -846,11 +897,12 @@ class InferenceEngine:
         self._top_p[i] = req.top_p
         self._freq_pen[i] = req.freq_pen
         self._pres_pen[i] = req.pres_pen
+        self._logprobs[i] = req.logprobs
         # The device-side carry knows nothing about this slot yet; patch it
         # in at the next dispatch.
         self._ov_mask[i] = True
 
-    def _account_token(self, slot: int, tok: int) -> None:
+    def _account_token(self, slot: int, tok: int, lp_info=None) -> None:
         """Record one generated token: scheduler accounting, slot-state
         update for the next decode call, eviction, emission."""
         out = self.scheduler.record_token(slot, tok)
@@ -862,7 +914,7 @@ class InferenceEngine:
             # The generated token's own position: it is written to the cache
             # by the decode step that consumes it.
             self._positions[slot] = out.cache_len - 1
-        self._emit(out, tok, evicted)
+        self._emit(out, tok, evicted, lp_info)
 
     def _prefix_copy_in(self, run: RunningSlot, pool_ids: List[int]) -> None:
         """Copy matched pool blocks into the run's slot (executor thread)."""
@@ -987,22 +1039,24 @@ class InferenceEngine:
             dispatched.append((runs, first_dev, t0))
         inserts: List[RunningSlot] = []
         for runs, first_dev, t0 in dispatched:
-            firsts = await loop.run_in_executor(
+            firsts, lp = await loop.run_in_executor(
                 self._executor,
-                lambda fd=first_dev: np.asarray(jax.device_get(fd)),
+                lambda fd=first_dev: jax.tree.map(np.asarray,
+                                                  jax.device_get(fd)),
             )
             # Wall time of this chunk's dispatch → result-on-host span, the
             # per-phase timing SURVEY §5 asks for (overlaps siblings').
             global_metrics.observe(
                 "engine_prefill_ms", (time.monotonic() - t0) * 1000.0
             )
-            for run, first in zip(runs, firsts[: len(runs)]):
+            for i, (run, first) in enumerate(zip(runs, firsts[: len(runs)])):
                 if self.scheduler.slots[run.slot] is not run:
                     # Consumer cancelled while the prefill was in flight;
                     # the slot is already free — drop it.
                     continue
                 self._admit_one(run)
-                self._account_token(run.slot, int(first))
+                lp_row = (lp[0][i], lp[1][i], lp[2][i])
+                self._account_token(run.slot, int(first), lp_row)
                 if self._prefix is not None:
                     inserts.append(run)
         # Pool inserts run after EVERY first token of the wave is out —
@@ -1051,34 +1105,38 @@ class InferenceEngine:
                 self._segmented[run.slot] = (run, start + len(seg))
             chunk_rows.append((run, start, seg, final))
             rows.append((run, final))
-        first = self._dispatch_chunk_rows(chunk_rows, chunk)
+        first_lp = self._dispatch_chunk_rows(chunk_rows, chunk)
         global_metrics.inc("engine_prefill_segments_total", len(rows))
-        return rows, first
+        return rows, first_lp
 
     async def _finish_segments(self, loop, seg) -> None:
         """Fetch a segment dispatch's sampled block; activate final rows."""
         rows, first_dev = seg
-        firsts = await loop.run_in_executor(
+        firsts, lp = await loop.run_in_executor(
             self._executor,
-            lambda: np.asarray(jax.device_get(first_dev)),
+            lambda: jax.tree.map(np.asarray, jax.device_get(first_dev)),
         )
-        for (run, final), first in zip(rows, firsts[: len(rows)]):
+        for i, ((run, final), first) in enumerate(
+            zip(rows, firsts[: len(rows)])
+        ):
             if not final or self.scheduler.slots[run.slot] is not run:
                 continue
             self._admit_one(run)
-            self._account_token(run.slot, int(first))
+            lp_row = (lp[0][i], lp[1][i], lp[2][i])
+            self._account_token(run.slot, int(first), lp_row)
             if self._prefix is not None:
                 await loop.run_in_executor(
                     self._executor, self._prefix_insert, run
                 )
 
-    async def _process_burst(self, sampled: np.ndarray, assign: List) -> None:
+    async def _process_burst(self, outs, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.
 
         ``assign`` snapshots which request held each row at dispatch time:
         rows that were freed or re-admitted since (pipelining lag) carry
         junk tokens for the *old* occupant and are skipped.
         """
+        sampled, (lp, top_ids, top_lps) = outs
         for col in range(sampled.shape[1]):
             for i in np.nonzero(self._active_mask)[0]:
                 run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
@@ -1087,7 +1145,8 @@ class InferenceEngine:
                     continue
                 if run.request.request_id != assign[i]:
                     continue  # re-admitted: its tokens come from the next burst
-                self._account_token(int(i), int(sampled[i, col]))
+                lp_row = (lp[i, col], top_ids[i, col], top_lps[i, col])
+                self._account_token(int(i), int(sampled[i, col]), lp_row)
             # Yield so this column's tokens flush to consumers before the
             # next (keeps SSE pacing smooth within a burst).
             await asyncio.sleep(0)
@@ -1136,10 +1195,11 @@ class InferenceEngine:
                 if any(self._active_mask) else None
             )
             if in_flight is not None:
-                sampled_dev, assign = in_flight
+                outs_dev, assign = in_flight
                 t0 = time.monotonic()
-                sampled = await loop.run_in_executor(
-                    self._executor, lambda: np.asarray(jax.device_get(sampled_dev))
+                outs = await loop.run_in_executor(
+                    self._executor,
+                    lambda: jax.tree.map(np.asarray, jax.device_get(outs_dev)),
                 )
                 # Decode-phase stall: how long the host waited for the
                 # previous burst after dispatching the next one (0 ≈ the
@@ -1147,7 +1207,7 @@ class InferenceEngine:
                 global_metrics.observe(
                     "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                 )
-                await self._process_burst(sampled, assign)
+                await self._process_burst(outs, assign)
             if seg is not None:
                 # Fetched after the decode work above, so the segment's
                 # device→host RTT rides under real compute.

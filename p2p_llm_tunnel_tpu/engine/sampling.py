@@ -13,6 +13,11 @@ import jax
 import jax.numpy as jnp
 
 
+#: Static top-alternatives width for logprob reporting: requests may ask
+#: for 0..CAP top_logprobs; one compiled shape serves them all.
+TOP_LOGPROBS_CAP = 8
+
+
 class SamplingParams(NamedTuple):
     """Per-slot sampling controls (all [B] arrays inside the engine)."""
 
@@ -21,16 +26,43 @@ class SamplingParams(NamedTuple):
     top_p: jnp.ndarray  # 1.0 → disabled
     freq_pen: jnp.ndarray  # OpenAI frequency_penalty, 0 → disabled
     pres_pen: jnp.ndarray  # OpenAI presence_penalty, 0 → disabled
+    logprobs: jnp.ndarray  # requested top_logprobs count, 0 → disabled
 
 
 def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
-                freq_pen=0.0, pres_pen=0.0) -> SamplingParams:
+                freq_pen=0.0, pres_pen=0.0, logprobs=0) -> SamplingParams:
     return SamplingParams(
         temperature=jnp.full((batch,), temperature, jnp.float32),
         top_k=jnp.full((batch,), top_k, jnp.int32),
         top_p=jnp.full((batch,), top_p, jnp.float32),
         freq_pen=jnp.full((batch,), freq_pen, jnp.float32),
         pres_pen=jnp.full((batch,), pres_pen, jnp.float32),
+        logprobs=jnp.full((batch,), logprobs, jnp.int32),
+    )
+
+
+def logprob_data(logits: jnp.ndarray, sampled: jnp.ndarray):
+    """(chosen_lp [B], top_ids [B,CAP] i32, top_lps [B,CAP] f32).
+
+    Log-probabilities of the RAW model distribution (before penalties/
+    temperature/truncation), matching what OpenAI reports.  Callers gate
+    this behind a lax.cond on any(params.logprobs > 0): the top_k over a
+    128k vocab is the same ms-scale cost class as the stochastic sampling
+    path.
+    """
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    chosen = (
+        jnp.take_along_axis(logits, sampled[:, None], axis=-1)[:, 0] - lse
+    )
+    top_vals, top_ids = jax.lax.top_k(logits, TOP_LOGPROBS_CAP)
+    return chosen, top_ids.astype(jnp.int32), top_vals - lse[:, None]
+
+
+def empty_logprob_data(batch: int):
+    return (
+        jnp.zeros((batch,), jnp.float32),
+        jnp.zeros((batch, TOP_LOGPROBS_CAP), jnp.int32),
+        jnp.zeros((batch, TOP_LOGPROBS_CAP), jnp.float32),
     )
 
 

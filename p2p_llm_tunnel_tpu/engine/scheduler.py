@@ -26,6 +26,7 @@ class GenRequest:
     top_p: float = 1.0
     freq_pen: float = 0.0  # OpenAI frequency_penalty over generated tokens
     pres_pen: float = 0.0  # OpenAI presence_penalty over generated tokens
+    logprobs: int = 0  # top_logprobs to report per token (0 = off)
     stop_ids: tuple = ()
 
     def __post_init__(self) -> None:

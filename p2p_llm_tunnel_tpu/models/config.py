@@ -152,6 +152,41 @@ def llama3_8b() -> ModelConfig:
     )
 
 
+def llama32_1b() -> ModelConfig:
+    """Llama-3.2-1B: tied embeddings, GQA 32/8, head_dim 64 — the smallest
+    real-checkpoint target (fits any chip; good for the opt-in
+    tests/test_real_checkpoint.py smoke)."""
+    return ModelConfig(
+        name="llama3.2-1b",
+        vocab_size=128256,
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=64,
+        ffn_dim=8192,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+        tie_embeddings=True,
+    )
+
+
+def llama32_3b() -> ModelConfig:
+    return ModelConfig(
+        name="llama3.2-3b",
+        vocab_size=128256,
+        dim=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=8192,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+        tie_embeddings=True,
+    )
+
+
 def mistral_7b() -> ModelConfig:
     """Mistral-7B-v0.1: llama-style with a 4096 sliding window on EVERY
     layer (the arch that popularised windowed attention for serving)."""
@@ -263,6 +298,8 @@ PRESETS = {
     "gemma2-2b": gemma2_2b,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
+    "llama3.2-1b": llama32_1b,
+    "llama3.2-3b": llama32_3b,
     "mistral-7b": mistral_7b,
     "qwen2-7b": qwen2_7b,
 }

@@ -76,6 +76,7 @@ def main() -> None:
         top_p=jnp.ones((rows,), jnp.float32),
         freq_pen=jnp.zeros((rows,), jnp.float32),
         pres_pen=jnp.zeros((rows,), jnp.float32),
+        logprobs=jnp.zeros((rows,), jnp.int32),
     )
     tokens = jnp.full((rows,), 5, jnp.int32)
     positions = jnp.full((rows,), pos0, jnp.int32)
@@ -125,7 +126,7 @@ def main() -> None:
     jax.block_until_ready(out)
     t_compile = time.monotonic() - t0
     print(f"compile+first burst: {t_compile:.1f}s", file=sys.stderr, flush=True)
-    sampled, tokens, positions, counts, kv = out
+    sampled, _lp, tokens, positions, counts, kv = out
 
     times = []
     if pipeline:
@@ -137,7 +138,7 @@ def main() -> None:
                     eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
                     samp, jax.random.fold_in(key, i), kv_view, steps,
                 )
-                sampled, tokens, positions, counts, kv = cur
+                sampled, _lp, tokens, positions, counts, kv = cur
             if in_flight is not None:
                 np.asarray(jax.device_get(in_flight))
                 times.append(time.monotonic() - t0)
@@ -145,7 +146,7 @@ def main() -> None:
     else:
         for i in range(iters):
             t0 = time.monotonic()
-            sampled, tokens, positions, counts, kv = eng._jit_decode(
+            sampled, _lp, tokens, positions, counts, kv = eng._jit_decode(
                 eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
                 samp, jax.random.fold_in(key, i), kv_view, steps,
             )

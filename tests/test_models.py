@@ -188,7 +188,7 @@ def test_window_pattern_all_isolates_every_layer():
 
 
 def test_new_presets_instantiate():
-    for name in ("mistral-7b", "qwen2-7b"):
+    for name in ("mistral-7b", "qwen2-7b", "llama3.2-1b", "llama3.2-3b"):
         cfg = get_config(name)
         assert cfg.n_heads % cfg.n_kv_heads == 0
         assert cfg.dim  # smoke: fields populated

@@ -58,6 +58,7 @@ def test_per_row_penalties_batch_together():
         top_p=jnp.ones((2,)),
         freq_pen=jnp.array([0.5, 0.0]),  # row 0 penalized, row 1 not
         pres_pen=jnp.zeros((2,)),
+        logprobs=jnp.zeros((2,), jnp.int32),
     )
     out = sample(logits, params, jax.random.PRNGKey(0), counts)
     assert (int(out[0]), int(out[1])) == (1, 0)

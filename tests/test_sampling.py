@@ -56,6 +56,7 @@ def test_mixed_batch_per_slot_params():
         top_p=jnp.array([1.0, 1.0, 1.0]),
         freq_pen=jnp.zeros((3,)),
         pres_pen=jnp.zeros((3,)),
+        logprobs=jnp.zeros((3,), jnp.int32),
     )
     out = np.asarray(sample(logits, params, jax.random.PRNGKey(3)))
     ref = np.argmax(np.asarray(logits), -1)
@@ -80,6 +81,7 @@ def test_all_greedy_batch_skips_stochastic_path():
         top_p=jnp.full((4,), 0.5),
         freq_pen=jnp.zeros((4,)),
         pres_pen=jnp.zeros((4,)),
+        logprobs=jnp.zeros((4,), jnp.int32),
     )
     out = sample(logits, params, jax.random.PRNGKey(3))
     np.testing.assert_array_equal(
@@ -96,6 +98,7 @@ def test_mixed_greedy_and_stochastic_rows_still_exact():
         top_p=jnp.ones((4,)),
         freq_pen=jnp.zeros((4,)),
         pres_pen=jnp.zeros((4,)),
+        logprobs=jnp.zeros((4,), jnp.int32),
     )
     out = np.asarray(sample(logits, params, jax.random.PRNGKey(4)))
     ref = np.argmax(np.asarray(logits), -1)
