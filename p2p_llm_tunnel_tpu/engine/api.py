@@ -107,6 +107,19 @@ class _StopMatcher:
         return out
 
 
+def _legacy_lp_obj(tokenizer, events, n_top: int) -> dict:
+    """Legacy /v1/completions logprobs arrays (stream + non-stream)."""
+    return {
+        "tokens": [tokenizer.decode_token(e.token_id) for e in events],
+        "token_logprobs": [e.logprob for e in events],
+        "top_logprobs": [
+            {tokenizer.decode_token(tid): tlp
+             for tid, tlp in (e.top_logprobs or [])[:n_top]}
+            for e in events
+        ],
+    }
+
+
 def _lp_entry(tokenizer, ev, n_top: int) -> dict:
     """One OpenAI chat-shape logprobs entry for a token event, with the
     alternatives sliced to the REQUESTED count (which may be zero even when
@@ -159,6 +172,8 @@ class EngineAPI:
         from p2p_llm_tunnel_tpu.engine.sampling import TOP_LOGPROBS_CAP
 
         raw_lp = body.get("logprobs")
+        if body.get("top_logprobs") is not None and not (raw_lp is True):
+            raise ValueError("top_logprobs requires logprobs to be true")
         if isinstance(raw_lp, bool):
             n_top = int(body.get("top_logprobs") or 0) if raw_lp else 0
             lp_on = raw_lp
@@ -279,26 +294,19 @@ class EngineAPI:
 
         tok = self.engine.tokenizer
 
-        def lp_chunk(text, events):
+        def lp_obj_of(events):
             # Logprobs shape per endpoint family: chat chunks carry the
             # modern {"content": [...]} object; legacy completions chunks
             # carry the tokens/token_logprobs/top_logprobs arrays — the
             # SAME shapes their non-stream counterparts return.
             if chat:
-                lp_obj = {"content": [_lp_entry(tok, e, n_top) for e in events]}
-            else:
-                lp_obj = {
-                    "tokens": [tok.decode_token(e.token_id) for e in events],
-                    "token_logprobs": [e.logprob for e in events],
-                    "top_logprobs": [
-                        {tok.decode_token(tid): tlp
-                         for tid, tlp in (e.top_logprobs or [])[:n_top]}
-                        for e in events
-                    ],
-                }
+                return {"content": [_lp_entry(tok, e, n_top) for e in events]}
+            return _legacy_lp_obj(tok, events, n_top)
+
+        def lp_chunk(text, events):
             return (
                 head + json.dumps({"content": text})
-                + ', "logprobs": ' + json.dumps(lp_obj)
+                + ', "logprobs": ' + json.dumps(lp_obj_of(events))
                 + ', "finish_reason": null}]}\n\n'
             ).encode()
 
@@ -323,7 +331,18 @@ class EngineAPI:
                     yield content_chunk(text)
             if finish is not None:
                 finish_reason = finish
-        yield chunk({}, finish_reason)
+        if pending_lp:
+            # Entries whose text never emitted (mid-codepoint final byte,
+            # zero-text stop): attach them to the final chunk so stream and
+            # non-stream logprob counts agree.
+            yield (
+                head + json.dumps({})
+                + ', "logprobs": ' + json.dumps(lp_obj_of(pending_lp))
+                + ', "finish_reason": ' + json.dumps(finish_reason)
+                + "}]}\n\n"
+            ).encode()
+        else:
+            yield chunk({}, finish_reason)
         yield b"data: [DONE]\n\n"
 
     async def _openai_complete(self, prompt_ids, kwargs, stops, n_top: int,
@@ -347,30 +366,24 @@ class EngineAPI:
             "total_tokens": len(prompt_ids) + n_tokens,
         }
         tok = self.engine.tokenizer
+        lp_requested = kwargs.get("logprobs", 0) > 0
         if chat:
             choice = {
                 "index": 0,
                 "message": {"role": "assistant", "content": content},
                 "finish_reason": finish_reason,
             }
-            if lp_entries:
+            if lp_requested:
+                # Always present when requested — possibly with an empty
+                # list (e.g. single stop-token generation), never missing.
                 choice["logprobs"] = {"content": [
                     _lp_entry(tok, e, n_top) for e in lp_entries
                 ]}
             obj_name = "chat.completion"
         else:
             choice = {"index": 0, "text": content, "finish_reason": finish_reason}
-            if lp_entries:
-                # Legacy /v1/completions logprobs shape.
-                choice["logprobs"] = {
-                    "tokens": [tok.decode_token(e.token_id) for e in lp_entries],
-                    "token_logprobs": [e.logprob for e in lp_entries],
-                    "top_logprobs": [
-                        {tok.decode_token(tid): tlp
-                         for tid, tlp in (e.top_logprobs or [])[:n_top]}
-                        for e in lp_entries
-                    ],
-                }
+            if lp_requested:
+                choice["logprobs"] = _legacy_lp_obj(tok, lp_entries, n_top)
             obj_name = "text_completion"
         return _json_response(
             200,

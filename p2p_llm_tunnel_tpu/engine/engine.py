@@ -707,7 +707,7 @@ class InferenceEngine:
             self._next_key(),
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, lp
+        return first, (lp if lps.any() else None)
 
     def _dispatch_chunk_rows(self, rows, t: int):
         """Pack rows of ``(run, start, segment_ids, sample?)`` into ONE
@@ -758,7 +758,7 @@ class InferenceEngine:
             self._next_key(),
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, lp
+        return first, (lp if lps.any() else None)
 
     def _view_buckets(self) -> List[int]:
         """The full set of kv-view buckets this engine can ever dispatch:
@@ -884,6 +884,11 @@ class InferenceEngine:
             if run is not None and self._active_mask[i] else None
             for i, run in enumerate(self.scheduler.slots)
         ] + [None]  # scratch row
+        # Skip the lp arrays in the host fetch when nobody asked: the
+        # ~17 KB/burst of zeros would otherwise ride every device_get on a
+        # link where transfer time is the bottleneck.
+        if not np.any(np.where(active, self._logprobs, 0)):
+            lp_out = None
         return (sampled, lp_out), assign
 
     def _admit_one(self, run: RunningSlot) -> None:
@@ -1055,7 +1060,7 @@ class InferenceEngine:
                     # the slot is already free — drop it.
                     continue
                 self._admit_one(run)
-                lp_row = (lp[0][i], lp[1][i], lp[2][i])
+                lp_row = None if lp is None else (lp[0][i], lp[1][i], lp[2][i])
                 self._account_token(run.slot, int(first), lp_row)
                 if self._prefix is not None:
                     inserts.append(run)
@@ -1122,7 +1127,7 @@ class InferenceEngine:
             if not final or self.scheduler.slots[run.slot] is not run:
                 continue
             self._admit_one(run)
-            lp_row = (lp[0][i], lp[1][i], lp[2][i])
+            lp_row = None if lp is None else (lp[0][i], lp[1][i], lp[2][i])
             self._account_token(run.slot, int(first), lp_row)
             if self._prefix is not None:
                 await loop.run_in_executor(
@@ -1136,7 +1141,7 @@ class InferenceEngine:
         rows that were freed or re-admitted since (pipelining lag) carry
         junk tokens for the *old* occupant and are skipped.
         """
-        sampled, (lp, top_ids, top_lps) = outs
+        sampled, lp_out = outs
         for col in range(sampled.shape[1]):
             for i in np.nonzero(self._active_mask)[0]:
                 run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
@@ -1145,7 +1150,10 @@ class InferenceEngine:
                     continue
                 if run.request.request_id != assign[i]:
                     continue  # re-admitted: its tokens come from the next burst
-                lp_row = (lp[i, col], top_ids[i, col], top_lps[i, col])
+                lp_row = None
+                if lp_out is not None:
+                    lp, top_ids, top_lps = lp_out
+                    lp_row = (lp[i, col], top_ids[i, col], top_lps[i, col])
                 self._account_token(int(i), int(sampled[i, col]), lp_row)
             # Yield so this column's tokens flush to consumers before the
             # next (keeps SSE pacing smooth within a burst).
