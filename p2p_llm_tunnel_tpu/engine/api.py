@@ -120,6 +120,14 @@ def _legacy_lp_obj(tokenizer, events, n_top: int) -> dict:
     }
 
 
+def _usage(prompt_ids, n_tokens: int) -> dict:
+    return {
+        "prompt_tokens": len(prompt_ids),
+        "completion_tokens": n_tokens,
+        "total_tokens": len(prompt_ids) + n_tokens,
+    }
+
+
 def _lp_entry(tokenizer, ev, n_top: int) -> dict:
     """One OpenAI chat-shape logprobs entry for a token event, with the
     alternatives sliced to the REQUESTED count (which may be zero even when
@@ -264,16 +272,20 @@ class EngineAPI:
 
     async def _openai_stream(
         self, prompt_ids, kwargs, stops, n_top: int, chat: bool,
-        object_name: str, completion_id: str,
+        object_name: str, completion_id: str, include_usage: bool = False,
     ) -> AsyncIterator[bytes]:
         # Per-token cost matters at 1800+ tok/s x 32 streams: fold the
         # stream-constant envelope once and splice only the delta/finish in.
         # ``created`` is stamped once per stream (OpenAI semantics: chunks of
         # one completion share a created time).
+        created = int(time.time())  # shared by EVERY chunk of this stream
+        # Per the OpenAI spec, when include_usage is on every non-final
+        # chunk carries "usage": null; the final chunk carries the totals.
+        tail = ', "usage": null}' if include_usage else "}"
         head = (
             'data: {"id": ' + json.dumps(completion_id)
             + ', "object": ' + json.dumps(object_name)
-            + f', "created": {int(time.time())}'
+            + f', "created": {created}'
             + ', "model": ' + json.dumps(self.model_name)
             + ', "choices": [{"index": 0, "delta": '
         )
@@ -281,16 +293,14 @@ class EngineAPI:
         def chunk(delta, finish):
             return (
                 head + json.dumps(delta) + ', "finish_reason": '
-                + json.dumps(finish) + "}]}\n\n"
+                + json.dumps(finish) + "}]" + tail + "\n\n"
             ).encode()
 
         content_head = head + '{"content": '
+        content_tail = '}, "finish_reason": null}]' + tail + "\n\n"
 
         def content_chunk(text):  # the hot path: one per decoded token
-            return (
-                content_head + json.dumps(text)
-                + '}, "finish_reason": null}]}\n\n'
-            ).encode()
+            return (content_head + json.dumps(text) + content_tail).encode()
 
         tok = self.engine.tokenizer
 
@@ -307,13 +317,16 @@ class EngineAPI:
             return (
                 head + json.dumps({"content": text})
                 + ', "logprobs": ' + json.dumps(lp_obj_of(events))
-                + ', "finish_reason": null}]}\n\n'
+                + ', "finish_reason": null}]' + tail + "\n\n"
             ).encode()
 
         finish_reason = "stop"
         first = True
+        n_tokens = 0
         pending_lp = []  # events for tokens whose text is still held
         async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
+            if ev is not None:
+                n_tokens += 1
             if first:
                 # OpenAI streams open with a role-only delta chunk; emitting
                 # it when the FIRST token lands (not at accept) also gives
@@ -339,10 +352,19 @@ class EngineAPI:
                 head + json.dumps({})
                 + ', "logprobs": ' + json.dumps(lp_obj_of(pending_lp))
                 + ', "finish_reason": ' + json.dumps(finish_reason)
-                + "}]}\n\n"
+                + "}]" + tail + "\n\n"
             ).encode()
         else:
             yield chunk({}, finish_reason)
+        if include_usage:
+            # OpenAI stream_options.include_usage: one final chunk with
+            # empty choices and the usage totals.
+            yield ("data: " + json.dumps({
+                "id": completion_id, "object": object_name,
+                "created": created, "model": self.model_name,
+                "choices": [],
+                "usage": _usage(prompt_ids, n_tokens),
+            }) + "\n\n").encode()
         yield b"data: [DONE]\n\n"
 
     async def _openai_complete(self, prompt_ids, kwargs, stops, n_top: int,
@@ -360,11 +382,7 @@ class EngineAPI:
             if finish is not None:
                 finish_reason = finish
         content = "".join(parts)
-        usage = {
-            "prompt_tokens": len(prompt_ids),
-            "completion_tokens": n_tokens,
-            "total_tokens": len(prompt_ids) + n_tokens,
-        }
+        usage = _usage(prompt_ids, n_tokens)
         tok = self.engine.tokenizer
         lp_requested = kwargs.get("logprobs", 0) > 0
         if chat:
@@ -454,6 +472,26 @@ class EngineAPI:
             return _json_response(
                 200, {"models": [{"name": self.model_name, "model": self.model_name}]}
             )
+        if method == "GET" and path == "/api/version":
+            return _json_response(200, {"version": "0.1.0-tpu"})
+
+        if method == "POST" and path == "/api/show":
+            # Minimal Ollama model-info surface (clients probe it before
+            # chatting); architecture details come from the model config.
+            m = self.engine.mcfg
+            return _json_response(200, {
+                "modelfile": "",
+                "details": {"family": m.name, "parameter_size": ""},
+                "model_info": {
+                    "general.architecture": m.name,
+                    "num_layers": m.n_layers,
+                    "num_heads": m.n_heads,
+                    "num_kv_heads": m.n_kv_heads,
+                    "embedding_dim": m.dim,
+                    "context_length": self.engine.ecfg.max_seq,
+                    "vocab_size": m.vocab_size,
+                },
+            })
 
         if method != "POST":
             return _error(405, f"method {method} not allowed on {path}")
@@ -469,6 +507,13 @@ class EngineAPI:
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")
             )
+            stream_opts = payload.get("stream_options")
+            if stream_opts is not None and not stream:
+                return _error(400, "stream_options requires stream to be true")
+            include_usage = bool(
+                isinstance(stream_opts, dict)
+                and stream_opts.get("include_usage")
+            )
 
             if path == "/v1/chat/completions":
                 messages = payload.get("messages")
@@ -480,7 +525,7 @@ class EngineAPI:
                     cid = f"chatcmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
                         prompt_ids, kwargs, stops, n_top, True,
-                        "chat.completion.chunk", cid,
+                        "chat.completion.chunk", cid, include_usage,
                     )
                 return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=True)
 
@@ -494,7 +539,7 @@ class EngineAPI:
                     cid = f"cmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
                         prompt_ids, kwargs, stops, n_top, False,
-                        "text_completion.chunk", cid,
+                        "text_completion.chunk", cid, include_usage,
                     )
                 return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=False)
 

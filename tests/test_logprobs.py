@@ -182,6 +182,58 @@ def test_legacy_stream_logprobs_shape():
         assert len(lp["tokens"]) == len(lp["token_logprobs"])
 
 
+def test_stream_usage_and_ollama_info_routes():
+    """stream_options.include_usage appends a usage chunk; /api/show and
+    /api/version answer Ollama client probes."""
+    eng = _engine()
+    api = EngineAPI(eng, "tiny")
+
+    async def run():
+        await eng.start()
+        req = RequestHeaders(1, "POST", "/v1/chat/completions", {})
+        body = json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "ignore_eos": True, "stream": True,
+            "stream_options": {"include_usage": True},
+        }).encode()
+        _, _, chunks = await api.handle(req, body)
+        usage = None
+        created_vals = set()
+        async for chunk in chunks:
+            for event in chunk.decode().split("\n\n"):
+                if event.startswith("data: ") and event != "data: [DONE]":
+                    payload = json.loads(event[6:])
+                    created_vals.add(payload["created"])
+                    # Spec: with include_usage, every chunk carries the
+                    # usage key — null until the final totals chunk.
+                    assert "usage" in payload
+                    if payload.get("usage"):
+                        usage = payload["usage"]
+                        assert payload["choices"] == []
+        assert len(created_vals) == 1  # one shared created per stream
+        bad = json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "stream_options": {"include_usage": True},  # without stream
+        }).encode()
+        bad_status, _, _ = await api.handle(req, bad)
+        assert bad_status == 400
+        show_status, _, show_chunks = await api.handle(
+            RequestHeaders(2, "POST", "/api/show", {}), b"{}"
+        )
+        show = json.loads([c async for c in show_chunks][0])
+        ver_status, _, _ = await api.handle(
+            RequestHeaders(3, "GET", "/api/version", {}), b""
+        )
+        await eng.stop()
+        return usage, show_status, show, ver_status
+
+    usage, show_status, show, ver_status = asyncio.run(run())
+    assert usage["completion_tokens"] == 3
+    assert usage["total_tokens"] == usage["prompt_tokens"] + 3
+    assert show_status == 200 and show["model_info"]["num_layers"] > 0
+    assert ver_status == 200
+
+
 def test_stream_logprobs_entries():
     eng = _engine()
     api = EngineAPI(eng, "tiny")
