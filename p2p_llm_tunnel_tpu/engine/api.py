@@ -164,8 +164,16 @@ class EngineAPI:
         if max_tokens is None:
             max_tokens = body.get("max_new_tokens")
         max_tokens = 64 if max_tokens is None else int(max_tokens)
+        # max_tokens=0 is the pure-scoring form (lm-eval-harness style
+        # loglikelihood: prompt + echo + logprobs, no generation); the
+        # engine still samples one throwaway token, the response omits it.
+        score_only = max_tokens == 0 and bool(body.get("echo"))
+        if score_only:
+            max_tokens = 1
         if max_tokens < 1:
-            raise ValueError("max_tokens must be >= 1")
+            raise ValueError(
+                "max_tokens must be >= 1 (0 is allowed only with echo)"
+            )
         temperature = float(body.get("temperature") or 0.0)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
@@ -198,6 +206,7 @@ class EngineAPI:
         # Engine gate: >=1 enables the device-side logprob computation; the
         # RESPONSE slices alternatives to n_top (possibly zero).
         n_lp = max(1, n_top) if lp_on else 0
+        echo = bool(body.get("echo"))
         kwargs = dict(
             max_new_tokens=max_tokens,
             temperature=temperature,
@@ -209,7 +218,7 @@ class EngineAPI:
         )
         if body.get("ignore_eos"):  # vLLM-style benchmarking knob
             kwargs["stop_ids"] = ()
-        return kwargs, n_top
+        return kwargs, n_top, echo, score_only
 
     @staticmethod
     def _stop_strings(body: dict) -> list:
@@ -368,19 +377,28 @@ class EngineAPI:
         yield b"data: [DONE]\n\n"
 
     async def _openai_complete(self, prompt_ids, kwargs, stops, n_top: int,
-                               chat: bool):
+                               chat: bool, echo: bool = False,
+                               score_only: bool = False):
         parts = []
         finish_reason = "stop"
         n_tokens = 0
         lp_entries = []
+        prompt_lps = None
         async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             n_tokens += 1
             if text:
                 parts.append(text)
             if ev is not None and ev.logprob is not None:
                 lp_entries.append(ev)
+            if ev is not None and ev.prompt_logprobs is not None:
+                prompt_lps = ev.prompt_logprobs
             if finish is not None:
                 finish_reason = finish
+        if score_only:
+            # Pure scoring (max_tokens=0 + echo): the single sampled token
+            # exists only to drive the engine; the response omits it.
+            parts, lp_entries, n_tokens = [], [], 0
+            finish_reason = "length"
         content = "".join(parts)
         usage = _usage(prompt_ids, n_tokens)
         tok = self.engine.tokenizer
@@ -399,9 +417,26 @@ class EngineAPI:
                 ]}
             obj_name = "chat.completion"
         else:
+            if echo:
+                # Legacy echo: the response text begins with the prompt.
+                content = tok.decode(list(prompt_ids)) + content
             choice = {"index": 0, "text": content, "finish_reason": finish_reason}
             if lp_requested:
-                choice["logprobs"] = _legacy_lp_obj(tok, lp_entries, n_top)
+                lp_obj = _legacy_lp_obj(tok, lp_entries, n_top)
+                if echo and prompt_lps is not None:
+                    # Prepend the prompt tokens' scores: the first prompt
+                    # token has no context -> null, matching OpenAI; no
+                    # alternatives are reported for prompt positions.
+                    lp_obj = {
+                        "tokens": [tok.decode_token(t) for t in prompt_ids]
+                        + lp_obj["tokens"],
+                        "token_logprobs": [None] + [
+                            float(x) for x in prompt_lps[1:]
+                        ] + lp_obj["token_logprobs"],
+                        "top_logprobs": [None] * len(prompt_ids)
+                        + lp_obj["top_logprobs"],
+                    }
+                choice["logprobs"] = lp_obj
             obj_name = "text_completion"
         return _json_response(
             200,
@@ -502,7 +537,7 @@ class EngineAPI:
             return _error(400, f"invalid JSON body: {e}")
 
         try:
-            kwargs, n_top = self._gen_kwargs(payload)
+            kwargs, n_top, echo, score_only = self._gen_kwargs(payload)
             stops = self._stop_strings(payload)
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")
@@ -516,6 +551,8 @@ class EngineAPI:
             )
 
             if path == "/v1/chat/completions":
+                if echo:
+                    return _error(400, "echo is only supported on /v1/completions")
                 messages = payload.get("messages")
                 if not isinstance(messages, list):
                     return _error(400, "messages must be a list")
@@ -536,12 +573,26 @@ class EngineAPI:
                 prompt_ids = self.engine.tokenizer.encode(str(prompt))
                 self._check_prompt(prompt_ids)
                 if stream:
+                    if echo:
+                        return _error(
+                            400, "echo is not supported with stream=true"
+                        )
                     cid = f"cmpl-{int(time.time() * 1000)}"
                     return 200, dict(_SSE), self._openai_stream(
                         prompt_ids, kwargs, stops, n_top, False,
                         "text_completion.chunk", cid, include_usage,
                     )
-                return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=False)
+                if echo:
+                    # Engage the engine's scoring path only where its output
+                    # is consumed (an /api/* body carrying "echo" must not
+                    # silently trigger the expensive full-prompt variant).
+                    kwargs = dict(
+                        kwargs, echo_logprobs=kwargs["logprobs"] > 0,
+                    )
+                return await self._openai_complete(
+                    prompt_ids, kwargs, stops, n_top, chat=False, echo=echo,
+                    score_only=score_only,
+                )
 
             if path == "/api/generate":
                 prompt_ids = self.engine.tokenizer.encode(str(payload.get("prompt", "")))

@@ -130,6 +130,10 @@ class TokenEvent:
     # model distribution, plus the top-N (id, logprob) alternatives.
     logprob: Optional[float] = None
     top_logprobs: Optional[List[Tuple[int, float]]] = None
+    # Echo/scoring path (legacy completions): per-PROMPT-token logprobs,
+    # attached once on the request's FIRST event (entry 0 has no context
+    # and is reported as None by the API).
+    prompt_logprobs: Optional[List[float]] = None
 
 
 @dataclass
@@ -330,7 +334,7 @@ class InferenceEngine:
             static_argnums=(10, 11),
         )
         self._jit_prefill = jax.jit(
-            self._prefill_fn, donate_argnums=(1,), static_argnums=()
+            self._prefill_fn, donate_argnums=(1,), static_argnums=(7,)
         )
         self._jit_chunk_prefill = jax.jit(
             self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=()
@@ -417,17 +421,32 @@ class InferenceEngine:
         )
         return toks.T, lp_out, tokens, positions, counts, kv_cache  # [B, k]
 
-    def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp, key):
-        last_logits, kv_cache = prefill_into_cache(
-            self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
-            mesh=self.mesh,
-        )
+    def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp,
+                    key, echo=False):
+        """Plain prefill; ``echo`` (STATIC) additionally returns per-prompt-
+        token logprobs — the scoring path of the legacy completions API,
+        compiled on first use (an explicitly-requested eval feature, not
+        the serving default).  One body serves both compiled variants so
+        the sampling/logprob handling cannot drift between them."""
+        prompt_lps = None
+        if echo:
+            last_logits, kv_cache, prompt_lps = prefill_into_cache(
+                self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
+                mesh=self.mesh, return_prompt_logprobs=True,
+            )
+        else:
+            last_logits, kv_cache = prefill_into_cache(
+                self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
+                mesh=self.mesh,
+            )
         first = sampling.sample(last_logits, samp, key)
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
             lambda: sampling.logprob_data(last_logits, first),
             lambda: sampling.empty_logprob_data(first.shape[0]),
         )
+        if echo:
+            return first, lp, prompt_lps, kv_cache
         return first, lp, kv_cache
 
     def _chunk_prefill_fn(
@@ -559,6 +578,7 @@ class InferenceEngine:
         freq_pen: float = 0.0,
         pres_pen: float = 0.0,
         logprobs: int = 0,
+        echo_logprobs: bool = False,
         stop_ids: Optional[Tuple[int, ...]] = None,
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes."""
@@ -576,6 +596,7 @@ class InferenceEngine:
             freq_pen=freq_pen,
             pres_pen=pres_pen,
             logprobs=logprobs,
+            echo_logprobs=echo_logprobs,
             stop_ids=tuple(stop_ids),
         )
         state = _ActiveRequest(
@@ -602,7 +623,7 @@ class InferenceEngine:
     # -- engine loop ------------------------------------------------------
 
     def _emit(self, run: RunningSlot, token_id: int, evicted: bool,
-              lp_info=None) -> None:
+              lp_info=None, prompt_lps=None) -> None:
         rid = run.request.request_id
         state = self._requests.get(rid)
         if state is None:
@@ -628,7 +649,7 @@ class InferenceEngine:
             n = min(run.request.logprobs, len(top_ids))
             tops = [(int(top_ids[j]), float(top_lps[j])) for j in range(n)]
         state.queue.put_nowait(
-            TokenEvent(token_id, text, finish, logprob, tops)
+            TokenEvent(token_id, text, finish, logprob, tops, prompt_lps)
         )
 
     def _next_key(self) -> jax.Array:
@@ -644,6 +665,7 @@ class InferenceEngine:
     def _dispatch_prefill_batch(
         self, runs: List[RunningSlot], t: int,
         hists: Optional[List[int]] = None,
+        echo: bool = False,
     ):
         """Non-blocking: dispatch one bucket of admitted prompts as ONE XLA
         call; returns the on-device first-token array WITHOUT fetching it.
@@ -697,17 +719,30 @@ class InferenceEngine:
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
         )
-        first, lp, self.kv_cache = self._jit_prefill(
-            self.params,
-            self.kv_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
-            jnp.asarray(slots),
-            samp,
-            self._next_key(),
-        )
+        if echo:
+            first, lp, plp, self.kv_cache = self._jit_prefill(
+                self.params,
+                self.kv_cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(slots),
+                samp,
+                self._next_key(),
+                True,
+            )
+        else:
+            plp = None
+            first, lp, self.kv_cache = self._jit_prefill(
+                self.params,
+                self.kv_cache,
+                jnp.asarray(tokens),
+                jnp.asarray(lengths),
+                jnp.asarray(slots),
+                samp,
+                self._next_key(),
+            )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, (lp if lps.any() else None)
+        return first, (lp if lps.any() else None), plp
 
     def _dispatch_chunk_rows(self, rows, t: int):
         """Pack rows of ``(run, start, segment_ids, sample?)`` into ONE
@@ -758,7 +793,7 @@ class InferenceEngine:
             self._next_key(),
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, (lp if lps.any() else None)
+        return first, (lp if lps.any() else None), None
 
     def _view_buckets(self) -> List[int]:
         """The full set of kv-view buckets this engine can ever dispatch:
@@ -907,7 +942,8 @@ class InferenceEngine:
         # in at the next dispatch.
         self._ov_mask[i] = True
 
-    def _account_token(self, slot: int, tok: int, lp_info=None) -> None:
+    def _account_token(self, slot: int, tok: int, lp_info=None,
+                       prompt_lps=None) -> None:
         """Record one generated token: scheduler accounting, slot-state
         update for the next decode call, eviction, emission."""
         out = self.scheduler.record_token(slot, tok)
@@ -919,7 +955,7 @@ class InferenceEngine:
             # The generated token's own position: it is written to the cache
             # by the decode step that consumes it.
             self._positions[slot] = out.cache_len - 1
-        self._emit(out, tok, evicted, lp_info)
+        self._emit(out, tok, evicted, lp_info, prompt_lps)
 
     def _prefix_copy_in(self, run: RunningSlot, pool_ids: List[int]) -> None:
         """Copy matched pool blocks into the run's slot (executor thread)."""
@@ -982,7 +1018,10 @@ class InferenceEngine:
         pool_ids_of: Dict[int, List[int]] = {}
         for run in admitted:
             hist = 0
-            if self._prefix is not None:
+            # Echo/scoring requests need logits for EVERY prompt position:
+            # prefix reuse and segmentation would skip computing them, so
+            # they always take the whole-prompt plain path.
+            if self._prefix is not None and not run.request.echo_logprobs:
                 hist, ids = self._prefix.match(run.request.prompt_ids)
                 if hist:
                     pool_ids_of[run.slot] = ids
@@ -996,6 +1035,8 @@ class InferenceEngine:
         # history length.)
         if self.ecfg.prefill_chunk > 0:
             for run in list(admitted):
+                if run.request.echo_logprobs:
+                    continue  # echo: whole-prompt prefill only (see above)
                 hist = hist_of[run.slot]
                 if len(run.request.prompt_ids) - hist > self.ecfg.prefill_chunk:
                     if hist:
@@ -1012,7 +1053,7 @@ class InferenceEngine:
         # program, whose bucket is the tail length.  A matched prefix whose
         # tail exceeds every compiled chunk bucket is dropped back to the
         # plain path — NEVER cold-compile on the serving path.
-        groups: Dict[Tuple[int, bool], List[RunningSlot]] = {}
+        groups: Dict[Tuple[int, bool, bool], List[RunningSlot]] = {}
         for run in admitted:
             hist = hist_of[run.slot]
             if hist and (
@@ -1022,14 +1063,15 @@ class InferenceEngine:
             if hist:
                 global_metrics.inc("engine_prefix_hit_tokens_total", hist)
             t = self._bucket(len(run.request.prompt_ids) - hist)
-            groups.setdefault((t, hist > 0), []).append(run)
-        chunked: List[Tuple[int, bool, List[RunningSlot]]] = []
+            echo = bool(run.request.echo_logprobs)
+            groups.setdefault((t, hist > 0, echo), []).append(run)
+        chunked: List[Tuple[int, bool, bool, List[RunningSlot]]] = []
         pr = self.ecfg.prefill_rows
-        for (t, cached), runs in sorted(groups.items()):
+        for (t, cached, echo), runs in sorted(groups.items()):
             for i in range(0, len(runs), pr):
-                chunked.append((t, cached, runs[i : i + pr]))
+                chunked.append((t, cached, echo, runs[i : i + pr]))
         dispatched = []
-        for t, cached, runs in chunked:
+        for t, cached, echo, runs in chunked:
             t0 = time.monotonic()
             if cached:
                 for run in runs:
@@ -1039,12 +1081,13 @@ class InferenceEngine:
                     )
             hists = [hist_of[r.slot] for r in runs] if cached else None
             first_dev = await loop.run_in_executor(
-                self._executor, self._dispatch_prefill_batch, runs, t, hists
+                self._executor, self._dispatch_prefill_batch, runs, t, hists,
+                echo,
             )
             dispatched.append((runs, first_dev, t0))
         inserts: List[RunningSlot] = []
         for runs, first_dev, t0 in dispatched:
-            firsts, lp = await loop.run_in_executor(
+            firsts, lp, plp = await loop.run_in_executor(
                 self._executor,
                 lambda fd=first_dev: jax.tree.map(np.asarray,
                                                   jax.device_get(fd)),
@@ -1061,7 +1104,11 @@ class InferenceEngine:
                     continue
                 self._admit_one(run)
                 lp_row = None if lp is None else (lp[0][i], lp[1][i], lp[2][i])
-                self._account_token(run.slot, int(first), lp_row)
+                plp_row = None
+                if plp is not None:
+                    n = len(run.request.prompt_ids)
+                    plp_row = [float(x) for x in plp[i][:n]]
+                self._account_token(run.slot, int(first), lp_row, plp_row)
                 if self._prefix is not None:
                     inserts.append(run)
         # Pool inserts run after EVERY first token of the wave is out —
@@ -1117,7 +1164,7 @@ class InferenceEngine:
     async def _finish_segments(self, loop, seg) -> None:
         """Fetch a segment dispatch's sampled block; activate final rows."""
         rows, first_dev = seg
-        firsts, lp = await loop.run_in_executor(
+        firsts, lp, _plp = await loop.run_in_executor(
             self._executor,
             lambda: jax.tree.map(np.asarray, jax.device_get(first_dev)),
         )

@@ -27,6 +27,9 @@ class GenRequest:
     freq_pen: float = 0.0  # OpenAI frequency_penalty over generated tokens
     pres_pen: float = 0.0  # OpenAI presence_penalty over generated tokens
     logprobs: int = 0  # top_logprobs to report per token (0 = off)
+    # Echo/scoring: compute per-prompt-token logprobs during prefill
+    # (forces the whole-prompt plain prefill path).
+    echo_logprobs: bool = False
     stop_ids: tuple = ()
 
     def __post_init__(self) -> None:

@@ -384,12 +384,20 @@ def prefill_into_cache(
     kv_cache: KVCache,
     slots: jnp.ndarray,  # [Bp] cache slot per prompt
     mesh=None,
-) -> Tuple[jnp.ndarray, KVCache]:
+    return_prompt_logprobs: bool = False,
+):
     """Prefill prompts and scatter their KV into cache slots.
 
     Returns last-real-token logits [Bp, V] and the updated cache.  Positions
     past a prompt's length hold junk KV, but decode overwrites position
     ``length + n`` before it ever becomes attendable, so junk is never read.
+
+    With ``return_prompt_logprobs`` (a STATIC flag; the echo/scoring path of
+    the legacy completions API) additionally returns ``[Bp, T]`` log-probs
+    of each prompt token given its prefix — entry ``t`` scores
+    ``tokens[:, t]`` under the logits at position ``t-1``; entry 0 is 0.0
+    (no context) and entries past a prompt's length are junk the caller
+    masks by ``lengths``.
     """
     b, t = tokens.shape
     valid = jnp.arange(t)[None, :] < lengths[:, None]
@@ -397,6 +405,16 @@ def prefill_into_cache(
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None], axis=1
     )[:, 0]  # [Bp, V]
+    prompt_lps = None
+    if return_prompt_logprobs:
+        lsm = jax.nn.log_softmax(logits[:, :-1], axis=-1)  # [Bp, T-1, V]
+        scored = jnp.take_along_axis(
+            lsm, tokens[:, 1:, None], axis=-1
+        )[..., 0]  # lp of token t given prefix, t = 1..T-1
+        prompt_lps = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.float32), scored.astype(jnp.float32)],
+            axis=1,
+        )
 
     # [L,Bp,T,K,D] → scatter over slot axis of [L,Slots,S,K,D]
     s_max = kv_cache["k"].shape[2]
@@ -414,6 +432,8 @@ def prefill_into_cache(
     else:
         out["k"] = kv_cache["k"].at[:, slots, :t_w].set(ks)
         out["v"] = kv_cache["v"].at[:, slots, :t_w].set(vs)
+    if return_prompt_logprobs:
+        return last, out, prompt_lps
     return last, out
 
 
