@@ -10,9 +10,10 @@ Surfaces (BASELINE.md configs):
   (stream + non-stream; temperature/top_k/top_p, frequency_penalty/
   presence_penalty over generated tokens, string `stop` sequences with
   boundary-safe matching, logprobs/top_logprobs — chat shape + legacy
-  completions shape — and ignore_eos)
-- Ollama: GET /api/tags, POST /api/generate, POST /api/chat
-  (NDJSON streaming; options.stop)
+  completions shape — stream_options.include_usage, legacy `echo` with
+  prompt logprobs incl. max_tokens=0 pure scoring, and ignore_eos)
+- Ollama: GET /api/tags, /api/version, POST /api/show, /api/generate,
+  /api/chat (NDJSON streaming; options.stop)
 - GET /health
 
 SSE chunk shape matches the conformance fixture tmp/mock_llm.py:36-88.
