@@ -31,8 +31,12 @@ def _greedy_margins(cfg, params, prompt, toks):
         decode_step, init_kv_cache, prefill_into_cache,
     )
 
-    cache = init_kv_cache(cfg, 1, 64, jnp.float32)
-    t = 32
+    t = 16
+    while t < len(prompt):
+        t *= 2
+    cache = init_kv_cache(
+        cfg, 1, max(64, t + len(toks) + 1), jnp.float32
+    )
     tokens = jnp.zeros((1, t), jnp.int32).at[0, : len(prompt)].set(
         jnp.array(prompt)
     )
@@ -146,3 +150,54 @@ def test_tp_engine_with_checkpoint(tmp_path, cpu_devices):
     )
     toks = _collect(eng, list(b"ckpt"), 4)
     assert len(toks) == 4
+
+
+def test_tp_engine_with_prefix_cache_and_chunked_prefill(cpu_devices):
+    """Prefix caching + chunked prefill under a tp=2 mesh: the sharded pool
+    copy ops and the chunk-attention einsum partition under GSPMD, and
+    repeat prompts produce the same tokens as the no-cache tp engine."""
+    cfg = get_config("tiny", n_heads=8, n_kv_heads=2, vocab_size=512)
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    prompt = list(b"shared prefix for the tensor parallel pool test ") * 2
+
+    def build(prefix_cache):
+        return InferenceEngine(
+            model_cfg=cfg,
+            engine_cfg=EngineConfig(
+                model="tiny", num_slots=2, max_seq=256, dtype="float32",
+                decode_steps=4, tp=2, min_prefill_bucket=16,
+                prefix_cache=prefix_cache, prefix_pool_blocks=16,
+                prefill_chunk=32,
+            ),
+            params=params,
+        )
+
+    async def run(eng):
+        await eng.start()
+        outs = []
+        for tail in (b"one", b"two"):
+            toks = []
+            async for ev in eng.generate(prompt + list(tail),
+                                         max_new_tokens=6, stop_ids=()):
+                toks.append(ev.token_id)
+            outs.append(toks)
+        await eng.stop()
+        return outs
+
+    plain = asyncio.run(asyncio.wait_for(run(build(False)), 180))
+    cached = asyncio.run(asyncio.wait_for(run(build(True)), 180))
+    for tail, p_toks, c_toks in zip((b"one", b"two"), plain, cached):
+        if p_toks == c_toks:
+            continue
+        # Same fp-near-tie tolerance as test_tp_engine_matches_single_chip:
+        # the cache-hit admission runs a differently-shaped compiled program
+        # (pool restore + tail) whose reductions may reassociate; only a
+        # divergence at a DECISIVE margin is a real failure.
+        div = next(
+            i for i, (a, b) in enumerate(zip(p_toks, c_toks)) if a != b
+        )
+        margins = _greedy_margins(cfg, params, prompt + list(tail), p_toks)
+        assert margins[div] < 1e-3, (
+            f"prefix-cache tp diverged at step {div} with decisive margin "
+            f"{margins[div]:.6f}: {p_toks} vs {c_toks}"
+        )
