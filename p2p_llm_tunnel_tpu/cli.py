@@ -268,6 +268,16 @@ async def _engine_backend(args):
 
     import jax
 
+    # TUNNEL_JAX_PLATFORM pins the backend (e.g. "cpu") BEFORE any device
+    # op.  The axon PJRT plugin force-registers the tunneled TPU in every
+    # process and wins over the JAX_PLATFORMS env var, so when the chip
+    # tunnel is wedged (it hangs any process on first device op) this is
+    # the only way to serve from CPU — jax.config is the one override the
+    # plugin respects (same mechanism as tests/conftest.py).
+    forced = os.environ.get("TUNNEL_JAX_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
     mesh = None
     if args.coordinator:
         # Multi-host: join the runtime FIRST (jax.devices() becomes global),

@@ -292,12 +292,17 @@ class EngineAPI:
         # Per the OpenAI spec, when include_usage is on every non-final
         # chunk carries "usage": null; the final chunk carries the totals.
         tail = ', "usage": null}' if include_usage else "}"
+        # Chunk grammar per endpoint family (ADVICE r4: legacy completion
+        # streams must carry choices[].text — object "text_completion" —
+        # not chat-style delta objects, or OpenAI-SDK clients reading
+        # .choices[0].text get nothing).
         head = (
             'data: {"id": ' + json.dumps(completion_id)
             + ', "object": ' + json.dumps(object_name)
             + f', "created": {created}'
             + ', "model": ' + json.dumps(self.model_name)
-            + ', "choices": [{"index": 0, "delta": '
+            + ', "choices": [{"index": 0, '
+            + ('"delta": ' if chat else '"text": ')
         )
 
         def chunk(delta, finish):
@@ -306,11 +311,22 @@ class EngineAPI:
                 + json.dumps(finish) + "}]" + tail + "\n\n"
             ).encode()
 
-        content_head = head + '{"content": '
-        content_tail = '}, "finish_reason": null}]' + tail + "\n\n"
+        content_head = head + ('{"content": ' if chat else "")
+        content_tail = (
+            ('}' if chat else ', "logprobs": null')
+            + ', "finish_reason": null}]' + tail + "\n\n"
+        )
 
         def content_chunk(text):  # the hot path: one per decoded token
             return (content_head + json.dumps(text) + content_tail).encode()
+
+        def legacy_chunk(text, lp_obj, finish):
+            return (
+                head + json.dumps(text)
+                + ', "logprobs": ' + json.dumps(lp_obj)
+                + ', "finish_reason": ' + json.dumps(finish)
+                + "}]" + tail + "\n\n"
+            ).encode()
 
         tok = self.engine.tokenizer
 
@@ -337,18 +353,20 @@ class EngineAPI:
         async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
             if ev is not None:
                 n_tokens += 1
-            if first:
-                # OpenAI streams open with a role-only delta chunk; emitting
-                # it when the FIRST token lands (not at accept) also gives
-                # clients an honest time-to-first-token signal even when the
-                # token's text is empty (mid-codepoint byte, special id).
+            if first and chat:
+                # OpenAI chat streams open with a role-only delta chunk;
+                # emitting it when the FIRST token lands (not at accept)
+                # also gives clients an honest time-to-first-token signal
+                # even when the token's text is empty (mid-codepoint byte,
+                # special id).  Legacy streams have no role chunk.
                 yield chunk({"role": "assistant"}, None)
-                first = False
+            first = False
             if ev is not None and ev.logprob is not None:
                 pending_lp.append(ev)
             if text:
                 if pending_lp:
-                    yield lp_chunk(text, pending_lp)
+                    yield lp_chunk(text, pending_lp) if chat else \
+                        legacy_chunk(text, lp_obj_of(pending_lp), None)
                     pending_lp = []
                 else:
                     yield content_chunk(text)
@@ -358,14 +376,18 @@ class EngineAPI:
             # Entries whose text never emitted (mid-codepoint final byte,
             # zero-text stop): attach them to the final chunk so stream and
             # non-stream logprob counts agree.
-            yield (
-                head + json.dumps({})
-                + ', "logprobs": ' + json.dumps(lp_obj_of(pending_lp))
-                + ', "finish_reason": ' + json.dumps(finish_reason)
-                + "}]" + tail + "\n\n"
-            ).encode()
+            if chat:
+                yield (
+                    head + json.dumps({})
+                    + ', "logprobs": ' + json.dumps(lp_obj_of(pending_lp))
+                    + ', "finish_reason": ' + json.dumps(finish_reason)
+                    + "}]" + tail + "\n\n"
+                ).encode()
+            else:
+                yield legacy_chunk("", lp_obj_of(pending_lp), finish_reason)
         else:
-            yield chunk({}, finish_reason)
+            yield chunk({}, finish_reason) if chat else \
+                legacy_chunk("", None, finish_reason)
         if include_usage:
             # OpenAI stream_options.include_usage: one final chunk with
             # empty choices and the usage totals.
@@ -579,9 +601,11 @@ class EngineAPI:
                             400, "echo is not supported with stream=true"
                         )
                     cid = f"cmpl-{int(time.time() * 1000)}"
+                    # OpenAI legacy streams keep object "text_completion"
+                    # (there is no ".chunk" variant in the legacy spec).
                     return 200, dict(_SSE), self._openai_stream(
                         prompt_ids, kwargs, stops, n_top, False,
-                        "text_completion.chunk", cid, include_usage,
+                        "text_completion", cid, include_usage,
                     )
                 if echo:
                     # Engage the engine's scoring path only where its output

@@ -34,11 +34,13 @@ insert that might recycle the matched block.
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
@@ -48,9 +50,16 @@ log = get_logger(__name__)
 class PrefixIndex:
     """Host-side chain-hash index: block content -> pool slot, with LRU.
 
-    A block's key is ``hash((parent_key, block_tokens))`` so equal token
-    windows at different offsets/contexts never collide: block i's key
-    commits to the ENTIRE prefix [0, (i+1)*block).
+    A block's key is ``blake2b(parent_digest || block_token_bytes)`` so
+    equal token windows at different offsets/contexts never collide: block
+    i's key commits to the ENTIRE prefix [0, (i+1)*block).
+
+    The digest is cryptographic ON PURPOSE (ADVICE r4): Python's builtin
+    tuple/int hash is an invertible algebraic mix, so two different
+    prefixes can share a key by adversarial construction — and a collision
+    here silently serves one request KV computed from another request's
+    content.  vLLM moved its prefix keys from builtin hash to sha256 for
+    the same reason; a 16-byte blake2b costs ~1 us per block.
     """
 
     def __init__(self, block: int, capacity: int):
@@ -58,16 +67,19 @@ class PrefixIndex:
         self.block = block
         # Pool index 0 is the scratch block (insert-padding target).
         self._free: List[int] = list(range(1, capacity))
-        self._lru: "OrderedDict[int, int]" = OrderedDict()  # key -> pool idx
+        self._lru: "OrderedDict[bytes, int]" = OrderedDict()  # key -> pool idx
         self.hits = 0
         self.lookups = 0
 
-    def _keys_of(self, prompt_ids) -> List[int]:
+    def _keys_of(self, prompt_ids) -> List[bytes]:
         keys = []
-        h = 0
+        h = b""
         b = self.block
         for i in range(len(prompt_ids) // b):
-            h = hash((h, tuple(prompt_ids[i * b : (i + 1) * b])))
+            window = np.asarray(prompt_ids[i * b : (i + 1) * b], np.int64)
+            h = hashlib.blake2b(
+                h + window.tobytes(), digest_size=16
+            ).digest()
             keys.append(h)
         return keys
 
@@ -90,7 +102,7 @@ class PrefixIndex:
             self.hits += 1
         return len(ids) * self.block, ids
 
-    def missing(self, prompt_ids) -> List[Tuple[int, int]]:
+    def missing(self, prompt_ids) -> List[Tuple[int, bytes]]:
         """Fully-covered prompt blocks not yet pooled: [(block_no, key)]."""
         return [
             (i, key)
@@ -98,7 +110,7 @@ class PrefixIndex:
             if key not in self._lru
         ]
 
-    def allocate(self, keys: List[int]) -> List[int]:
+    def allocate(self, keys: List[bytes]) -> List[int]:
         """Assign a pool slot per key (evicting LRU as needed); the caller
         must then actually copy the block content in.
 

@@ -14,8 +14,11 @@ import jax.numpy as jnp
 
 
 #: Static top-alternatives width for logprob reporting: requests may ask
-#: for 0..CAP top_logprobs; one compiled shape serves them all.
-TOP_LOGPROBS_CAP = 8
+#: for 0..CAP top_logprobs; one compiled shape serves them all.  20 matches
+#: the OpenAI chat spec's top_logprobs upper bound (ADVICE r4: the old cap
+#: of 8 rejected valid requests for 9..20); per-step cost is a [B, 20]
+#: top_k + transfer, negligible next to the [B, V] logits it reads.
+TOP_LOGPROBS_CAP = 20
 
 
 class SamplingParams(NamedTuple):

@@ -176,7 +176,31 @@ def main() -> None:
             f.write(json.dumps(row) + "\n")
         print(json.dumps(row), flush=True)
 
+    # Chip windows are scarce (r4: one 6-minute window in a whole session).
+    # SWEEP_SKIP_DONE=1 makes a re-launched sweep resume where the last
+    # chip window left off: labels that already produced an error-free row
+    # are skipped.  Only rows WITH a ts field count — pre-r5 rows in the
+    # accumulated jsonl predate the current methodology.
+    done_labels: set = set()
+    if os.environ.get("SWEEP_SKIP_DONE") == "1" and os.path.exists(out_path):
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                # A CPU-fallback (no_tpu) row never banks a TPU config:
+                # otherwise one leaked BENCH_FORCE_CPU run would make every
+                # later chip window skip the label, freezing a CPU number
+                # as the config's final artifact.
+                if (r.get("ts") and not r.get("error") and "value" in r
+                        and (not require_tpu or not r.get("no_tpu"))):
+                    done_labels.add(r.get("sweep_label"))
+
     for label, overrides in GRID:
+        if label in done_labels:
+            print(f"skip {label}: already banked", file=sys.stderr)
+            continue
         remaining = budget - (time.monotonic() - t0)
         if remaining < 90:
             print(f"budget exhausted before {label}", file=sys.stderr)

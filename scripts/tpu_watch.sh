@@ -1,26 +1,77 @@
 #!/usr/bin/env bash
 # Watch for the tunneled TPU to come back, then run the perf sweep.
 #
-# The axon device tunnel wedges intermittently (it died mid-round in r4's
-# first session and again at ~04:52 in the second); this watcher probes with
-# a short-timeout subprocess every PROBE_INTERVAL seconds and launches
-# scripts/perf_sweep.py once a real matmul succeeds.  Probe subprocesses are
-# disposable — a hung probe is killed by `timeout`, never wedging the
-# watcher itself.
+# The axon device tunnel wedges intermittently and stays down for hours
+# (r4: one 6-minute window in a whole session); this watcher probes with a
+# short-timeout subprocess every PROBE_INTERVAL seconds and launches
+# scripts/perf_sweep.py the moment a real matmul succeeds.  Probe
+# subprocesses are disposable — a hung probe is killed by `timeout`, never
+# wedging the watcher itself.
+#
+# Design for scarce chip minutes:
+# - The sweep runs from a WORKTREE SNAPSHOT of HEAD taken when the chip
+#   comes back, so ongoing commits to the main tree can't change the code
+#   mid-sweep and break config comparability.  The snapshot shares the
+#   persistent JAX compile cache (JAX_CC_DIR) with the main tree.
+# - Results append to the MAIN tree's PERF_SWEEP.jsonl.
+# - SWEEP_SKIP_DONE=1: if the chip wedges mid-sweep and returns later, the
+#   next launch skips configs that already banked an error-free row.
+# - The watcher keeps looping until every sweep exit shows no chip_gone in
+#   its final row (i.e. the grid actually completed or the budget ran out
+#   with the chip alive).
 set -u
 cd "$(dirname "$0")/.."
+REPO="$(pwd)"
 PROBE_INTERVAL="${PROBE_INTERVAL:-120}"
 MARKER="${MARKER:-/tmp/tpu_back.marker}"
+WT="$REPO/.sweep_wt"
 rm -f "$MARKER"
-while true; do
-  if timeout 90 python -c "
+
+probe() {
+  timeout 90 python -c "
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
 x = jnp.ones((128, 128)); (x @ x).block_until_ready()
-" >/dev/null 2>&1; then
-    echo "$(date -u +%H:%M:%S) TPU back — launching sweep" >&2
+" >/dev/null 2>&1
+}
+
+while true; do
+  if probe; then
+    echo "$(date -u +%H:%M:%S) TPU back — snapshotting HEAD and launching sweep" >&2
     touch "$MARKER"
-    exec python scripts/perf_sweep.py
+    git worktree remove --force "$WT" 2>/dev/null || true
+    if ! git worktree add --detach "$WT" HEAD >/dev/null 2>&1; then
+      # Stale registration / held index.lock: running from the live tree
+      # would break the snapshot's comparability guarantee — retry instead.
+      echo "$(date -u +%H:%M:%S) worktree add failed; retrying next cycle" >&2
+      sleep "$PROBE_INTERVAL"
+      continue
+    fi
+    (
+      cd "$WT" || exit 9
+      SWEEP_OUT="$REPO/PERF_SWEEP.jsonl" \
+      JAX_CC_DIR="$REPO/.jax_cache" \
+      SWEEP_SKIP_DONE=1 \
+      python scripts/perf_sweep.py
+    )
+    rc=$?
+    git worktree remove --force "$WT" 2>/dev/null || true
+    last="$(tail -n 1 "$REPO/PERF_SWEEP.jsonl" 2>/dev/null)"
+    if [ "$rc" -ne 0 ]; then
+      # The sweep itself died (exception, OOM kill) — the last jsonl row
+      # may be stale; keep watching rather than claim completion.
+      echo "$(date -u +%H:%M:%S) sweep exited rc=$rc — resuming watch" >&2
+      rm -f "$MARKER"
+      sleep "$PROBE_INTERVAL"
+      continue
+    fi
+    if echo "$last" | grep -q 'chip_gone'; then
+      echo "$(date -u +%H:%M:%S) sweep aborted on chip_gone — resuming watch" >&2
+      rm -f "$MARKER"
+      continue
+    fi
+    echo "$(date -u +%H:%M:%S) sweep complete — watcher exiting" >&2
+    exit 0
   fi
   echo "$(date -u +%H:%M:%S) TPU still unreachable" >&2
   sleep "$PROBE_INTERVAL"

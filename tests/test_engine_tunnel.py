@@ -111,6 +111,63 @@ def test_completions_endpoint():
     asyncio.run(run())
 
 
+def test_completions_streaming_legacy_shape():
+    """Streaming /v1/completions speaks the LEGACY stream grammar: object
+    'text_completion' (no '.chunk'), choices[0].text (never delta), a
+    logprobs object per chunk when requested — so OpenAI-SDK completion
+    clients reading .choices[0].text actually see the tokens (ADVICE r4)."""
+    async def run():
+        async with engine_stack() as (base, _):
+            payload = json.dumps(
+                {"prompt": "abc", "max_tokens": 4, "stream": True,
+                 "logprobs": 2,
+                 "stream_options": {"include_usage": True}}
+            ).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/v1/completions", {}, payload, timeout=60.0
+            )
+            assert resp.status == 200
+            body = await resp.read_all()
+            assert body.strip().endswith(b"data: [DONE]")
+            lines = [l for l in body.split(b"\n\n") if l.startswith(b"data:")]
+            chunks = [json.loads(l[len(b"data: "):]) for l in lines[:-1]]
+            # usage chunk last (include_usage), finish chunk before it
+            usage = chunks[-1]
+            assert usage["choices"] == []
+            assert usage["usage"]["completion_tokens"] >= 1
+            final = chunks[-2]
+            assert final["choices"][0]["finish_reason"] in ("stop", "length")
+            for c in chunks:
+                assert c["object"] == "text_completion"
+                for choice in c["choices"]:
+                    assert "delta" not in choice
+                    assert isinstance(choice["text"], str)
+                    assert "logprobs" in choice
+            # At least one content chunk carries the legacy logprob arrays.
+            lps = [c["choices"][0]["logprobs"] for c in chunks[:-1]
+                   if c["choices"][0]["logprobs"] is not None]
+            assert lps, "no chunk carried logprobs despite logprobs=2"
+            assert "token_logprobs" in lps[0] and "tokens" in lps[0]
+            # Legacy top_logprobs is a text-keyed dict: distinct token ids
+            # with identical text (byte tokens both rendering U+FFFD here)
+            # collapse, so <=2 with at least one entry.
+            assert 1 <= len(lps[0]["top_logprobs"][0]) <= 2
+            # Concatenated stream text equals the non-stream completion...
+            text = "".join(
+                c["choices"][0]["text"] for c in chunks if c["choices"]
+            )
+            resp2 = await http11.http_request(
+                "POST", f"{base}/v1/completions", {},
+                json.dumps({"prompt": "abc", "max_tokens": 4,
+                            "stream": False}).encode(), timeout=60.0,
+            )
+            obj2 = json.loads(await resp2.read_all())
+            # ...modulo sampling: both use the same greedy-by-default params
+            assert text == obj2["choices"][0]["text"]
+
+    asyncio.run(run())
+
+
 def test_ollama_generate_ndjson_stream():
     async def run():
         async with engine_stack() as (base, _):
