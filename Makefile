@@ -1,7 +1,7 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native native-san test test-unit test-local test-race bench serve proxy signal multichip
+.PHONY: native native-san test test-unit test-fast test-local test-race bench serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
@@ -15,6 +15,9 @@ test: test-unit test-local
 
 test-unit:         ## full pytest suite on the virtual CPU mesh
 	python -m pytest tests/ -q
+
+test-fast:         ## <3 min iteration loop: everything not marked slow
+	python -m pytest tests/ -q -m "not slow"
 
 test-local:        ## hermetic 4-process end-to-end over real sockets
 	scripts/test-local.sh

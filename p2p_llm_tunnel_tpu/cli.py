@@ -233,6 +233,10 @@ async def _serve_once(args) -> None:
     backend = None
     if args.backend == "tpu":
         backend = await _engine_backend(args)
+        if backend is None:
+            # Multi-host follower rank: the replay loop above ran to
+            # completion (leader stopped); nothing to serve here.
+            return
     channel, signaling = await connect(args.signal, args.room, args.transport,
                                        stun_server=args.stun, relay=args.relay,
                                        relay_secret=args.relay_secret)
@@ -334,6 +338,11 @@ async def _engine_backend(args):
     if args.replicas > 1:
         from p2p_llm_tunnel_tpu.engine.router import ReplicaRouter, router_backend
 
+        if args.coordinator and args.num_processes > 1:
+            raise SystemExit(
+                "--replicas > 1 is a single-host data-parallel mode; "
+                "multi-host runs shard ONE engine over the global mesh"
+            )
         log.info("starting %d engine replicas: model=%s slots=%d",
                  args.replicas, args.model, args.slots)
         router = ReplicaRouter(
@@ -351,6 +360,16 @@ async def _engine_backend(args):
 
         log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
         engine = make_engine(0)
+        spmd = getattr(engine, "_spmd", None)  # tests inject fake engines
+        if spmd is not None and spmd.rank != 0:
+            # Follower host (PARITY A8): no tunnel endpoint here — rank 0
+            # owns the tunnel and broadcasts every dispatch's host inputs;
+            # this process replays them until the leader stops.  Returns
+            # None so _serve_once skips connecting.
+            log.info("multi-host follower rank %d: replaying rank-0 "
+                     "dispatches", spmd.rank)
+            await asyncio.to_thread(engine.spmd_follower_loop)
+            return None
         await engine.start()
         # See replica branch: compile all decode variants before traffic.
         await engine.warmup()

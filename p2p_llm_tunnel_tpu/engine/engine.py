@@ -213,6 +213,13 @@ class InferenceEngine:
                 tp=self.ecfg.tp, dp=1, sp=self.ecfg.sp, ep=self.ecfg.ep
             )
         self.mesh = mesh
+        # Cross-host SPMD serving (PARITY A8): in a multi-process run rank 0
+        # broadcasts every dispatch's host inputs and ranks != 0 replay them
+        # (spmd_follower_loop).  None in single-process runs — zero overhead.
+        from p2p_llm_tunnel_tpu.parallel.spmd_serve import SpmdCoordinator
+
+        self._spmd = SpmdCoordinator.maybe(mesh)
+        self._spmd_stop_sent = False
         if mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import (
                 param_shardings as _pshard,
@@ -289,6 +296,11 @@ class InferenceEngine:
             self._copy_in, self._copy_out = make_copy_ops(
                 blk, self._prefix_max_blocks
             )
+            if self._spmd is not None:
+                self._copy_in = self._spmd.wrap("copy_in", self._copy_in, 2)
+                self._copy_out = self._spmd.wrap(
+                    "copy_out", self._copy_out, 2
+                )
 
         # Prefill may run a hotter quant mode than decode (prefill_act_quant):
         # a separate static config for the prefill program only.
@@ -337,8 +349,18 @@ class InferenceEngine:
             self._prefill_fn, donate_argnums=(1,), static_argnums=(7,)
         )
         self._jit_chunk_prefill = jax.jit(
-            self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=()
+            self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=(8,)
         )
+        if self._spmd is not None:
+            # Carries (params + device caches) are spliced by each rank;
+            # everything after them is host input, broadcast by rank 0.
+            self._jit_decode = self._spmd.wrap("decode", self._jit_decode, 5)
+            self._jit_prefill = self._spmd.wrap(
+                "prefill", self._jit_prefill, 2
+            )
+            self._jit_chunk_prefill = self._spmd.wrap(
+                "chunk", self._jit_chunk_prefill, 2
+            )
 
         # Device-side decode carry (created lazily) + host override patch.
         self._dev_tokens = None
@@ -450,16 +472,18 @@ class InferenceEngine:
         return first, lp, kv_cache
 
     def _chunk_prefill_fn(
-        self, params, kv_cache, tokens, lengths, starts, slots, samp, key
+        self, params, kv_cache, tokens, lengths, starts, slots, samp, key,
+        kv_view,
     ):
-        """Tail-only prefill against reused history KV (prefix-cache path)."""
+        """Tail-only prefill against reused history KV (prefix-cache path).
+        ``kv_view`` is static (one compiled program per (tail, view))."""
         from p2p_llm_tunnel_tpu.models.transformer import (
             chunk_prefill_into_cache,
         )
 
         last_logits, kv_cache = chunk_prefill_into_cache(
             self._prefill_mcfg, params, tokens, lengths, starts, kv_cache,
-            slots,
+            slots, kv_view=kv_view,
         )
         first = sampling.sample(last_logits, samp, key)
         lp = jax.lax.cond(
@@ -482,6 +506,14 @@ class InferenceEngine:
         if self._task is not None:
             await self._task
             self._task = None
+        if (self._spmd is not None and self._spmd.rank == 0
+                and not self._spmd_stop_sent):
+            # Release the follower ranks blocked in spmd_follower_loop.
+            # Once only: stop() must stay idempotent, and a second stop
+            # broadcast would hang rank 0 (followers already exited).
+            self._spmd_stop_sent = True
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(self._executor, self._spmd.send_stop)
         # Unblock every in-flight generate() consumer.
         for state in list(self._requests.values()):
             state.queue.put_nowait(None)
@@ -515,14 +547,18 @@ class InferenceEngine:
         if self._prefix is not None:
             await loop.run_in_executor(self._executor, self._warm_prefix)
         if self.ecfg.prefill_chunk > 0:
-            await loop.run_in_executor(
-                self._executor, self._warm_chunk_program,
-                self.ecfg.prefill_chunk,
-            )
+            # Chunked-prefill segments march ``starts`` toward max_seq, so
+            # every view bucket >= the chunk width is reachable.
+            for view in views:
+                if view >= self.ecfg.prefill_chunk:
+                    await loop.run_in_executor(
+                        self._executor, self._warm_chunk_program,
+                        self.ecfg.prefill_chunk, view,
+                    )
 
-    def _warm_chunk_program(self, t: int) -> None:
-        """Compile the chunk-prefill program at tail width ``t`` against
-        scratch rows (executor thread)."""
+    def _warm_chunk_program(self, t: int, view: int) -> None:
+        """Compile the chunk-prefill program at tail width ``t`` and kv-view
+        ``view`` against scratch rows (executor thread)."""
         nb = self.ecfg.prefill_rows
         samp = sampling.SamplingParams(
             temperature=jnp.zeros((nb,), jnp.float32),
@@ -541,8 +577,18 @@ class InferenceEngine:
             jnp.full((nb,), self._scratch_slot, jnp.int32),
             samp,
             self._next_key(),
+            view,
         )
         jax.block_until_ready(first)
+
+    def _chunk_view_bucket(self, need: int) -> int:
+        """Smallest kv-view bucket covering ``need`` cache positions —
+        same bucket set as decode (_view_buckets), so warmup pre-compiles
+        exactly the (tail, view) programs dispatch can pick."""
+        for view in self._view_buckets():
+            if view >= need:
+                return view
+        return self.ecfg.max_seq
 
     def _warm_prefix(self) -> None:
         """Compile the prefix-cache programs (both copy ops + every
@@ -559,11 +605,15 @@ class InferenceEngine:
         self._pool = self._copy_out(
             self._pool, self.kv_cache, self._scratch_slot, pids, bnos
         )
+        views = self._view_buckets()
         for t in self._chunk_buckets:
-            self._warm_chunk_program(t)
+            for view in views:
+                if view >= t:
+                    self._warm_chunk_program(t, view)
         log.info(
-            "prefix-cache warmup: copy ops + chunk-prefill%s compiled "
-            "in %.1fs", self._chunk_buckets, time.monotonic() - t0,
+            "prefix-cache warmup: copy ops + chunk-prefill tails %s x "
+            "views %s compiled in %.1fs",
+            self._chunk_buckets, views, time.monotonic() - t0,
         )
 
     # -- public API -------------------------------------------------------
@@ -782,6 +832,10 @@ class InferenceEngine:
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
         )
+        # Smallest view covering every row's history + padded tail: the
+        # attention read cost of an admission tracks the live context, not
+        # max_seq (VERDICT r4 item 7).
+        view = self._chunk_view_bucket(int(starts.max()) + t)
         first, lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
@@ -791,6 +845,7 @@ class InferenceEngine:
             jnp.asarray(slots),
             samp,
             self._next_key(),
+            view,
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
         return first, (lp if lps.any() else None), None
@@ -821,10 +876,7 @@ class InferenceEngine:
         if active.any():
             need = int(self._positions[:n][active].max()) + 1
         need += 2 * self.ecfg.decode_steps + 1
-        for view in self._view_buckets():
-            if view >= need:
-                return view
-        return self.ecfg.max_seq
+        return self._chunk_view_bucket(need)
 
     def _burst_steps(self) -> int:
         """Full burst normally; the small eager burst while work is waiting
@@ -856,13 +908,7 @@ class InferenceEngine:
         in flight to the host — the pipelining that hides the ~90 ms
         device_get RTT of the tunneled-TPU path.
         """
-        rows = self.ecfg.num_slots + 1
-        if self._dev_tokens is None:
-            self._dev_tokens = jnp.zeros((rows,), jnp.int32)
-            self._dev_positions = jnp.zeros((rows,), jnp.int32)
-            self._dev_counts = jnp.zeros(
-                (rows, self.mcfg.vocab_size), jnp.int32
-            )
+        self._ensure_decode_carry()
         # jnp.array (copy=True) — NOT jnp.asarray — for every persistent host
         # array at the dispatch boundary: on the CPU backend asarray zero-copy
         # ALIASES numpy buffers, so mutating them after dispatch (_ov_mask
@@ -925,6 +971,68 @@ class InferenceEngine:
         if not np.any(np.where(active, self._logprobs, 0)):
             lp_out = None
         return (sampled, lp_out), assign
+
+    def _ensure_decode_carry(self) -> None:
+        """Lazily create the device-side decode carry — shared by rank-0
+        dispatch and follower replay so both sides stay shape-identical.
+        Under multi-process SPMD the zeros must be GLOBAL arrays (a
+        process-local array is rejected at the jit boundary)."""
+        if self._dev_tokens is not None:
+            return
+        rows = self.ecfg.num_slots + 1
+        glob = (self._spmd.globalize if self._spmd is not None
+                else (lambda x: x))
+        self._dev_tokens = glob(jnp.zeros((rows,), jnp.int32))
+        self._dev_positions = glob(jnp.zeros((rows,), jnp.int32))
+        self._dev_counts = glob(
+            jnp.zeros((rows, self.mcfg.vocab_size), jnp.int32)
+        )
+
+    # -- cross-host SPMD followers (PARITY A8) ----------------------------
+
+    def spmd_follower_step(self) -> bool:
+        """Replay ONE broadcast dispatch; False when rank 0 said stop.
+
+        The wrapped jit callables do the receive-side globalization; this
+        method only splices in the follower's own device carries and stores
+        the carried outputs, mirroring exactly what the rank-0 call sites
+        do with theirs."""
+        assert self._spmd is not None and self._spmd.rank != 0
+        op, args = self._spmd.recv()
+        if op == "stop":
+            return False
+        if op == "decode":
+            self._ensure_decode_carry()
+            (_s, _lp, self._dev_tokens, self._dev_positions,
+             self._dev_counts, self.kv_cache) = self._jit_decode(
+                self.params, self.kv_cache, self._dev_tokens,
+                self._dev_positions, self._dev_counts, *args,
+            )
+        elif op == "prefill":
+            out = self._jit_prefill(self.params, self.kv_cache, *args)
+            self.kv_cache = out[-1]
+        elif op == "chunk":
+            out = self._jit_chunk_prefill(
+                self.params, self.kv_cache, *args
+            )
+            self.kv_cache = out[-1]
+        elif op == "copy_in":
+            self.kv_cache = self._copy_in(self.kv_cache, self._pool, *args)
+        elif op == "copy_out":
+            self._pool = self._copy_out(self._pool, self.kv_cache, *args)
+        else:
+            raise RuntimeError(f"unknown SPMD op {op!r}")
+        return True
+
+    def spmd_follower_loop(self) -> None:
+        """Ranks != 0: replay rank 0's dispatch stream until it stops.
+        Blocking (broadcast_one_to_all rendezvous); run instead of
+        start()/serving on follower hosts."""
+        log.info("SPMD follower loop: rank %d", self._spmd.rank)
+        n = 0
+        while self.spmd_follower_step():
+            n += 1
+        log.info("SPMD follower loop done after %d ops", n)
 
     def _admit_one(self, run: RunningSlot) -> None:
         """Set up host slot state after prefill admission."""

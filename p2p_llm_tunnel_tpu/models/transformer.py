@@ -445,6 +445,7 @@ def chunk_prefill_into_cache(
     starts: jnp.ndarray,  # [Bp] history length per row (tail begins here)
     kv_cache: KVCache,
     slots: jnp.ndarray,  # [Bp] cache slot per prompt
+    kv_view: Optional[int] = None,  # static: attend only to cache[:kv_view]
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Prefill only the TAIL of each prompt against reused history KV.
 
@@ -467,16 +468,20 @@ def chunk_prefill_into_cache(
       prefix matching entirely, so cache-hit admissions never bypass
       ring/Ulysses attention.  Plain einsum attention here partitions fine
       under tp-only meshes (GSPMD splits the head axes).
-    - Attention reads the full cache row (S = max_seq) rather than a
-      kv_view bucket; at the current serving contexts the tail-chunk score
-      matrix is small, but a long-context config (max_seq >= 4096) should
-      grow a static view argument mirroring decode_step's before relying
-      on this path — noted in PERF.md.
+    ``kv_view`` mirrors decode_step's: a STATIC python int bounding how
+    much of the cache row the attention reads (callers pick the smallest
+    power-of-2 bucket covering every row's ``starts + length``), so the
+    admission cost of prefix-cache hits and chunked-prefill segments
+    tracks the live context, not max_seq (VERDICT r4 item 7 — previously
+    this path re-taxed exactly the long prompts it exists to help).
+    Writes still target the full cache row.
 
     Returns last-real-tail-token logits [Bp, V] and the updated cache.
     """
     b, t = tokens.shape
     s = kv_cache["k"].shape[2]
+    if kv_view is None or kv_view > s:
+        kv_view = s
     x = _embed(cfg, params, tokens)
     pos = starts[:, None] + jnp.arange(t)[None, :]  # [Bp,T] global positions
     layer_idx = jnp.arange(cfg.n_layers)
@@ -501,14 +506,19 @@ def chunk_prefill_into_cache(
         else:
             cache["k"] = cache["k"].at[idx, rows, pos].set(k)
             cache["v"] = cache["v"].at[idx, rows, pos].set(v)
-        # One fused (layer) slice, then row gather: [Bp, S, K, D].
+        # One fused (layer, view) slice, then row gather: [Bp, view, K, D].
         zero = jnp.zeros((), idx.dtype)
         start5 = (idx, zero, zero, zero, zero)
-        lshape = (1,) + cache["k"].shape[1:]
+        lshape = (
+            (1, cache["k"].shape[1], kv_view) + cache["k"].shape[3:]
+        )
         k_all = jax.lax.dynamic_slice(cache["k"], start5, lshape)[0][slots]
         v_all = jax.lax.dynamic_slice(cache["v"], start5, lshape)[0][slots]
         if quant:
-            sshape = (1,) + cache["k_scale"].shape[1:]
+            sshape = (
+                (1, cache["k_scale"].shape[1], kv_view)
+                + cache["k_scale"].shape[3:]
+            )
             k_s_all = jax.lax.dynamic_slice(
                 cache["k_scale"], start5[:4], sshape)[0][slots]
             v_s_all = jax.lax.dynamic_slice(
@@ -519,7 +529,7 @@ def chunk_prefill_into_cache(
             q, k_all, v_all, starts,
             scale=cfg.query_scale,
             softcap=cfg.attn_softcap,
-            window=_layer_window(cfg, idx, s),
+            window=_layer_window(cfg, idx, kv_view),
         )
         attn = mm(attn.reshape(b, t, -1), blk["wo"], cfg.act_quant)
         if cfg.post_norms:

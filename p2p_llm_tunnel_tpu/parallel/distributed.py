@@ -20,11 +20,10 @@ token-sized — across hosts (DCN), matching the bandwidth hierarchy
 
 Scope note: every BASELINE.md config fits ONE host (a v5e-8 / v5p-8 slice
 is one process with 8 local devices — engine tp=8 works today with no
-flags from this module).  These hooks establish the beyond-baseline
-multi-HOST runtime and mesh; driving the engine loop SPMD across hosts
-additionally requires broadcasting the tunnel-owning rank's host inputs
-each dispatch (jax.experimental.multihost_utils.broadcast_one_to_all) —
-wired as future work, tracked in PARITY.md A8.
+flags from this module).  Driving the engine loop SPMD across hosts —
+rank 0 broadcasting each dispatch's host inputs, other ranks replaying —
+lives in parallel/spmd_serve.py (r5; PARITY A8 closed), proven by the
+2-process CPU run in tests/test_spmd_serve.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,149 @@
+"""Cross-host SPMD serving: rank-0 host-input broadcast + follower replay.
+
+Multi-host JAX is N identical processes that must execute the SAME jitted
+computations in the SAME order — GSPMD collectives rendezvous by program
+order, not by tags.  Serving breaks the symmetry: only rank 0 owns the
+tunnel endpoint, the scheduler, and the sampled-token consumers.  This
+module restores it with the standard leader/follower split (the pattern
+PARITY.md A8 tracked as future work, closed in r5):
+
+- rank 0 runs the full engine loop; every XLA dispatch first broadcasts
+  ``(op, host_inputs)`` to all ranks (two `broadcast_one_to_all`
+  collectives: a fixed-size length header, then the pickled payload);
+- ranks != 0 run ``InferenceEngine.spmd_follower_loop()``: receive each
+  op and replay it into the SAME jitted callables, splicing in their own
+  device-side carries (params, KV cache, decode carry, prefix pool).
+
+Device state stays in lockstep because every jitted program is a
+deterministic function of (carried state, broadcast host inputs) — the
+PRNG key rides the broadcast, so even sampling agrees bit-for-bit.
+
+The broadcast is a host-data control plane (~KBs per dispatch: token ids,
+sampling params, a PRNG key); the heavy tensors (params, KV) never move —
+they live sharded across hosts and meet inside the jitted computation via
+ICI/DCN collectives that XLA inserts from the mesh placement.
+
+Wrapping happens at the ``jax.jit`` callable boundary (``wrap``), so the
+warmup paths, the serving paths, and the prefix-cache copy programs all
+broadcast automatically — there is exactly one place dispatches can
+escape from, and none do.
+
+Reference analog: none — the reference serves from one host
+(/root/reference/tunnel/src/serve.rs); this tier is the SURVEY §5
+distributed-communication plan's scale-out leg.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _to_host(x):
+    """Array leaves -> numpy (picklable, process-local); others untouched —
+    static args (python ints/bools) must stay hashable python scalars."""
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return np.asarray(x)
+    return x
+
+
+class SpmdCoordinator:
+    """Host-input broadcast channel for one engine's dispatch stream.
+
+    All traffic flows through ``broadcast_one_to_all`` (a true collective:
+    rank 0 blocks until every follower arrives — construction order between
+    leader and followers needs no extra rendezvous).  Dispatches on rank 0
+    all originate from the engine's single XLA executor thread, so the op
+    stream has a total order; followers replay in that order, keeping every
+    GSPMD collective matched across processes.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.rank = jax.process_index()
+        self._replicated = NamedSharding(mesh, P())
+
+    @classmethod
+    def maybe(cls, mesh: Optional[Mesh]) -> Optional["SpmdCoordinator"]:
+        """A coordinator iff this is a real multi-process run with a mesh."""
+        if mesh is None or jax.process_count() == 1:
+            return None
+        return cls(mesh)
+
+    # -- wire format ------------------------------------------------------
+
+    def _bcast_bytes(self, data: Optional[bytes]) -> bytes:
+        from jax.experimental import multihost_utils as mhu
+
+        if self.rank == 0:
+            assert data is not None
+            n = len(data)
+            mhu.broadcast_one_to_all(np.asarray([n], np.int64))
+            mhu.broadcast_one_to_all(np.frombuffer(data, np.uint8))
+            return data
+        n = int(mhu.broadcast_one_to_all(np.zeros((1,), np.int64))[0])
+        buf = mhu.broadcast_one_to_all(np.zeros((n,), np.uint8))
+        return bytes(buf)
+
+    def send(self, op: str, host_args: Tuple[Any, ...]) -> None:
+        """Rank 0: publish one dispatch's host inputs to every follower."""
+        payload = jax.tree_util.tree_map(_to_host, host_args)
+        self._bcast_bytes(pickle.dumps((op, payload)))
+
+    def recv(self) -> Tuple[str, Tuple[Any, ...]]:
+        """Followers: block for the next op."""
+        op, payload = pickle.loads(self._bcast_bytes(None))
+        return op, payload
+
+    def send_stop(self) -> None:
+        self.send("stop", ())
+
+    # -- dispatch wrapping ------------------------------------------------
+
+    def globalize(self, x):
+        """Host array -> replicated global jax.Array over the mesh,
+        WITHOUT any collective.
+
+        Multi-process jit rejects process-local arrays, and
+        ``jax.device_put`` to a cross-process sharding hides an
+        ``assert_equal`` collective inside — which deadlocks the moment
+        leader and follower globalize at different points in their
+        streams (found the hard way: rank 0's decode-carry init ran it
+        pre-emit while rank 1 sat in recv).  ``make_array_from_callback``
+        has each process supply its addressable shards directly — purely
+        local, order-insensitive; every rank holds an identical copy of
+        the value (rank 0 computed it, followers received it), so the
+        unchecked replication is value-correct."""
+        if isinstance(x, (jax.Array, np.ndarray)):
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, self._replicated, lambda idx: arr[idx]
+            )
+        return x
+
+    def wrap(self, op: str, fn: Callable, n_carry: int) -> Callable:
+        """Wrap a jitted callable: args[:n_carry] are device-side carries
+        (params, caches — already global, never broadcast); the rest are
+        host inputs, broadcast on rank 0 before the call and globalized on
+        every rank."""
+
+        def wrapped(*args):
+            carries, host = args[:n_carry], args[n_carry:]
+            if self.rank == 0:
+                self.send(op, host)
+            host = tuple(
+                jax.tree_util.tree_map(self.globalize, a) for a in host
+            )
+            return fn(*carries, *host)
+
+        wrapped.op_name = op
+        wrapped.inner = fn
+        return wrapped

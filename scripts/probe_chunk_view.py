@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Probe: chunk-prefill admission cost vs kv_view bucket (VERDICT r4 #7).
+
+Before r5, ``chunk_prefill_into_cache`` read the full cache row per layer
+(S = max_seq), so prefix-cache hits and chunked-prefill segments paid
+attention-read cost proportional to max_seq even for a 100-token context.
+This probe times the jitted chunk program at a fixed (tail, history) while
+growing max_seq, with the view pinned to the bucket covering the live
+context vs pinned to max_seq — the win is the gap between those curves.
+
+Runs anywhere (CPU mesh included; relative scaling is what matters).
+Usage: python scripts/probe_chunk_view.py [model] (default tiny-ish custom)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Pin the platform BEFORE any backend init: jax.default_backend() would
+# force-initialise the axon plugin's tunneled chip, which hangs every
+# process while the tunnel is wedged.  PROBE_PLATFORM=tpu opts in.
+jax.config.update(
+    "jax_platforms", os.environ.get("PROBE_PLATFORM", "cpu")
+)
+
+import jax.numpy as jnp  # noqa: E402
+
+from p2p_llm_tunnel_tpu.models.config import get_config  # noqa: E402
+from p2p_llm_tunnel_tpu.models.transformer import (  # noqa: E402
+    chunk_prefill_into_cache,
+    init_kv_cache,
+    init_params,
+)
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+TAIL = 32
+HIST = 64  # history tokens already in cache
+ROWS = 8
+
+
+def bucket_for(need: int, max_seq: int) -> int:
+    v = 128
+    while v < need and v < max_seq:
+        v *= 2
+    return min(v, max_seq)
+
+
+def main() -> None:
+    cfg = get_config(MODEL)
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    fn = jax.jit(chunk_prefill_into_cache, static_argnums=(0, 7),
+                 donate_argnums=(5,))
+
+    print(f"model={MODEL} platform={jax.default_backend()} "
+          f"tail={TAIL} hist={HIST} rows={ROWS}")
+    print(f"{'max_seq':>8} {'view':>6} {'ms/call':>9}")
+    for max_seq in (512, 1024, 2048, 4096):
+        for view in (bucket_for(HIST + TAIL, max_seq), max_seq):
+            cache = init_kv_cache(cfg, ROWS, max_seq, jnp.bfloat16)
+            tokens = jnp.ones((ROWS, TAIL), jnp.int32)
+            lengths = jnp.full((ROWS,), TAIL, jnp.int32)
+            starts = jnp.full((ROWS,), HIST, jnp.int32)
+            slots = jnp.arange(ROWS, dtype=jnp.int32)
+            # compile + 1 warm call
+            last, cache = fn(cfg, params, tokens, lengths, starts, cache,
+                             slots, view)
+            jax.block_until_ready(last)
+            n = 10
+            t0 = time.monotonic()
+            for _ in range(n):
+                last, cache = fn(cfg, params, tokens, lengths, starts,
+                                 cache, slots, view)
+            jax.block_until_ready(last)
+            ms = (time.monotonic() - t0) / n * 1000
+            print(f"{max_seq:>8} {view:>6} {ms:>9.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,10 @@ import textwrap
 
 import pytest
 
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

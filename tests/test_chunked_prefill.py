@@ -9,6 +9,12 @@ import asyncio
 from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def _cfg(**kw):
     base = dict(model="tiny", num_slots=4, max_seq=256, dtype="float32",

@@ -19,6 +19,12 @@ from p2p_llm_tunnel_tpu.models.transformer import (
 )
 from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def test_prompt_logprobs_match_manual_scoring():
     """prefill_into_cache(return_prompt_logprobs) must equal scoring each

@@ -17,6 +17,12 @@ from p2p_llm_tunnel_tpu.models.transformer import (
     prefill_into_cache,
 )
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 ECFG = EngineConfig(model="tiny", num_slots=4, max_seq=64, dtype="float32", seed=0)
 
 

@@ -13,6 +13,12 @@ import random
 
 from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 ECFG = EngineConfig(
     model="tiny", num_slots=4, max_seq=64, dtype="float32", seed=0,
     decode_steps=4, prefill_rows=4,

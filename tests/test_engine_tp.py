@@ -9,6 +9,12 @@ from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 from p2p_llm_tunnel_tpu.models.config import get_config
 from p2p_llm_tunnel_tpu.models.transformer import init_params
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def _collect(engine, prompt, n):
     async def main():

@@ -13,6 +13,12 @@ from p2p_llm_tunnel_tpu.engine.api import engine_backend
 from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 from p2p_llm_tunnel_tpu.transport import loopback_pair
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 ECFG = EngineConfig(model="tiny", num_slots=4, max_seq=128, dtype="float32")
 
 

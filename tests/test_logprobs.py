@@ -16,6 +16,12 @@ from p2p_llm_tunnel_tpu.engine.sampling import (
 )
 from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def test_logprob_data_matches_log_softmax():
     logits = jax.random.normal(jax.random.PRNGKey(0), (3, 50))

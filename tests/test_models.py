@@ -15,6 +15,10 @@ from p2p_llm_tunnel_tpu.models.transformer import (
     prefill_into_cache,
 )
 
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module",
                 params=["tiny", "tiny-gemma", "tiny-moe", "tiny-qwen"])

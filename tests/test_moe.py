@@ -14,6 +14,10 @@ import pytest
 from p2p_llm_tunnel_tpu.models.config import get_config
 from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
 
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def _inputs(cfg, t=12, seed=5):
     tokens = jax.random.randint(jax.random.PRNGKey(seed), (2, t), 0,

@@ -10,6 +10,12 @@ from p2p_llm_tunnel_tpu.models.config import get_config
 from p2p_llm_tunnel_tpu.models.quant import QTensor, mm, quantize_params
 from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
 
+import pytest
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
 
 def test_qtensor_roundtrip_error_bounded():
     w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)

@@ -1,0 +1,163 @@
+"""Cross-host SPMD serving (PARITY A8): 2-process CPU proof.
+
+Two real OS processes join a jax.distributed runtime (Gloo CPU
+collectives), build IDENTICAL engines over a tp=2 mesh that SPANS the
+processes (one CPU device each), and serve: rank 0 drives generation
+through the normal engine loop while rank 1 replays the broadcast
+dispatch stream (InferenceEngine.spmd_follower_loop).  The tokens rank 0
+emits must equal a single-process tp=2 oracle — proving the follower
+executed every collective in lockstep (a desync deadlocks or corrupts).
+
+Subprocess-based like the transport-net suite: multi-controller JAX
+cannot be simulated in one process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: One script, two ranks.  Greedy sampling (temperature 0) + fixed seed so
+#: the oracle comparison is exact.
+WORKER = textwrap.dedent("""\
+    import asyncio, json, os, sys
+
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=2, process_id=rank
+    )
+    import numpy as np
+    from jax.sharding import Mesh
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+    from p2p_llm_tunnel_tpu.models.config import get_config
+    from p2p_llm_tunnel_tpu.parallel.mesh import AXES
+
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(1, 1, 2, 1), AXES
+    )  # tp=2 across the two processes
+    engine = InferenceEngine(
+        model_cfg=get_config("tiny", n_heads=8, n_kv_heads=2, vocab_size=512),
+        engine_cfg=EngineConfig(
+            model="tiny", num_slots=2, max_seq=64, dtype="float32",
+            seed=0, decode_steps=4, decode_steps_eager=0, prefill_rows=2,
+        ),
+        mesh=mesh,
+    )
+
+    async def lead():
+        await engine.start()
+        outs = []
+        for prompt in ([1, 2, 3, 4], [9, 8, 7]):
+            toks = []
+            async for ev in engine.generate(
+                prompt, max_new_tokens=6, stop_ids=()
+            ):
+                toks.append(ev.token_id)
+            outs.append(toks)
+        await engine.stop()
+        print("RESULT " + json.dumps(outs), flush=True)
+
+    if rank == 0:
+        asyncio.run(lead())
+    else:
+        engine.spmd_follower_loop()
+""")
+
+ORACLE = textwrap.dedent("""\
+    import asyncio, json, os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+    from p2p_llm_tunnel_tpu.models.config import get_config
+    from p2p_llm_tunnel_tpu.parallel.mesh import AXES
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 2, 1), AXES)
+    engine = InferenceEngine(
+        model_cfg=get_config("tiny", n_heads=8, n_kv_heads=2, vocab_size=512),
+        engine_cfg=EngineConfig(
+            model="tiny", num_slots=2, max_seq=64, dtype="float32",
+            seed=0, decode_steps=4, decode_steps_eager=0, prefill_rows=2,
+        ),
+        mesh=mesh,
+    )
+
+    async def run():
+        await engine.start()
+        outs = []
+        for prompt in ([1, 2, 3, 4], [9, 8, 7]):
+            toks = []
+            async for ev in engine.generate(
+                prompt, max_new_tokens=6, stop_ids=()
+            ):
+                toks.append(ev.token_id)
+            outs.append(toks)
+        await engine.stop()
+        print("RESULT " + json.dumps(outs), flush=True)
+
+    asyncio.run(run())
+""")
+
+
+def _run(script: str, *argv: str) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, "-c", script, *argv],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        cwd=REPO,
+    )
+
+
+def _result_of(out: bytes):
+    for line in out.decode().splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def _free_port() -> str:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+@pytest.mark.slow
+def test_two_process_spmd_serving_matches_oracle():
+    port = _free_port()
+    lead = _run(WORKER, "0", port)
+    follow = _run(WORKER, "1", port)
+    try:
+        out0, err0 = lead.communicate(timeout=600)
+        out1, err1 = follow.communicate(timeout=60)
+    finally:
+        for p in (lead, follow):
+            if p.poll() is None:
+                p.kill()
+    assert lead.returncode == 0, err0.decode()[-2000:]
+    assert follow.returncode == 0, err1.decode()[-2000:]
+    tokens = _result_of(out0)
+    assert tokens is not None, out0.decode()[-500:]
+
+    oracle_p = _run(ORACLE)
+    out_o, err_o = oracle_p.communicate(timeout=600)
+    assert oracle_p.returncode == 0, err_o.decode()[-2000:]
+    expected = _result_of(out_o)
+    assert tokens == expected, (tokens, expected)
