@@ -60,6 +60,7 @@ FALLBACKS = {
 SAFE_OVERRIDES = {
     "BENCH_PREFILL_ACT_QUANT": "0",
     "BENCH_FLASH_DECODE": "0",
+    "BENCH_FLASH_SGRID": "0",
     "BENCH_KV_QUANT": "none",
     "BENCH_QUANT": "int8",
     "BENCH_PREFIX_CACHE": "0",
@@ -162,8 +163,13 @@ async def _run_attempt(model: str) -> dict:
            and quant == "int8")
     kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
     # An int8 KV cache forces the einsum decode path; record what ran.
-    flash_decode = (os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
-                    and kv_quant != "int8")
+    # BENCH_FLASH_SGRID implies flash decode (the S-gridded variant).
+    flash_sgrid = (os.environ.get("BENCH_FLASH_SGRID", "0") == "1"
+                   and kv_quant != "int8")
+    flash_decode = flash_sgrid or (
+        os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
+        and kv_quant != "int8"
+    )
     # Automatic prefix caching — on by default here AND in the serve CLI
     # (TUNNEL_PREFIX_CACHE), so the benched config is the deployed default.
     # The bench prompts share a prefix the way real traffic shares system
@@ -198,6 +204,7 @@ async def _run_attempt(model: str) -> dict:
             decode_steps=decode_steps, decode_steps_eager=eager_steps,
             prefill_rows=prefill_rows, quant=quant,
             prefill_act_quant=pf8, flash_decode=flash_decode,
+            flash_sgrid=flash_sgrid,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk,
         ),
@@ -332,6 +339,7 @@ async def _run_attempt(model: str) -> dict:
         "prefill_act_quant": pf8,
         "kv_quant": kv_quant,
         "flash_decode": flash_decode,
+        "flash_sgrid": flash_sgrid,
         "prefix_cache": prefix_cache,
         "prefix_hit_tokens": global_metrics.counter(
             "engine_prefix_hit_tokens_total"

@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the Pallas decode-attention kernel on "
                             "tileable shapes (--no-flash-decode overrides "
                             "the env)")
+    serve.add_argument("--flash-sgrid",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_FLASH_SGRID", "") == "1",
+                       help="with --flash-decode: the S-gridded kernel "
+                            "variant (per-block DMA, frontier-clamped "
+                            "fetches, no view cap)")
     serve.add_argument("--prefill-chunk", type=int,
                        default=int(_env("TUNNEL_PREFILL_CHUNK", "0")),
                        help="chunked prefill: prompts longer than this many "
@@ -329,6 +335,7 @@ async def _engine_backend(args):
                     kv_quant=args.kv_quant,
                     prefill_act_quant=args.prefill_act_quant,
                     flash_decode=args.flash_decode,
+                    flash_sgrid=args.flash_sgrid,
                     prefix_cache=args.prefix_cache,
                     prefill_chunk=args.prefill_chunk,
                     seed=seed,

@@ -261,6 +261,28 @@ class EngineAPI:
             # whenever the asyncgen finalizer happens to collect it.
             await gen.aclose()
 
+    def _chat_prompt_ids(self, messages) -> list:
+        """Tokenize a chat: the tokenizer's OWN chat template when it has
+        one (HFTokenizer on a real checkpoint — the rendering the model was
+        tuned on), else the generic role-prefixed flattening
+        (render_chat_prompt; byte/numeric tokenizers, template-less HF)."""
+        tok = self.engine.tokenizer
+        apply = getattr(tok, "apply_chat_template", None)
+        if apply is not None:
+            try:
+                ids = apply(messages)
+            except (ValueError, TypeError):
+                raise
+            except Exception as e:
+                # Real templates reject messages via jinja raise_exception
+                # (gemma: system role; llama-2: non-alternating roles) — a
+                # TemplateError the router wouldn't map to 400.  It IS an
+                # invalid-request error: surface it as one.
+                raise ValueError(f"chat template rejected messages: {e}")
+            if ids is not None:
+                return ids
+        return tok.encode(render_chat_prompt(messages))
+
     def _check_prompt(self, prompt_ids) -> None:
         """Reject unservable prompts eagerly (scheduler would raise lazily,
         after a streaming 200 has already gone out)."""
@@ -579,7 +601,7 @@ class EngineAPI:
                 messages = payload.get("messages")
                 if not isinstance(messages, list):
                     return _error(400, "messages must be a list")
-                prompt_ids = self.engine.tokenizer.encode(render_chat_prompt(messages))
+                prompt_ids = self._chat_prompt_ids(messages)
                 self._check_prompt(prompt_ids)
                 if stream:
                     cid = f"chatcmpl-{int(time.time() * 1000)}"
@@ -634,7 +656,7 @@ class EngineAPI:
 
             if path == "/api/chat":
                 messages = payload.get("messages") or []
-                prompt_ids = self.engine.tokenizer.encode(render_chat_prompt(messages))
+                prompt_ids = self._chat_prompt_ids(messages)
                 self._check_prompt(prompt_ids)
                 if stream:
                     return 200, dict(_NDJSON), self._ollama_chat_stream(

@@ -44,8 +44,15 @@ def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
     )
 
 
+def lp_width(vocab_size: int) -> int:
+    """Static top-alternatives width: the CAP, clamped to the vocabulary.
+    Tiny test vocabularies (< 20) would otherwise make top_k raise."""
+    return min(TOP_LOGPROBS_CAP, vocab_size)
+
+
 def logprob_data(logits: jnp.ndarray, sampled: jnp.ndarray):
-    """(chosen_lp [B], top_ids [B,CAP] i32, top_lps [B,CAP] f32).
+    """(chosen_lp [B], top_ids [B,W] i32, top_lps [B,W] f32),
+    W = lp_width(V).
 
     Log-probabilities of the RAW model distribution (before penalties/
     temperature/truncation), matching what OpenAI reports.  Callers gate
@@ -57,15 +64,18 @@ def logprob_data(logits: jnp.ndarray, sampled: jnp.ndarray):
     chosen = (
         jnp.take_along_axis(logits, sampled[:, None], axis=-1)[:, 0] - lse
     )
-    top_vals, top_ids = jax.lax.top_k(logits, TOP_LOGPROBS_CAP)
+    top_vals, top_ids = jax.lax.top_k(logits, lp_width(logits.shape[-1]))
     return chosen, top_ids.astype(jnp.int32), top_vals - lse[:, None]
 
 
-def empty_logprob_data(batch: int):
+def empty_logprob_data(batch: int, vocab_size: int = 10**9):
+    """Zero-filled logprob tuple, shape-matched to logprob_data for the
+    lax.cond that selects between them."""
+    w = lp_width(vocab_size)
     return (
         jnp.zeros((batch,), jnp.float32),
-        jnp.zeros((batch, TOP_LOGPROBS_CAP), jnp.int32),
-        jnp.zeros((batch, TOP_LOGPROBS_CAP), jnp.float32),
+        jnp.zeros((batch, w), jnp.int32),
+        jnp.zeros((batch, w), jnp.float32),
     )
 
 

@@ -3,7 +3,7 @@ an adapter for HuggingFace tokenizers for real checkpoints."""
 
 from __future__ import annotations
 
-from typing import List, Protocol
+from typing import List, Optional, Protocol
 
 
 class Tokenizer(Protocol):
@@ -138,3 +138,19 @@ class HFTokenizer:
 
     def decode_token(self, token_id: int) -> str:
         return self._t.decode([token_id], skip_special_tokens=True)
+
+    def apply_chat_template(self, messages) -> Optional[List[int]]:
+        """Token ids via the checkpoint's OWN chat template (the exact
+        rendering the model was instruction-tuned on), or None when the
+        tokenizer ships no template — the API layer then falls back to the
+        generic render_chat_prompt flattening.
+
+        Capability parity with the reference serving real Ollama models
+        transparently (tunnel/src/serve.rs:219): Ollama applies the model's
+        Modelfile template server-side; our engine mode does the same via
+        the HF tokenizer's template."""
+        if not getattr(self._t, "chat_template", None):
+            return None
+        return self._t.apply_chat_template(
+            messages, add_generation_prompt=True, tokenize=True
+        )

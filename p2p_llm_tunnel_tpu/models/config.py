@@ -60,6 +60,9 @@ class ModelConfig:
     # head_dim % 128 == 0).  Off by default: the einsum path is the oracle;
     # flip on once measured faster for the target config.
     flash_decode: bool = False
+    # With flash_decode: use the S-gridded variant (per-block DMA, frontier
+    # skips the fetch too, no view-size cap) instead of the full-plane one.
+    flash_sgrid: bool = False
     # Sequence-parallel strategy when the mesh has sp > 1:
     # "ring"    — K/V blocks rotate via ppermute (bandwidth-optimal on the
     #             ICI ring; no sliding-window support)

@@ -604,16 +604,36 @@ def decode_step(
     #   large views must use the einsum path (or a future S-gridded kernel).
     quant = kv_cache_is_quantized(kv_cache)
     tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-    use_flash = (
+    flash_common = (
         cfg.flash_decode
         and not quant  # kernel reads raw K/V; int8 cache takes the einsum path
         and (jax.default_backend() == "tpu" or cfg.flash_interpret)
         and tp == 1
         and kv_view % 128 == 0
         and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
+    )
+    # The S-gridded kernel has no view cap (per-block DMA); the plane
+    # kernel must bound its whole-view staging to the VMEM budget.
+    use_sgrid = flash_common and cfg.flash_sgrid
+    use_flash = (
+        flash_common and not use_sgrid
         and kv_view * cfg.head_dim <= 8192 * 128
     )
-    if use_flash:
+    if use_sgrid:
+        from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+            flash_decode_attention_sgrid,
+        )
+
+        def attention(q, k_l, v_l, idx):
+            win = _layer_window(cfg, idx, s)
+            return flash_decode_attention_sgrid(
+                q, k_l, v_l, positions,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=win,
+                interpret=cfg.flash_interpret,
+            )
+    elif use_flash:
         from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
             flash_decode_attention,
         )
