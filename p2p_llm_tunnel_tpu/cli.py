@@ -134,6 +134,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "conversations); pure latency optimization, "
                             "outputs unchanged; disable with "
                             "--no-prefix-cache or TUNNEL_PREFIX_CACHE=0")
+    serve.add_argument("--prefix-cache-dir",
+                       default=_env("TUNNEL_PREFIX_CACHE_DIR"),
+                       help="persist the prefix-cache block pool here: warm "
+                            "prompt KV survives serve restarts (loaded at "
+                            "startup when compatible, saved at shutdown)")
     serve.add_argument("--sp", type=int, default=int(_env("TUNNEL_SP", "1")),
                        help="sequence-parallel degree for prefill "
                             "(long-context)")
@@ -257,6 +262,9 @@ async def _serve_once(args) -> None:
 
 
 _BACKEND = None
+#: Engines constructed by this process — the Ctrl+C path snapshots their
+#: prefix pools (asyncio.run tears down before any engine.stop() runs).
+_ENGINES: list = []
 
 
 async def _engine_backend(args):
@@ -317,6 +325,11 @@ async def _engine_backend(args):
     def make_engine(seed: int) -> InferenceEngine:
         # Replica i lives on device i (round-robin): its params/KV arrays
         # are created committed there, so jit dispatch follows.
+        # Each replica snapshots into its own subdirectory — one shared dir
+        # would have every save clobber the previous replica's pool.
+        pfx_dir = args.prefix_cache_dir
+        if pfx_dir and args.replicas > 1:
+            pfx_dir = os.path.join(pfx_dir, f"replica-{seed}")
         with jax.default_device(devices[seed % len(devices)]):
             return InferenceEngine(
                 tokenizer=tokenizer,
@@ -337,6 +350,7 @@ async def _engine_backend(args):
                     flash_decode=args.flash_decode,
                     flash_sgrid=args.flash_sgrid,
                     prefix_cache=args.prefix_cache,
+                    prefix_cache_dir=pfx_dir,
                     prefill_chunk=args.prefill_chunk,
                     seed=seed,
                 )
@@ -355,6 +369,7 @@ async def _engine_backend(args):
         router = ReplicaRouter(
             [make_engine(i) for i in range(args.replicas)], args.model
         )
+        _ENGINES.extend(router.engines)
         await router.start()
         # Pre-compile every decode variant BEFORE serving: a first-hit
         # compile inside the live loop would stall the event loop past the
@@ -367,6 +382,7 @@ async def _engine_backend(args):
 
         log.info("starting TPU engine: model=%s slots=%d", args.model, args.slots)
         engine = make_engine(0)
+        _ENGINES.append(engine)
         spmd = getattr(engine, "_spmd", None)  # tests inject fake engines
         if spmd is not None and spmd.rank != 0:
             # Follower host (PARITY A8): no tunnel endpoint here — rank 0
@@ -426,11 +442,26 @@ async def _amain(args) -> None:
 
 def main(argv: Optional[list] = None) -> None:
     init_logging()
+    import signal as _signal
+
+    # SIGTERM (docker stop, systemd, supervisors) takes the same graceful
+    # path as Ctrl+C — prefix-pool snapshots must survive orchestrated
+    # restarts, not just interactive ones.  And a process launched as a
+    # background job of a non-interactive shell inherits SIGINT=ignore
+    # (POSIX); restore the default so Ctrl+C-equivalents work there too.
+    _signal.signal(_signal.SIGTERM, _signal.default_int_handler)
+    if _signal.getsignal(_signal.SIGINT) == _signal.SIG_IGN:
+        _signal.signal(_signal.SIGINT, _signal.default_int_handler)
     args = build_parser().parse_args(argv)
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:
         log.info("interrupted, shutting down")
+        for eng in _ENGINES:
+            try:
+                eng.save_prefix_snapshot()
+            except Exception as e:  # best-effort on the exit path
+                log.warning("prefix snapshot on shutdown failed: %s", e)
         sys.exit(130)
 
 

@@ -108,6 +108,11 @@ class EngineConfig:
     # Pool capacity in blocks (block 0 is scratch).  Sized so HBM cost is
     # modest: 128 blocks x 16 tokens of 8B bf16 KV ~= 0.27 GB.
     prefix_pool_blocks: int = 128
+    # Directory for prefix-pool snapshots: warm prompt KV (shared system
+    # prompts, live conversations) survives serve restarts — loaded at
+    # startup when compatible, saved at stop().  None disables (the pool
+    # stays memory-only, the pre-r5 behavior).
+    prefix_cache_dir: Optional[str] = None
     # How many tail buckets the chunk-prefill path supports: buckets
     # min_prefill_bucket * 2^i for i < prefix_tail_buckets.  Requests whose
     # post-match tail exceeds the largest bucket take the plain full-prefill
@@ -297,6 +302,17 @@ class InferenceEngine:
             self._pool = init_pool(
                 self.kv_cache, blk, self.ecfg.prefix_pool_blocks
             )
+            if self.ecfg.prefix_cache_dir:
+                from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+                    load_pool_snapshot,
+                )
+
+                loaded = load_pool_snapshot(
+                    self.ecfg.prefix_cache_dir, self._pool, self._prefix,
+                    self._prefix_snapshot_meta(),
+                )
+                if loaded is not None:
+                    self._pool = loaded
             if self.mesh is not None:
                 from p2p_llm_tunnel_tpu.parallel.sharding import shard_kv_cache
 
@@ -518,6 +534,9 @@ class InferenceEngine:
         if self._task is not None:
             await self._task
             self._task = None
+        # Persist warm prompt KV before the executor goes away (reads the
+        # pool device arrays; must happen while XLA dispatch still works).
+        self.save_prefix_snapshot()
         if (self._spmd is not None and self._spmd.rank == 0
                 and not self._spmd_stop_sent):
             # Release the follower ranks blocked in spmd_follower_loop.
@@ -983,6 +1002,36 @@ class InferenceEngine:
         if not np.any(np.where(active, self._logprobs, 0)):
             lp_out = None
         return (sampled, lp_out), assign
+
+    def _prefix_snapshot_meta(self) -> dict:
+        """Compatibility pins for a prefix-pool snapshot: any mismatch means
+        the cached KV bytes are meaningless for this engine."""
+        return {
+            "model": self.mcfg.name,
+            "dtype": self.ecfg.dtype,
+            "quant": self.ecfg.quant,
+            "kv_quant": self.ecfg.kv_quant,
+            "seed": self.ecfg.seed,
+            "ckpt_path": self.ecfg.ckpt_path,
+            "block": self._prefix_block,
+            "capacity": self.ecfg.prefix_pool_blocks,
+        }
+
+    def save_prefix_snapshot(self) -> None:
+        if (self._prefix is None or not self.ecfg.prefix_cache_dir
+                or self._spmd is not None):
+            # Multi-host: every rank would need a coordinated save/load;
+            # skipped (snapshots are a single-host serve convenience).
+            return
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import save_pool_snapshot
+
+        try:
+            save_pool_snapshot(
+                self.ecfg.prefix_cache_dir, self._pool, self._prefix,
+                self._prefix_snapshot_meta(),
+            )
+        except OSError as e:
+            log.warning("prefix snapshot save failed: %s", e)
 
     def _ensure_decode_carry(self) -> None:
         """Lazily create the device-side decode carry — shared by rank-0

@@ -35,6 +35,8 @@ insert that might recycle the matched block.
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -65,11 +67,29 @@ class PrefixIndex:
     def __init__(self, block: int, capacity: int):
         assert capacity >= 2, "need at least scratch + one real block"
         self.block = block
+        self.capacity = capacity
         # Pool index 0 is the scratch block (insert-padding target).
         self._free: List[int] = list(range(1, capacity))
         self._lru: "OrderedDict[bytes, int]" = OrderedDict()  # key -> pool idx
         self.hits = 0
         self.lookups = 0
+
+    def export_state(self) -> List[List]:
+        """LRU-ordered [[hex key, pool idx], ...] (oldest first) for the
+        pool snapshot."""
+        return [[k.hex(), idx] for k, idx in self._lru.items()]
+
+    def import_state(self, entries: List[List]) -> None:
+        """Restore a snapshot's index; unreferenced pool slots become free."""
+        self._lru.clear()
+        used = set()
+        for khex, idx in entries:
+            idx = int(idx)
+            if not 1 <= idx < self.capacity:
+                continue  # stale snapshot from a larger pool
+            self._lru[bytes.fromhex(khex)] = idx
+            used.add(idx)
+        self._free = [i for i in range(1, self.capacity) if i not in used]
 
     def _keys_of(self, prompt_ids) -> List[bytes]:
         keys = []
@@ -134,6 +154,79 @@ class PrefixIndex:
             newly.add(key)
             out.append(idx)
         return out
+
+
+def save_pool_snapshot(
+    dirpath: str, pool: Dict[str, jnp.ndarray], index: PrefixIndex,
+    meta: Dict,
+) -> None:
+    """Persist the block pool + index so warm prompt KV survives a serve
+    restart (SURVEY §5's optional checkpoint clause, VERDICT r4 item 10).
+
+    Plain npz + json: the pool is a handful of dense host-shaped arrays
+    (~0.27 GB at 8B/128 blocks), not a sharded training state — orbax
+    machinery buys nothing here.  The manifest pins every compatibility
+    axis; loaders ignore any snapshot that doesn't match exactly."""
+    os.makedirs(dirpath, exist_ok=True)
+    # tmp + rename: a SIGKILL / full disk mid-write must leave either the
+    # old snapshot or none — never a truncated npz beside a valid manifest.
+    npz_tmp = os.path.join(dirpath, ".prefix_pool.npz.tmp")
+    with open(npz_tmp, "wb") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in pool.items()})
+    os.replace(npz_tmp, os.path.join(dirpath, "prefix_pool.npz"))
+    manifest = dict(meta, lru=index.export_state(), version=1)
+    man_tmp = os.path.join(dirpath, ".prefix_index.json.tmp")
+    with open(man_tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(man_tmp, os.path.join(dirpath, "prefix_index.json"))
+    log.info("prefix pool snapshot saved: %d blocks -> %s",
+             len(index._lru), dirpath)
+
+
+def load_pool_snapshot(
+    dirpath: str, pool: Dict[str, jnp.ndarray], index: PrefixIndex,
+    meta: Dict,
+) -> Optional[Dict[str, jnp.ndarray]]:
+    """Restore a snapshot into a freshly-initialised pool; None (and an
+    untouched index) when absent or incompatible."""
+    man_path = os.path.join(dirpath, "prefix_index.json")
+    npz_path = os.path.join(dirpath, "prefix_pool.npz")
+    if not (os.path.exists(man_path) and os.path.exists(npz_path)):
+        return None
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        log.warning("prefix snapshot unreadable (%s); starting cold", e)
+        return None
+    for key, want in meta.items():
+        if manifest.get(key) != want:
+            log.warning(
+                "prefix snapshot incompatible (%s: %r != %r); starting cold",
+                key, manifest.get(key), want,
+            )
+            return None
+    try:
+        npz = np.load(npz_path)
+        files = set(npz.files)
+    except Exception as e:  # BadZipFile/OSError/EOFError — corrupt file
+        log.warning("prefix snapshot unreadable (%s); starting cold", e)
+        return None
+    if files != set(pool):
+        log.warning("prefix snapshot leaves mismatch; starting cold")
+        return None
+    out = {}
+    for key, arr in pool.items():
+        loaded = npz[key]
+        if loaded.shape != arr.shape:
+            log.warning("prefix snapshot shape mismatch on %s; starting cold",
+                        key)
+            return None
+        out[key] = jnp.asarray(loaded, arr.dtype)
+    index.import_state(manifest.get("lru", []))
+    log.info("prefix pool snapshot restored: %d blocks from %s",
+             len(index._lru), dirpath)
+    return out
 
 
 def init_pool(kv_cache: Dict[str, jnp.ndarray], block: int, capacity: int):

@@ -68,7 +68,7 @@ def logprob_data(logits: jnp.ndarray, sampled: jnp.ndarray):
     return chosen, top_ids.astype(jnp.int32), top_vals - lse[:, None]
 
 
-def empty_logprob_data(batch: int, vocab_size: int = 10**9):
+def empty_logprob_data(batch: int, vocab_size: int):
     """Zero-filled logprob tuple, shape-matched to logprob_data for the
     lax.cond that selects between them."""
     w = lp_width(vocab_size)

@@ -1,6 +1,7 @@
 """CLI + supervisor tests: arg precedence and retry/backoff semantics."""
 
 import asyncio
+import os
 
 import pytest
 
@@ -156,3 +157,52 @@ def test_cli_engine_knobs_reach_engine_config(monkeypatch):
     assert cfg.prefill_act_quant and cfg.flash_decode
     assert cfg.sp == 2 and cfg.sp_mode == "ulysses"
     assert cfg.ep == 4 and cfg.tp == 2
+
+
+@pytest.mark.slow
+def test_sigterm_saves_prefix_snapshot(tmp_path):
+    """SIGTERM (docker stop / systemd) must take the graceful path: the
+    serve CLI snapshots its prefix pool before exiting, even mid-connect
+    (no peer ever joins here)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    snap = tmp_path / "snap"
+    env = dict(
+        os.environ, TUNNEL_JAX_PLATFORM="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "p2p_llm_tunnel_tpu.cli", "serve",
+         "--backend", "tpu", "--model", "tiny", "--slots", "2",
+         "--max-seq", "64", "--prefix-cache", "--prefix-cache-dir",
+         str(snap), "--signal", "ws://127.0.0.1:9/nowhere", "--room", "x"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
+    try:
+        # The engine is fully built before the signaling connect (which
+        # fails against the dead endpoint and enters backoff) — poll for
+        # the supervisor's backoff line in stderr.
+        deadline = time.monotonic() + 240
+        seen = b""
+        os.set_blocking(proc.stderr.fileno(), False)
+        while time.monotonic() < deadline:
+            chunk = proc.stderr.read() or b""
+            seen += chunk
+            if b"reconnecting in" in seen:
+                break
+            if proc.poll() is not None:
+                raise AssertionError(f"serve died early: {seen[-2000:]}")
+            time.sleep(1)
+        else:
+            raise AssertionError(f"serve never reached connect: {seen[-2000:]}")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert (snap / "prefix_index.json").exists(), "no snapshot after SIGTERM"
+    assert (snap / "prefix_pool.npz").exists()

@@ -370,6 +370,54 @@ def test_engine_prefix_reuse_exact_tokens():
     assert global_metrics.counter("engine_prefix_hit_tokens_total") > 0
 
 
+def test_prefix_pool_survives_engine_restart(tmp_path):
+    """VERDICT r4 item 10 / SURVEY §5's optional checkpoint clause: with a
+    prefix_cache_dir, a FRESH engine process hits KV cached by a previous
+    one — same tokens, nonzero hits on its very first request."""
+    prompt = list(b"You are a helpful assistant. Please answer: what?")
+    snap = str(tmp_path / "pfx")
+
+    def cfg():
+        return EngineConfig(
+            model="tiny", num_slots=4, max_seq=128, dtype="float32",
+            min_prefill_bucket=16, prefix_cache=True,
+            prefix_pool_blocks=16, prefix_cache_dir=snap,
+        )
+
+    async def serve_once():
+        eng = InferenceEngine(engine_cfg=cfg())
+        await eng.start()
+        out = []
+        async for ev in eng.generate(prompt, max_new_tokens=8, stop_ids=()):
+            out.append(ev.token_id)
+        hits = eng._prefix.hits
+        await eng.stop()  # saves the snapshot
+        return out, hits
+
+    out_a, hits_a = asyncio.run(serve_once())
+    assert hits_a == 0  # cold pool
+    out_b, hits_b = asyncio.run(serve_once())
+    assert hits_b >= 1  # warm from the snapshot, first request
+    assert out_b == out_a  # reused KV must not change tokens
+
+    # An incompatible engine (different seed => different weights) must
+    # refuse the snapshot instead of serving another model's KV.
+    from dataclasses import replace as dc_replace
+
+    async def other_seed():
+        eng = InferenceEngine(engine_cfg=dc_replace(cfg(), seed=1))
+        await eng.start()
+        out = []
+        async for ev in eng.generate(prompt, max_new_tokens=8, stop_ids=()):
+            out.append(ev.token_id)
+        hits = eng._prefix.hits
+        await eng.stop()
+        return out, hits
+
+    _, hits_c = asyncio.run(other_seed())
+    assert hits_c == 0
+
+
 def test_engine_prefix_shared_prefix_different_tails():
     """Distinct requests sharing a long prefix: every request's output must
     match its own no-cache run."""
