@@ -162,10 +162,11 @@ async def _run_attempt(model: str) -> dict:
     pf8 = (os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
            and quant == "int8")
     kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
-    # An int8 KV cache forces the einsum decode path; record what ran.
-    # BENCH_FLASH_SGRID implies flash decode (the S-gridded variant).
-    flash_sgrid = (os.environ.get("BENCH_FLASH_SGRID", "0") == "1"
-                   and kv_quant != "int8")
+    # BENCH_FLASH_SGRID implies flash decode (the S-gridded variant), and
+    # COMPOSES with an int8 KV cache (the kernel dequantizes in VMEM); the
+    # plane kernel still requires raw bf16 K/V, so an int8 cache forces
+    # the einsum path when only BENCH_FLASH_DECODE is set.
+    flash_sgrid = os.environ.get("BENCH_FLASH_SGRID", "0") == "1"
     flash_decode = flash_sgrid or (
         os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
         and kv_quant != "int8"

@@ -449,9 +449,15 @@ def main(argv: Optional[list] = None) -> None:
     # restarts, not just interactive ones.  And a process launched as a
     # background job of a non-interactive shell inherits SIGINT=ignore
     # (POSIX); restore the default so Ctrl+C-equivalents work there too.
-    _signal.signal(_signal.SIGTERM, _signal.default_int_handler)
+    got_sig = {"num": None}
+
+    def _graceful(signum, frame):
+        got_sig["num"] = signum
+        raise KeyboardInterrupt
+
+    _signal.signal(_signal.SIGTERM, _graceful)
     if _signal.getsignal(_signal.SIGINT) == _signal.SIG_IGN:
-        _signal.signal(_signal.SIGINT, _signal.default_int_handler)
+        _signal.signal(_signal.SIGINT, _graceful)
     args = build_parser().parse_args(argv)
     try:
         asyncio.run(_amain(args))
@@ -462,6 +468,11 @@ def main(argv: Optional[list] = None) -> None:
                 eng.save_prefix_snapshot()
             except Exception as e:  # best-effort on the exit path
                 log.warning("prefix snapshot on shutdown failed: %s", e)
+        if got_sig["num"] == _signal.SIGTERM:
+            # Die BY SIGTERM so supervisors (systemd SuccessExitStatus,
+            # docker) see a normal stop, not exit code 130.
+            _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+            os.kill(os.getpid(), _signal.SIGTERM)
         sys.exit(130)
 
 

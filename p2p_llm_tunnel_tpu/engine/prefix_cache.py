@@ -80,14 +80,21 @@ class PrefixIndex:
         return [[k.hex(), idx] for k, idx in self._lru.items()]
 
     def import_state(self, entries: List[List]) -> None:
-        """Restore a snapshot's index; unreferenced pool slots become free."""
+        """Restore a snapshot's index; unreferenced pool slots become free.
+        Malformed entries are skipped — a damaged manifest must degrade to
+        a (partially) cold pool, never crash engine startup."""
         self._lru.clear()
         used = set()
-        for khex, idx in entries:
-            idx = int(idx)
+        for entry in entries:
+            try:
+                khex, idx = entry
+                idx = int(idx)
+                key = bytes.fromhex(khex)
+            except (TypeError, ValueError):
+                continue
             if not 1 <= idx < self.capacity:
                 continue  # stale snapshot from a larger pool
-            self._lru[bytes.fromhex(khex)] = idx
+            self._lru[key] = idx
             used.add(idx)
         self._free = [i for i in range(1, self.capacity) if i not in used]
 
@@ -167,14 +174,23 @@ def save_pool_snapshot(
     (~0.27 GB at 8B/128 blocks), not a sharded training state — orbax
     machinery buys nothing here.  The manifest pins every compatibility
     axis; loaders ignore any snapshot that doesn't match exactly."""
+    import time
+
     os.makedirs(dirpath, exist_ok=True)
-    # tmp + rename: a SIGKILL / full disk mid-write must leave either the
-    # old snapshot or none — never a truncated npz beside a valid manifest.
+    # tmp + rename per file, PLUS a shared snap_id in both: a crash
+    # between the two renames must not pair new pool bytes with the old
+    # index (recycled block ids would silently serve another prompt's KV).
+    snap_id = f"{time.time_ns():x}"
     npz_tmp = os.path.join(dirpath, ".prefix_pool.npz.tmp")
     with open(npz_tmp, "wb") as f:
-        np.savez(f, **{k: np.asarray(v) for k, v in pool.items()})
+        np.savez(
+            f,
+            __snap_id__=np.frombuffer(snap_id.encode(), np.uint8),
+            **{k: np.asarray(v) for k, v in pool.items()},
+        )
     os.replace(npz_tmp, os.path.join(dirpath, "prefix_pool.npz"))
-    manifest = dict(meta, lru=index.export_state(), version=1)
+    manifest = dict(meta, lru=index.export_state(), version=1,
+                    snap_id=snap_id)
     man_tmp = os.path.join(dirpath, ".prefix_index.json.tmp")
     with open(man_tmp, "w") as f:
         json.dump(manifest, f)
@@ -199,6 +215,10 @@ def load_pool_snapshot(
     except (OSError, json.JSONDecodeError) as e:
         log.warning("prefix snapshot unreadable (%s); starting cold", e)
         return None
+    if manifest.get("version") != 1:
+        log.warning("prefix snapshot version %r unsupported; starting cold",
+                    manifest.get("version"))
+        return None
     for key, want in meta.items():
         if manifest.get(key) != want:
             log.warning(
@@ -209,10 +229,17 @@ def load_pool_snapshot(
     try:
         npz = np.load(npz_path)
         files = set(npz.files)
+        snap_id = bytes(npz["__snap_id__"]).decode()
     except Exception as e:  # BadZipFile/OSError/EOFError — corrupt file
         log.warning("prefix snapshot unreadable (%s); starting cold", e)
         return None
-    if files != set(pool):
+    if snap_id != manifest.get("snap_id"):
+        # Crash between the pool and manifest renames: the halves are from
+        # different saves and the index would point into the wrong blocks.
+        log.warning("prefix snapshot halves mismatch (%s != %s); "
+                    "starting cold", snap_id, manifest.get("snap_id"))
+        return None
+    if files - {"__snap_id__"} != set(pool):
         log.warning("prefix snapshot leaves mismatch; starting cold")
         return None
     out = {}

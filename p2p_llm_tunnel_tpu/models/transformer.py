@@ -604,27 +604,43 @@ def decode_step(
     #   large views must use the einsum path (or a future S-gridded kernel).
     quant = kv_cache_is_quantized(kv_cache)
     tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-    flash_common = (
+    flash_base = (
         cfg.flash_decode
-        and not quant  # kernel reads raw K/V; int8 cache takes the einsum path
         and (jax.default_backend() == "tpu" or cfg.flash_interpret)
         and tp == 1
         and kv_view % 128 == 0
         and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
     )
+    # int8 KV composes ONLY with the s-gridded kernel (it dequantizes in
+    # VMEM); the plane kernel and the legacy path read raw bf16.
+    use_sgrid_q = flash_base and cfg.flash_sgrid and quant
     # The S-gridded kernel has no view cap (per-block DMA); the plane
     # kernel must bound its whole-view staging to the VMEM budget.
-    use_sgrid = flash_common and cfg.flash_sgrid
+    use_sgrid = flash_base and cfg.flash_sgrid and not quant
     use_flash = (
-        flash_common and not use_sgrid
+        flash_base and not cfg.flash_sgrid and not quant
         and kv_view * cfg.head_dim <= 8192 * 128
     )
-    if use_sgrid:
+    if use_sgrid_q:
+        from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+            flash_decode_attention_sgrid_int8,
+        )
+
+        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
+            win = _layer_window(cfg, idx, s)
+            return flash_decode_attention_sgrid_int8(
+                q, k_l, v_l, k_s, v_s, positions,
+                scale=cfg.query_scale,
+                softcap=cfg.attn_softcap,
+                window=win,
+                interpret=cfg.flash_interpret,
+            )
+    elif use_sgrid:
         from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
             flash_decode_attention_sgrid,
         )
 
-        def attention(q, k_l, v_l, idx):
+        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
             win = _layer_window(cfg, idx, s)
             return flash_decode_attention_sgrid(
                 q, k_l, v_l, positions,
@@ -638,7 +654,7 @@ def decode_step(
             flash_decode_attention,
         )
 
-        def attention(q, k_l, v_l, idx):
+        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
             win = _layer_window(cfg, idx, s)
             return flash_decode_attention(
                 q, k_l, v_l, positions,
@@ -648,7 +664,7 @@ def decode_step(
                 interpret=cfg.flash_interpret,
             )
     else:
-        def attention(q, k_l, v_l, idx):
+        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
             return cached_attention(
                 q, k_l, v_l, positions,
                 scale=cfg.query_scale,
@@ -693,9 +709,19 @@ def decode_step(
                 cache["k_scale"], start[:4], sc_shape)[0]
             v_s = jax.lax.dynamic_slice(
                 cache["v_scale"], start[:4], sc_shape)[0]
-            k_l = (k_l.astype(jnp.float32) * k_s[..., None]).astype(x.dtype)
-            v_l = (v_l.astype(jnp.float32) * v_s[..., None]).astype(x.dtype)
-        attn = attention(q, k_l, v_l, idx)
+            if use_sgrid_q:
+                # Raw int8 K/V + scales go straight into the kernel, which
+                # dequantizes in VMEM — the bf16 plane never materializes
+                # in HBM (that was the whole einsum-path cost).
+                attn = attention(q, k_l, v_l, idx, k_s, v_s)
+            else:
+                k_l = (k_l.astype(jnp.float32)
+                       * k_s[..., None]).astype(x.dtype)
+                v_l = (v_l.astype(jnp.float32)
+                       * v_s[..., None]).astype(x.dtype)
+                attn = attention(q, k_l, v_l, idx)
+        else:
+            attn = attention(q, k_l, v_l, idx)
         attn = mm(attn.reshape(b, 1, -1), blk["wo"], cfg.act_quant)
         if cfg.post_norms:
             attn = _norm(cfg, attn, blk["post_attn_norm"])

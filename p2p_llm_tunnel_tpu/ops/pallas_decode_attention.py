@@ -173,19 +173,27 @@ def _decode_kernel_sgrid(
     pos_sref,  # scalar-prefetch [B] int32: per-slot query position
     win_sref,  # scalar-prefetch [1] int32: sliding window (S+1 = disabled)
     q_ref,  # [G, D] this (slot, kv-head)'s query group
-    k_ref,  # [BS, D] ONE s-block of this head's keys
+    k_ref,  # [BS, D] ONE s-block of this head's keys (bf16/f32 or int8)
     v_ref,  # [BS, D]
-    o_ref,  # [G, D]
-    m_sc,  # VMEM scratch [G, 128] running max (lane-replicated)
-    l_sc,  # VMEM scratch [G, 128] running denominator (lane-replicated)
-    acc_sc,  # VMEM scratch [G, D] running numerator
-    *,
+    *rest,  # quantized: (ks_ref [BS,1], vs_ref [BS,1], o, m, l, acc)
+    #         else:      (o, m, l, acc)
     scale: float,
     softcap: Optional[float],
     block_s: int,
     n_sblocks: int,
     out_dtype,
+    quantized: bool,
 ):
+    """ONE kernel for both the raw and int8-KV s-gridded variants — the
+    online-softmax/masking/frontier logic must never diverge between them.
+    ``quantized`` is a static python flag: the int8 path gets two extra
+    per-(token, head) scale refs and dequantizes in VMEM right after the
+    int8 DMA, composing kv_quant=int8's halved HBM traffic with the fused
+    kernel (pre-r5 the engine forced the einsum path for int8 KV)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, m_sc, l_sc, acc_sc = rest
     bi = pl.program_id(0)
     sj = pl.program_id(2)
     pos = pos_sref[bi]
@@ -206,6 +214,9 @@ def _decode_kernel_sgrid(
         q = q_ref[:].astype(jnp.float32) * scale
         k = k_ref[:].astype(jnp.float32)  # [BS, D]
         v = v_ref[:].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[:]
+            v = v * vs_ref[:]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [G, BS]
@@ -242,10 +253,12 @@ def _decode_kernel_sgrid(
 
 def flash_decode_attention_sgrid(
     q: jnp.ndarray,  # [B, 1, H, D]
-    k_cache: jnp.ndarray,  # [B, S, K, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D] (int8 when scales given)
     v_cache: jnp.ndarray,  # [B, S, K, D]
     q_positions: jnp.ndarray,  # [B] int32
     *,
+    k_scale: Optional[jnp.ndarray] = None,  # [B, S, K] f32 (int8 cache)
+    v_scale: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window=None,  # None | int | traced int scalar
@@ -258,10 +271,13 @@ def flash_decode_attention_sgrid(
     carry the online softmax across s-steps of one (slot, head).  Blocks
     past the slot's frontier resolve to the SAME block index as the
     frontier (scalar-prefetch clamp), so Pallas elides their fetch; their
-    compute is skipped with `pl.when`.
+    compute is skipped with `pl.when`.  With ``k_scale``/``v_scale`` the
+    cache is int8 and dequantized in VMEM.
     """
     b, t, h, d = q.shape
     assert t == 1, "decode step processes exactly one token per slot"
+    quantized = k_scale is not None
+    assert (v_scale is not None) == quantized
     s = k_cache.shape[1]
     kh = k_cache.shape[2]
     g = h // kh
@@ -291,6 +307,7 @@ def flash_decode_attention_sgrid(
         block_s=bs,
         n_sblocks=n_sb,
         out_dtype=q.dtype,
+        quantized=quantized,
     )
 
     def kv_index(bi, ki, sj, pos_r, win_r):
@@ -298,20 +315,32 @@ def flash_decode_attention_sgrid(
         # the previous step -> Pallas skips the DMA.
         return (bi, jnp.minimum(sj, pos_r[bi] // bs), ki, 0)
 
+    in_specs = [
+        pl.BlockSpec(
+            (None, None, g, d),
+            lambda bi, ki, sj, pos_r, win_r: (bi, ki, 0, 0),
+        ),
+        pl.BlockSpec((None, bs, None, d), kv_index),
+        pl.BlockSpec((None, bs, None, d), kv_index),
+    ]
+    operands = [pos, win, q_g, k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((None, bs, None, 1), kv_index),
+            pl.BlockSpec((None, bs, None, 1), kv_index),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32)[..., None],  # [B, S, K, 1]
+            v_scale.astype(jnp.float32)[..., None],
+        ]
+
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, kh, n_sb),
-            in_specs=[
-                pl.BlockSpec(
-                    (None, None, g, d),
-                    lambda bi, ki, sj, pos_r, win_r: (bi, ki, 0, 0),
-                ),
-                pl.BlockSpec((None, bs, None, d), kv_index),
-                pl.BlockSpec((None, bs, None, d), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (None, None, g, d),
                 lambda bi, ki, sj, pos_r, win_r: (bi, ki, 0, 0),
@@ -323,5 +352,21 @@ def flash_decode_attention_sgrid(
             ],
         ),
         interpret=interpret,
-    )(pos, win, q_g, k_cache, v_cache)
+    )(*operands)
     return out.reshape(b, 1, h, d)
+
+
+def flash_decode_attention_sgrid_int8(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """int8-KV convenience entry: delegates to the shared s-grid kernel."""
+    return flash_decode_attention_sgrid(
+        q, k_cache, v_cache, q_positions,
+        k_scale=k_scale, v_scale=v_scale, **kwargs,
+    )

@@ -48,6 +48,8 @@ GRID = [
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
     ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
     ("flash-sgrid", {"BENCH_FLASH_SGRID": "1"}),
+    # int8 KV + in-kernel dequant: the two decode-HBM levers composed.
+    ("kv8-sgrid", {"BENCH_KV_QUANT": "int8", "BENCH_FLASH_SGRID": "1"}),
     ("ctx2048", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                  "BENCH_CLIENTS": "16"}),
     ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",

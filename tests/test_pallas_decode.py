@@ -135,6 +135,62 @@ def test_sgrid_positions_gate_attendable_prefix():
     np.testing.assert_allclose(np.asarray(base), np.asarray(poisoned))
 
 
+def test_sgrid_int8_matches_dequant_oracle():
+    """int8-KV sgrid kernel vs cached_attention over the dequantized
+    cache — the exact arrays the einsum path would read."""
+    from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+        flash_decode_attention_sgrid_int8,
+    )
+    from p2p_llm_tunnel_tpu.models.transformer import _quant_kv
+
+    b, s, h, kh, d = 3, 512, 8, 2, 32
+    q, k, v = _mk(b, s, h, kh, d, seed=4)
+    k8, ks = _quant_kv(k)
+    v8, vs = _quant_kv(v)
+    kd = k8.astype(jnp.float32) * ks[..., None]
+    vd = v8.astype(jnp.float32) * vs[..., None]
+    pos = jnp.array([0, 100, 511], jnp.int32)
+    for kw in (dict(), dict(window=64), dict(softcap=20.0)):
+        want = cached_attention(q, kd, vd, pos, **kw)
+        got = flash_decode_attention_sgrid_int8(
+            q, k8, v8, ks, vs, pos, interpret=True, **kw
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-5, atol=3e-5, err_msg=str(kw))
+
+
+def test_full_model_decode_int8_sgrid_parity():
+    """decode_step: int8 KV + flash_sgrid (interpret) must reproduce the
+    int8-KV einsum path through the full tiny model."""
+    from dataclasses import replace
+
+    from p2p_llm_tunnel_tpu.models.config import get_config
+    from p2p_llm_tunnel_tpu.models.transformer import (
+        decode_step, init_kv_cache, init_params, prefill_into_cache,
+    )
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    fcfg = replace(cfg, flash_decode=True, flash_sgrid=True,
+                   flash_interpret=True)
+    cache = init_kv_cache(cfg, 2, 256, jnp.float32, quant=True)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0,
+                              cfg.vocab_size)
+    _, cache = prefill_into_cache(
+        cfg, params, jnp.pad(toks, ((0, 0), (0, 2))),
+        jnp.array([6]), cache, jnp.array([0]),
+    )
+    cache_f = jax.tree.map(lambda x: x, cache)
+    step_tokens = jnp.full((2,), 3, jnp.int32)
+    step_pos = jnp.full((2,), 6, jnp.int32)
+    ref, _ = decode_step(cfg, params, cache, step_tokens, step_pos,
+                         kv_view=128)
+    got, _ = decode_step(fcfg, params, cache_f, step_tokens, step_pos,
+                         kv_view=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_full_model_decode_flash_parity():
     """decode_step with flash_decode (interpret) must reproduce the einsum
     path exactly through the full tiny model, including gemma-2 windows."""

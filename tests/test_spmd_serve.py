@@ -52,6 +52,7 @@ WORKER = textwrap.dedent("""\
         engine_cfg=EngineConfig(
             model="tiny", num_slots=2, max_seq=64, dtype="float32",
             seed=0, decode_steps=4, decode_steps_eager=0, prefill_rows=2,
+            prefix_cache=True, prefix_pool_blocks=8, min_prefill_bucket=16,
         ),
         mesh=mesh,
     )
@@ -59,7 +60,7 @@ WORKER = textwrap.dedent("""\
     async def lead():
         await engine.start()
         outs = []
-        for prompt in ([1, 2, 3, 4], [9, 8, 7]):
+        for prompt in (list(range(1, 25)), list(range(1, 25)), [9, 8, 7]):
             toks = []
             async for ev in engine.generate(
                 prompt, max_new_tokens=6, stop_ids=()
@@ -67,6 +68,7 @@ WORKER = textwrap.dedent("""\
                 toks.append(ev.token_id)
             outs.append(toks)
         await engine.stop()
+        assert engine._prefix.hits >= 1, "prefix cache never hit"
         print("RESULT " + json.dumps(outs), flush=True)
 
     if rank == 0:
@@ -93,6 +95,7 @@ ORACLE = textwrap.dedent("""\
         engine_cfg=EngineConfig(
             model="tiny", num_slots=2, max_seq=64, dtype="float32",
             seed=0, decode_steps=4, decode_steps_eager=0, prefill_rows=2,
+            prefix_cache=True, prefix_pool_blocks=8, min_prefill_bucket=16,
         ),
         mesh=mesh,
     )
@@ -100,7 +103,7 @@ ORACLE = textwrap.dedent("""\
     async def run():
         await engine.start()
         outs = []
-        for prompt in ([1, 2, 3, 4], [9, 8, 7]):
+        for prompt in (list(range(1, 25)), list(range(1, 25)), [9, 8, 7]):
             toks = []
             async for ev in engine.generate(
                 prompt, max_new_tokens=6, stop_ids=()
