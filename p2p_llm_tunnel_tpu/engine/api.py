@@ -11,7 +11,10 @@ Surfaces (BASELINE.md configs):
   presence_penalty over generated tokens, string `stop` sequences with
   boundary-safe matching, logprobs/top_logprobs — chat shape + legacy
   completions shape — stream_options.include_usage, legacy `echo` with
-  prompt logprobs incl. max_tokens=0 pure scoring, and ignore_eos)
+  prompt logprobs incl. max_tokens=0 pure scoring, ignore_eos, `n`
+  samples per prompt, and batched legacy prompts: list of strings /
+  token ids / token-id lists, each choice indexed, all generations
+  sharing one continuous batch)
 - Ollama: GET /api/tags, /api/version, POST /api/show, /api/generate,
   /api/chat (NDJSON streaming; options.stop)
 - GET /health
@@ -121,11 +124,12 @@ def _legacy_lp_obj(tokenizer, events, n_top: int) -> dict:
     }
 
 
-def _usage(prompt_ids, n_tokens: int) -> dict:
+def _usage(prompt_tokens: int, n_tokens: int) -> dict:
+    """The one place the usage shape lives (all three response paths)."""
     return {
-        "prompt_tokens": len(prompt_ids),
+        "prompt_tokens": prompt_tokens,
         "completion_tokens": n_tokens,
-        "total_tokens": len(prompt_ids) + n_tokens,
+        "total_tokens": prompt_tokens + n_tokens,
     }
 
 
@@ -417,13 +421,153 @@ class EngineAPI:
                 "id": completion_id, "object": object_name,
                 "created": created, "model": self.model_name,
                 "choices": [],
-                "usage": _usage(prompt_ids, n_tokens),
+                "usage": _usage(len(prompt_ids), n_tokens),
             }) + "\n\n").encode()
         yield b"data: [DONE]\n\n"
 
-    async def _openai_complete(self, prompt_ids, kwargs, stops, n_top: int,
-                               chat: bool, echo: bool = False,
-                               score_only: bool = False):
+    def _parse_prompts(self, raw) -> list:
+        """OpenAI legacy ``prompt``: str | [str, ...] | [int, ...] |
+        [[int, ...], ...] -> list of token-id prompts (one completion
+        choice per entry × n).  Token-id forms serve pre-tokenized
+        clients (lm-eval loglikelihood batches); only THEY get the vocab
+        range check — server-tokenized ids are valid by construction."""
+        enc = self.engine.tokenizer.encode
+
+        def ints(xs):
+            return xs and all(
+                isinstance(t, int) and not isinstance(t, bool) for t in xs
+            )
+
+        def checked(ids):
+            vocab = self.engine.mcfg.vocab_size
+            if ids and not 0 <= min(ids) <= max(ids) < vocab:
+                raise ValueError(f"token ids outside vocab [0, {vocab})")
+            return list(ids)
+
+        if isinstance(raw, str):
+            return [enc(raw)]
+        if isinstance(raw, list):
+            if not raw:
+                raise ValueError("prompt must be non-empty")
+            if all(isinstance(x, str) for x in raw):
+                return [enc(x) for x in raw]
+            if ints(raw):
+                return [checked(raw)]
+            if all(isinstance(x, list) and ints(x) for x in raw):
+                return [checked(x) for x in raw]
+        raise ValueError(
+            "prompt must be a string, list of strings, list of token ids, "
+            "or list of token-id lists"
+        )
+
+    async def _openai_stream_multi(
+        self, prompts, n, kwargs, stops, n_top: int, chat: bool,
+        object_name: str, completion_id: str, include_usage: bool,
+    ) -> AsyncIterator[bytes]:
+        """Merged SSE stream over multiple (prompt, sample) runs.
+
+        Every chunk carries its choice ``index``; chunks interleave across
+        choices in token-arrival order (the runs share the continuous
+        batch), per-choice order is preserved.  The single-run path keeps
+        the envelope-folded `_openai_stream` — this generator trades that
+        micro-optimization for generality."""
+        import asyncio as _aio
+
+        created = int(time.time())
+        runs = [pids for pids in prompts for _ in range(n)]
+        queue: "_aio.Queue" = _aio.Queue()
+
+        async def pump(i, pids):
+            try:
+                async for item in self._events(pids, kwargs, stops):
+                    await queue.put((i, item))
+            finally:
+                await queue.put((i, None))
+
+        tasks = [
+            _aio.create_task(pump(i, pids)) for i, pids in enumerate(runs)
+        ]
+        tok = self.engine.tokenizer
+
+        def chunk_of(choice, usage=None):
+            obj = {
+                "id": completion_id, "object": object_name,
+                "created": created, "model": self.model_name,
+                "choices": [choice] if choice is not None else [],
+            }
+            if include_usage:
+                obj["usage"] = usage
+            return ("data: " + json.dumps(obj) + "\n\n").encode()
+
+        def lp_obj_of(events):
+            if chat:
+                return {"content": [_lp_entry(tok, e, n_top) for e in events]}
+            return _legacy_lp_obj(tok, events, n_top)
+
+        first = [True] * len(runs)
+        finish_of = ["stop"] * len(runs)
+        pending_lp = [[] for _ in runs]
+        n_tokens = 0
+        live = len(runs)
+        try:
+            while live:
+                i, item = await queue.get()
+                if item is None:
+                    live -= 1
+                    lps = pending_lp[i]
+                    if chat:
+                        c = {"index": i, "delta": {},
+                             "finish_reason": finish_of[i]}
+                        if lps:
+                            c["logprobs"] = lp_obj_of(lps)
+                    else:
+                        c = {"index": i, "text": "",
+                             "logprobs": lp_obj_of(lps) if lps else None,
+                             "finish_reason": finish_of[i]}
+                    yield chunk_of(c)
+                    continue
+                text, ev, finish = item
+                if ev is not None:
+                    n_tokens += 1
+                    if ev.logprob is not None:
+                        pending_lp[i].append(ev)
+                if first[i]:
+                    first[i] = False
+                    if chat:
+                        yield chunk_of({"index": i,
+                                        "delta": {"role": "assistant"},
+                                        "finish_reason": None})
+                if finish is not None:
+                    finish_of[i] = finish
+                if text:
+                    lps = pending_lp[i]
+                    pending_lp[i] = []
+                    if chat:
+                        c = {"index": i, "delta": {"content": text},
+                             "finish_reason": None}
+                        if lps:
+                            c["logprobs"] = lp_obj_of(lps)
+                    else:
+                        c = {"index": i, "text": text,
+                             "logprobs": lp_obj_of(lps) if lps else None,
+                             "finish_reason": None}
+                    yield chunk_of(c)
+            if include_usage:
+                pt = sum(len(p) for p in prompts)
+                yield chunk_of(None, usage=_usage(pt, n_tokens))
+            yield b"data: [DONE]\n\n"
+        finally:
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                try:
+                    await t
+                except BaseException:
+                    pass
+
+    async def _collect(self, prompt_ids, kwargs, stops, score_only=False):
+        """Drain one generation: (content, finish, lp_entries, prompt_lps,
+        n_tokens)."""
         parts = []
         finish_reason = "stop"
         n_tokens = 0
@@ -444,54 +588,88 @@ class EngineAPI:
             # exists only to drive the engine; the response omits it.
             parts, lp_entries, n_tokens = [], [], 0
             finish_reason = "length"
-        content = "".join(parts)
-        usage = _usage(prompt_ids, n_tokens)
+        return "".join(parts), finish_reason, lp_entries, prompt_lps, n_tokens
+
+    async def _openai_complete(self, prompts, kwargs, stops, n_top: int,
+                               chat: bool, echo: bool = False,
+                               score_only: bool = False, n: int = 1):
+        """Non-stream completion over one or more prompts × n samples.
+
+        ``prompts`` is a list of token-id prompts; choice ``index`` runs
+        prompt-major then sample (OpenAI semantics for list prompts + n).
+        All generations run CONCURRENTLY through the continuous batch —
+        a 4-prompt lm-eval style request occupies 4 slots of one burst,
+        not 4 sequential round-trips."""
+        import asyncio as _aio
+
+        runs = [pids for pids in prompts for _ in range(n)]
+        tasks = [
+            _aio.ensure_future(self._collect(pids, kwargs, stops, score_only))
+            for pids in runs
+        ]
+        try:
+            results = await _aio.gather(*tasks)
+        except BaseException:
+            # One run failing must not leave siblings generating into the
+            # void (they hold batch slots); the stream path's finally does
+            # the same for its pump tasks.
+            for t in tasks:
+                t.cancel()
+            await _aio.gather(*tasks, return_exceptions=True)
+            raise
         tok = self.engine.tokenizer
         lp_requested = kwargs.get("logprobs", 0) > 0
-        if chat:
-            choice = {
-                "index": 0,
-                "message": {"role": "assistant", "content": content},
-                "finish_reason": finish_reason,
-            }
-            if lp_requested:
-                # Always present when requested — possibly with an empty
-                # list (e.g. single stop-token generation), never missing.
-                choice["logprobs"] = {"content": [
-                    _lp_entry(tok, e, n_top) for e in lp_entries
-                ]}
-            obj_name = "chat.completion"
-        else:
-            if echo:
-                # Legacy echo: the response text begins with the prompt.
-                content = tok.decode(list(prompt_ids)) + content
-            choice = {"index": 0, "text": content, "finish_reason": finish_reason}
-            if lp_requested:
-                lp_obj = _legacy_lp_obj(tok, lp_entries, n_top)
-                if echo and prompt_lps is not None:
-                    # Prepend the prompt tokens' scores: the first prompt
-                    # token has no context -> null, matching OpenAI; no
-                    # alternatives are reported for prompt positions.
-                    lp_obj = {
-                        "tokens": [tok.decode_token(t) for t in prompt_ids]
-                        + lp_obj["tokens"],
-                        "token_logprobs": [None] + [
-                            float(x) for x in prompt_lps[1:]
-                        ] + lp_obj["token_logprobs"],
-                        "top_logprobs": [None] * len(prompt_ids)
-                        + lp_obj["top_logprobs"],
-                    }
-                choice["logprobs"] = lp_obj
-            obj_name = "text_completion"
+        choices = []
+        total_new = 0
+        for i, (pids, (content, finish_reason, lp_entries, prompt_lps,
+                       n_tokens)) in enumerate(zip(runs, results)):
+            total_new += n_tokens
+            if chat:
+                choice = {
+                    "index": i,
+                    "message": {"role": "assistant", "content": content},
+                    "finish_reason": finish_reason,
+                }
+                if lp_requested:
+                    # Always present when requested — possibly with an
+                    # empty list, never missing.
+                    choice["logprobs"] = {"content": [
+                        _lp_entry(tok, e, n_top) for e in lp_entries
+                    ]}
+            else:
+                if echo:
+                    # Legacy echo: the response text begins with the prompt.
+                    content = tok.decode(list(pids)) + content
+                choice = {"index": i, "text": content,
+                          "finish_reason": finish_reason}
+                if lp_requested:
+                    lp_obj = _legacy_lp_obj(tok, lp_entries, n_top)
+                    if echo and prompt_lps is not None:
+                        # Prepend the prompt tokens' scores: the first
+                        # prompt token has no context -> null, matching
+                        # OpenAI; no alternatives for prompt positions.
+                        lp_obj = {
+                            "tokens": [tok.decode_token(t) for t in pids]
+                            + lp_obj["tokens"],
+                            "token_logprobs": [None] + [
+                                float(x) for x in prompt_lps[1:]
+                            ] + lp_obj["token_logprobs"],
+                            "top_logprobs": [None] * len(pids)
+                            + lp_obj["top_logprobs"],
+                        }
+                    choice["logprobs"] = lp_obj
+            choices.append(choice)
+        # Usage counts each submitted prompt once (n samples share it).
+        prompt_tokens = sum(len(p) for p in prompts)
         return _json_response(
             200,
             {
                 "id": f"cmpl-{int(time.time() * 1000)}",
-                "object": obj_name,
+                "object": "chat.completion" if chat else "text_completion",
                 "created": int(time.time()),
                 "model": self.model_name,
-                "choices": [choice],
-                "usage": usage,
+                "choices": choices,
+                "usage": _usage(prompt_tokens, total_new),
             },
         )
 
@@ -594,6 +772,13 @@ class EngineAPI:
                 isinstance(stream_opts, dict)
                 and stream_opts.get("include_usage")
             )
+            raw_n = payload.get("n")
+            n_choices = 1 if raw_n is None else int(raw_n)
+            if not 1 <= n_choices <= 16:
+                return _error(400, "n must be in [1, 16]")
+            # Total per-request fan-out cap (prompts x n): the batched
+            # prompt-list dimension must not escape the bound n has.
+            max_fanout = 16
 
             if path == "/v1/chat/completions":
                 if echo:
@@ -605,18 +790,30 @@ class EngineAPI:
                 self._check_prompt(prompt_ids)
                 if stream:
                     cid = f"chatcmpl-{int(time.time() * 1000)}"
-                    return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, stops, n_top, True,
-                        "chat.completion.chunk", cid, include_usage,
+                    if n_choices == 1:
+                        return 200, dict(_SSE), self._openai_stream(
+                            prompt_ids, kwargs, stops, n_top, True,
+                            "chat.completion.chunk", cid, include_usage,
+                        )
+                    return 200, dict(_SSE), self._openai_stream_multi(
+                        [prompt_ids], n_choices, kwargs, stops, n_top,
+                        True, "chat.completion.chunk", cid, include_usage,
                     )
-                return await self._openai_complete(prompt_ids, kwargs, stops, n_top, chat=True)
+                return await self._openai_complete(
+                    [prompt_ids], kwargs, stops, n_top, chat=True,
+                    n=n_choices,
+                )
 
             if path == "/v1/completions":
-                prompt = payload.get("prompt", "")
-                if isinstance(prompt, list):
-                    prompt = "".join(prompt)
-                prompt_ids = self.engine.tokenizer.encode(str(prompt))
-                self._check_prompt(prompt_ids)
+                prompts = self._parse_prompts(payload.get("prompt", ""))
+                if len(prompts) * n_choices > max_fanout:
+                    return _error(
+                        400,
+                        f"prompts x n = {len(prompts) * n_choices} exceeds "
+                        f"the per-request completion cap of {max_fanout}",
+                    )
+                for pids in prompts:
+                    self._check_prompt(pids)
                 if stream:
                     if echo:
                         return _error(
@@ -625,8 +822,13 @@ class EngineAPI:
                     cid = f"cmpl-{int(time.time() * 1000)}"
                     # OpenAI legacy streams keep object "text_completion"
                     # (there is no ".chunk" variant in the legacy spec).
-                    return 200, dict(_SSE), self._openai_stream(
-                        prompt_ids, kwargs, stops, n_top, False,
+                    if len(prompts) == 1 and n_choices == 1:
+                        return 200, dict(_SSE), self._openai_stream(
+                            prompts[0], kwargs, stops, n_top, False,
+                            "text_completion", cid, include_usage,
+                        )
+                    return 200, dict(_SSE), self._openai_stream_multi(
+                        prompts, n_choices, kwargs, stops, n_top, False,
                         "text_completion", cid, include_usage,
                     )
                 if echo:
@@ -637,8 +839,8 @@ class EngineAPI:
                         kwargs, echo_logprobs=kwargs["logprobs"] > 0,
                     )
                 return await self._openai_complete(
-                    prompt_ids, kwargs, stops, n_top, chat=False, echo=echo,
-                    score_only=score_only,
+                    prompts, kwargs, stops, n_top, chat=False, echo=echo,
+                    score_only=score_only, n=n_choices,
                 )
 
             if path == "/api/generate":

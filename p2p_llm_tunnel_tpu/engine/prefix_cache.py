@@ -92,8 +92,11 @@ class PrefixIndex:
                 key = bytes.fromhex(khex)
             except (TypeError, ValueError):
                 continue
-            if not 1 <= idx < self.capacity:
-                continue  # stale snapshot from a larger pool
+            if not 1 <= idx < self.capacity or idx in used:
+                # Out-of-range (larger pool) or duplicate index (damaged
+                # manifest): admitting it would alias two prefix keys to
+                # one KV block — another prompt's cache served silently.
+                continue
             self._lru[key] = idx
             used.add(idx)
         self._free = [i for i in range(1, self.capacity) if i not in used]
@@ -189,7 +192,7 @@ def save_pool_snapshot(
             **{k: np.asarray(v) for k, v in pool.items()},
         )
     os.replace(npz_tmp, os.path.join(dirpath, "prefix_pool.npz"))
-    manifest = dict(meta, lru=index.export_state(), version=1,
+    manifest = dict(meta, lru=index.export_state(), version=2,
                     snap_id=snap_id)
     man_tmp = os.path.join(dirpath, ".prefix_index.json.tmp")
     with open(man_tmp, "w") as f:
@@ -215,8 +218,8 @@ def load_pool_snapshot(
     except (OSError, json.JSONDecodeError) as e:
         log.warning("prefix snapshot unreadable (%s); starting cold", e)
         return None
-    if manifest.get("version") != 1:
-        log.warning("prefix snapshot version %r unsupported; starting cold",
+    if manifest.get("version") != 2:
+        log.warning("prefix snapshot version %r unsupported (current: 2); starting cold",
                     manifest.get("version"))
         return None
     for key, want in meta.items():

@@ -1,0 +1,167 @@
+"""OpenAI `n` samples + batched legacy prompts (list/token-id forms).
+
+Every multi-choice request must decompose into exactly the single-choice
+results: choice i of a batched request equals the lone choice of the
+corresponding individual request (greedy determinism makes this exact),
+and the stream shape carries per-choice indices.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints import http11
+from tests.test_engine_tunnel import engine_stack
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+
+async def _post(base, path, payload):
+    resp = await http11.http_request(
+        "POST", f"{base}{path}", {"content-type": "application/json"},
+        json.dumps(payload).encode(), timeout=60.0,
+    )
+    body = await resp.read_all()
+    return resp.status, body
+
+
+def test_batched_prompts_match_individual_runs():
+    async def run():
+        async with engine_stack() as (base, _):
+            singles = []
+            for p in ("abc", "xyz"):
+                status, body = await _post(base, "/v1/completions", {
+                    "prompt": p, "max_tokens": 4, "stream": False,
+                })
+                assert status == 200
+                singles.append(json.loads(body)["choices"][0]["text"])
+            status, body = await _post(base, "/v1/completions", {
+                "prompt": ["abc", "xyz"], "max_tokens": 4, "stream": False,
+            })
+            assert status == 200
+            obj = json.loads(body)
+            assert [c["index"] for c in obj["choices"]] == [0, 1]
+            assert [c["text"] for c in obj["choices"]] == singles
+            # usage counts both prompts
+            assert obj["usage"]["prompt_tokens"] == 6
+            assert obj["usage"]["completion_tokens"] >= 2
+
+    asyncio.run(run())
+
+
+def test_token_id_prompt_equals_string_prompt():
+    async def run():
+        async with engine_stack() as (base, engine):
+            ids = engine.tokenizer.encode("abc")
+            _, body_s = await _post(base, "/v1/completions", {
+                "prompt": "abc", "max_tokens": 4, "stream": False,
+            })
+            _, body_t = await _post(base, "/v1/completions", {
+                "prompt": ids, "max_tokens": 4, "stream": False,
+            })
+            assert (json.loads(body_s)["choices"][0]["text"]
+                    == json.loads(body_t)["choices"][0]["text"])
+            # list-of-lists form, batched
+            status, body = await _post(base, "/v1/completions", {
+                "prompt": [ids, ids], "max_tokens": 4, "stream": False,
+            })
+            obj = json.loads(body)
+            assert status == 200 and len(obj["choices"]) == 2
+            assert (obj["choices"][0]["text"]
+                    == json.loads(body_s)["choices"][0]["text"])
+
+    asyncio.run(run())
+
+
+def test_n_samples_greedy_identical_and_validated():
+    async def run():
+        async with engine_stack() as (base, _):
+            status, body = await _post(base, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "stream": False, "n": 3,
+            })
+            assert status == 200
+            obj = json.loads(body)
+            assert [c["index"] for c in obj["choices"]] == [0, 1, 2]
+            texts = [c["message"]["content"] for c in obj["choices"]]
+            assert texts[0] == texts[1] == texts[2]  # greedy
+            # prompt counted once, completions summed
+            assert obj["usage"]["completion_tokens"] >= 3
+
+            status, _ = await _post(base, "/v1/completions", {
+                "prompt": "a", "n": 0,
+            })
+            assert status == 400
+            status, _ = await _post(base, "/v1/completions", {
+                "prompt": [1, "a"], "max_tokens": 2,
+            })
+            assert status == 400
+            status, _ = await _post(base, "/v1/completions", {
+                "prompt": [999999], "max_tokens": 2,
+            })
+            assert status == 400  # out-of-vocab token id
+
+    asyncio.run(run())
+
+
+def test_multi_prompt_stream_indices_and_equivalence():
+    async def run():
+        async with engine_stack() as (base, _):
+            status, body = await _post(base, "/v1/completions", {
+                "prompt": ["abc", "xyz"], "max_tokens": 4, "stream": True,
+                "stream_options": {"include_usage": True},
+            })
+            assert status == 200
+            assert body.strip().endswith(b"data: [DONE]")
+            lines = [l for l in body.split(b"\n\n")
+                     if l.startswith(b"data:") and b"[DONE]" not in l]
+            chunks = [json.loads(l[len(b"data: "):]) for l in lines]
+            texts = {0: "", 1: ""}
+            finishes = {}
+            for c in chunks:
+                assert c["object"] == "text_completion"
+                for ch in c["choices"]:
+                    assert "delta" not in ch
+                    texts[ch["index"]] += ch["text"]
+                    if ch["finish_reason"] is not None:
+                        finishes[ch["index"]] = ch["finish_reason"]
+            assert set(finishes) == {0, 1}
+            usage = chunks[-1]
+            assert usage["choices"] == []
+            assert usage["usage"]["prompt_tokens"] == 6
+
+            # Per-index stream text equals the non-stream batch.
+            _, body_ns = await _post(base, "/v1/completions", {
+                "prompt": ["abc", "xyz"], "max_tokens": 4, "stream": False,
+            })
+            obj = json.loads(body_ns)
+            assert texts[0] == obj["choices"][0]["text"]
+            assert texts[1] == obj["choices"][1]["text"]
+
+    asyncio.run(run())
+
+
+def test_chat_stream_n2_role_chunks_per_index():
+    async def run():
+        async with engine_stack() as (base, _):
+            status, body = await _post(base, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "q"}],
+                "max_tokens": 3, "stream": True, "n": 2,
+            })
+            assert status == 200
+            lines = [l for l in body.split(b"\n\n")
+                     if l.startswith(b"data:") and b"[DONE]" not in l]
+            chunks = [json.loads(l[len(b"data: "):]) for l in lines]
+            roles = [c["choices"][0]["index"] for c in chunks
+                     if c["choices"]
+                     and c["choices"][0]["delta"].get("role")]
+            assert sorted(roles) == [0, 1]
+            finishes = {c["choices"][0]["index"]
+                        for c in chunks if c["choices"]
+                        and c["choices"][0]["finish_reason"] is not None}
+            assert finishes == {0, 1}
+
+    asyncio.run(run())
