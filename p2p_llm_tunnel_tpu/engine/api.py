@@ -164,10 +164,32 @@ class EngineAPI:
         ALTERNATIVES the response should render per token — distinct from
         the engine gate (kwargs['logprobs']), which is >=1 whenever any
         logprob reporting is on (the chosen-token logprob needs the device
-        computation even with zero alternatives requested)."""
+        computation even with zero alternatives requested).
+
+        Ollama clients nest their sampling knobs under ``options`` (the
+        Modelfile parameter names); those are honored as fallbacks so
+        /api/generate and /api/chat behave like a real Ollama upstream
+        (num_predict/temperature/top_k/top_p — options.stop is handled in
+        _stop_strings).  Top-level OpenAI names win when both are given."""
+        opts = body.get("options")
+        opts = opts if isinstance(opts, dict) else {}
+
+        def field(name, ollama_name=None):
+            v = body.get(name)
+            return opts.get(ollama_name or name) if v is None else v
+
         max_tokens = body.get("max_tokens")
         if max_tokens is None:
             max_tokens = body.get("max_new_tokens")
+        if max_tokens is None:
+            np_opt = opts.get("num_predict")
+            if np_opt is not None and int(np_opt) < 0:
+                # Ollama sentinels: -1 = unlimited, -2 = fill context.
+                # Both mean "up to the context bound" here (the engine
+                # stops at max_seq regardless).
+                max_tokens = self.engine.ecfg.max_seq
+            else:
+                max_tokens = np_opt
         max_tokens = 64 if max_tokens is None else int(max_tokens)
         # max_tokens=0 is the pure-scoring form (lm-eval-harness style
         # loglikelihood: prompt + echo + logprobs, no generation); the
@@ -179,7 +201,7 @@ class EngineAPI:
             raise ValueError(
                 "max_tokens must be >= 1 (0 is allowed only with echo)"
             )
-        temperature = float(body.get("temperature") or 0.0)
+        temperature = float(field("temperature") or 0.0)
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
         freq_pen = float(body.get("frequency_penalty") or 0.0)
@@ -215,8 +237,8 @@ class EngineAPI:
         kwargs = dict(
             max_new_tokens=max_tokens,
             temperature=temperature,
-            top_k=int(body.get("top_k") or 0),
-            top_p=float(body.get("top_p") if body.get("top_p") is not None else 1.0),
+            top_k=int(field("top_k") or 0),
+            top_p=float(field("top_p") if field("top_p") is not None else 1.0),
             freq_pen=freq_pen,
             pres_pen=pres_pen,
             logprobs=n_lp,

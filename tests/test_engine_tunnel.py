@@ -190,6 +190,50 @@ def test_ollama_generate_ndjson_stream():
     asyncio.run(run())
 
 
+def test_ollama_options_sampling_knobs():
+    """Ollama nests sampling knobs under ``options`` (Modelfile names);
+    num_predict must bound generation and nested temperature/top_k must
+    be honored — a real Ollama upstream behaves this way, so engine mode
+    must too."""
+    async def run():
+        async with engine_stack() as (base, _):
+            payload = json.dumps({
+                "prompt": "abc", "stream": False,
+                "options": {"num_predict": 3, "temperature": 0.0},
+            }).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/api/generate", {}, payload, timeout=60.0
+            )
+            obj = json.loads(await resp.read_all())
+            assert resp.status == 200
+            assert obj["eval_count"] == 3
+            assert obj["done_reason"] == "length"
+            # top-level OpenAI name wins over the nested Ollama one
+            payload = json.dumps({
+                "prompt": "abc", "stream": False, "max_tokens": 2,
+                "options": {"num_predict": 9},
+            }).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/api/generate", {}, payload, timeout=60.0
+            )
+            obj = json.loads(await resp.read_all())
+            assert obj["eval_count"] == 2
+            # Ollama sentinel: num_predict -1 = unlimited -> context bound,
+            # never a 400 (ollama-python sends it by default).
+            payload = json.dumps({
+                "prompt": "abc", "stream": False,
+                "options": {"num_predict": -1},
+            }).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/api/generate", {}, payload, timeout=60.0
+            )
+            obj = json.loads(await resp.read_all())
+            assert resp.status == 200
+            assert obj["eval_count"] >= 1
+
+    asyncio.run(run())
+
+
 def test_ollama_tags():
     async def run():
         async with engine_stack() as (base, _):
