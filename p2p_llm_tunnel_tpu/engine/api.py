@@ -245,6 +245,9 @@ class EngineAPI:
         )
         if body.get("ignore_eos"):  # vLLM-style benchmarking knob
             kwargs["stop_ids"] = ()
+        seed = field("seed")  # OpenAI `seed` / Ollama options.seed
+        if seed is not None:
+            kwargs["seed"] = int(seed)
         return kwargs, n_top, echo, score_only
 
     @staticmethod
@@ -499,9 +502,15 @@ class EngineAPI:
         runs = [pids for pids in prompts for _ in range(n)]
         queue: "_aio.Queue" = _aio.Queue()
 
+        def run_kwargs(i):
+            # Same per-run seed offsetting as the non-stream path.
+            if "seed" not in kwargs or len(runs) == 1:
+                return kwargs
+            return dict(kwargs, seed=kwargs["seed"] + i)
+
         async def pump(i, pids):
             try:
-                async for item in self._events(pids, kwargs, stops):
+                async for item in self._events(pids, run_kwargs(i), stops):
                     await queue.put((i, item))
             finally:
                 await queue.put((i, None))
@@ -625,9 +634,19 @@ class EngineAPI:
         import asyncio as _aio
 
         runs = [pids for pids in prompts for _ in range(n)]
+
+        def run_kwargs(i):
+            # An explicit seed must still yield DISTINCT choices across the
+            # fan-out: offset it per run (same rule as the stream path).
+            if "seed" not in kwargs or len(runs) == 1:
+                return kwargs
+            return dict(kwargs, seed=kwargs["seed"] + i)
+
         tasks = [
-            _aio.ensure_future(self._collect(pids, kwargs, stops, score_only))
-            for pids in runs
+            _aio.ensure_future(
+                self._collect(pids, run_kwargs(i), stops, score_only)
+            )
+            for i, pids in enumerate(runs)
         ]
         try:
             results = await _aio.gather(*tasks)
@@ -780,6 +799,22 @@ class EngineAPI:
             payload = json.loads(body) if body else {}
         except json.JSONDecodeError as e:
             return _error(400, f"invalid JSON body: {e}")
+
+        opts_np = payload.get("options")
+        opts_np = opts_np.get("num_predict") if isinstance(opts_np, dict) \
+            else None
+        if path in ("/api/generate", "/api/chat") and opts_np == 0:
+            # Ollama semantics: num_predict 0 generates nothing (a real
+            # upstream 200s with eval_count 0; our engine needs >=1 token,
+            # so short-circuit before _gen_kwargs rejects max_tokens=0).
+            body_key = ("response" if path == "/api/generate"
+                        else "message")
+            body_val = ("" if path == "/api/generate"
+                        else {"role": "assistant", "content": ""})
+            return _json_response(
+                200, {"model": self.model_name, body_key: body_val,
+                      "done": True, "done_reason": "length",
+                      "eval_count": 0})
 
         try:
             kwargs, n_top, echo, score_only = self._gen_kwargs(payload)

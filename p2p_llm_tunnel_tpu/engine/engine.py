@@ -345,6 +345,7 @@ class InferenceEngine:
         self._freq_pen = np.zeros((rows,), np.float32)
         self._pres_pen = np.zeros((rows,), np.float32)
         self._logprobs = np.zeros((rows,), np.int32)
+        self._sample_seed = np.zeros((rows,), np.uint32)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -438,13 +439,17 @@ class InferenceEngine:
 
         any_lp = jnp.any(samp.logprobs > 0)
 
-        def one(carry, step_key):
+        def one(carry, _xs):
             toks, pos, cnt, cache = carry
             logits, cache = decode_step(
                 self.mcfg, params, cache, toks, pos, kv_view=kv_view,
                 mesh=self.mesh,
             )
-            sampled = sampling.sample(logits, samp, step_key, counts=cnt)
+            # key=None: sampling randomness is the per-request (seed, pos)
+            # stream — the burst key no longer feeds it (and the old split
+            # per step was dead weight XLA DCE'd anyway).
+            sampled = sampling.sample(logits, samp, None, counts=cnt,
+                                      pos=pos + 1)
             cnt = jax.lax.cond(
                 any_pen,
                 lambda: cnt.at[jnp.arange(b), sampled].add(1),
@@ -457,9 +462,8 @@ class InferenceEngine:
             )
             return (sampled, pos + 1, cnt, cache), (sampled, lp)
 
-        keys = jax.random.split(key, steps)
         (tokens, positions, counts, kv_cache), (toks, lps) = jax.lax.scan(
-            one, (tokens, positions, counts, kv_cache), keys
+            one, (tokens, positions, counts, kv_cache), None, length=steps
         )
         # [k, ...] scan stacking -> [B, k, ...] row-major for the host.
         lp_out = (
@@ -487,7 +491,7 @@ class InferenceEngine:
                 self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
                 mesh=self.mesh,
             )
-        first = sampling.sample(last_logits, samp, key)
+        first = sampling.sample(last_logits, samp, key, pos=lengths)
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
             lambda: sampling.logprob_data(last_logits, first),
@@ -512,7 +516,7 @@ class InferenceEngine:
             self._prefill_mcfg, params, tokens, lengths, starts, kv_cache,
             slots, kv_view=kv_view,
         )
-        first = sampling.sample(last_logits, samp, key)
+        first = sampling.sample(last_logits, samp, key, pos=starts + lengths)
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
             lambda: sampling.logprob_data(last_logits, first),
@@ -598,6 +602,7 @@ class InferenceEngine:
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.zeros((nb,), jnp.int32),
+            seed=jnp.zeros((nb,), jnp.uint32),
         )
         first, _lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
@@ -661,14 +666,21 @@ class InferenceEngine:
         logprobs: int = 0,
         echo_logprobs: bool = False,
         stop_ids: Optional[Tuple[int, ...]] = None,
+        seed: Optional[int] = None,
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes."""
         if stop_ids is None:
             stop_ids = (self.tokenizer.eos_id,)
         rid = self._next_request_id
         self._next_request_id += 1
+        if seed is None:
+            # Auto-seed from the request id: sampling stays reproducible
+            # for a fixed submission order AND independent of batch
+            # composition (each row's key stream is its own).
+            seed = (rid * 2654435761 + self.ecfg.seed) & 0xFFFFFFFF
         req = GenRequest(
             request_id=rid,
+            seed=int(seed) & 0xFFFFFFFF,
             prompt_ids=list(prompt_ids),
             max_new_tokens=max_new_tokens,
             temperature=temperature,
@@ -788,8 +800,10 @@ class InferenceEngine:
             top_p[i] = run.request.top_p
             total += len(ids)
         lps = np.zeros((nb,), np.int32)
+        seeds = np.zeros((nb,), np.uint32)
         for i, run in enumerate(runs):
             lps[i] = run.request.logprobs
+            seeds[i] = run.request.seed
         # Penalties are zero here by construction: the FIRST token has no
         # generated predecessors, so the prefill sampler needs no counts.
         samp = sampling.SamplingParams(
@@ -799,6 +813,7 @@ class InferenceEngine:
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
+            seed=jnp.asarray(seeds),
         )
         if echo:
             first, lp, plp, self.kv_cache = self._jit_prefill(
@@ -843,6 +858,7 @@ class InferenceEngine:
         top_k = np.zeros((nb,), np.int32)
         top_p = np.ones((nb,), np.float32)
         lps = np.zeros((nb,), np.int32)
+        seeds = np.zeros((nb,), np.uint32)
         total = 0
         for i, (run, start, seg, sample) in enumerate(rows):
             tokens[i, : len(seg)] = seg
@@ -854,6 +870,7 @@ class InferenceEngine:
                 top_k[i] = run.request.top_k
                 top_p[i] = run.request.top_p
                 lps[i] = run.request.logprobs
+                seeds[i] = run.request.seed
             total += len(seg)
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(temp),
@@ -862,6 +879,7 @@ class InferenceEngine:
             freq_pen=jnp.zeros((nb,), jnp.float32),
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
+            seed=jnp.asarray(seeds),
         )
         # Smallest view covering every row's history + padded tail: the
         # attention read cost of an admission tracks the live context, not
@@ -958,6 +976,7 @@ class InferenceEngine:
             freq_pen=jnp.array(np.where(active, self._freq_pen, 0.0)),
             pres_pen=jnp.array(np.where(active, self._pres_pen, 0.0)),
             logprobs=jnp.array(np.where(active, self._logprobs, 0)),
+            seed=jnp.array(self._sample_seed),
         )
         # INACTIVE rows are parked at position >= max_seq every dispatch:
         # decode_step writes KV at every row's carry position, and a stale
@@ -1107,6 +1126,7 @@ class InferenceEngine:
         self._freq_pen[i] = req.freq_pen
         self._pres_pen[i] = req.pres_pen
         self._logprobs[i] = req.logprobs
+        self._sample_seed[i] = req.seed
         # The device-side carry knows nothing about this slot yet; patch it
         # in at the next dispatch.
         self._ov_mask[i] = True

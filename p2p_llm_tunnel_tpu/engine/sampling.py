@@ -30,10 +30,16 @@ class SamplingParams(NamedTuple):
     freq_pen: jnp.ndarray  # OpenAI frequency_penalty, 0 → disabled
     pres_pen: jnp.ndarray  # OpenAI presence_penalty, 0 → disabled
     logprobs: jnp.ndarray  # requested top_logprobs count, 0 → disabled
+    # Per-request sampling seed [B] uint32 (None → legacy shared-key
+    # sampling).  With a seed, each row's randomness is a pure function of
+    # (seed, token position): identical regardless of batch composition,
+    # reproducible across runs — OpenAI `seed` / vLLM per-request seeds.
+    seed: jnp.ndarray = None
 
 
 def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
-                freq_pen=0.0, pres_pen=0.0, logprobs=0) -> SamplingParams:
+                freq_pen=0.0, pres_pen=0.0, logprobs=0,
+                seed=0) -> SamplingParams:
     return SamplingParams(
         temperature=jnp.full((batch,), temperature, jnp.float32),
         top_k=jnp.full((batch,), top_k, jnp.int32),
@@ -41,6 +47,7 @@ def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
         freq_pen=jnp.full((batch,), freq_pen, jnp.float32),
         pres_pen=jnp.full((batch,), pres_pen, jnp.float32),
         logprobs=jnp.full((batch,), logprobs, jnp.int32),
+        seed=jnp.full((batch,), seed, jnp.uint32),
     )
 
 
@@ -84,6 +91,7 @@ def sample(
     params: SamplingParams,
     key: jax.Array,
     counts: jnp.ndarray = None,  # [B, V] generated-token counts, or None
+    pos: jnp.ndarray = None,  # [B] index of the token being sampled
 ) -> jnp.ndarray:
     """Sample one token per row. Greedy rows (temperature==0) are exact.
 
@@ -113,7 +121,7 @@ def sample(
     any_stochastic = jnp.any(params.temperature > 0.0)
     return jax.lax.cond(
         any_stochastic,
-        lambda: _sample_stochastic(logits, params, key, greedy),
+        lambda: _sample_stochastic(logits, params, key, greedy, pos),
         lambda: greedy,
     )
 
@@ -123,6 +131,7 @@ def _sample_stochastic(
     params: SamplingParams,
     key: jax.Array,
     greedy: jnp.ndarray,
+    pos: jnp.ndarray = None,
 ) -> jnp.ndarray:
     b, v = logits.shape
 
@@ -153,5 +162,19 @@ def _sample_stochastic(
         (params.top_p[:, None] < 1.0) & (scaled < cutoff), -jnp.inf, scaled
     )
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    if pos is not None and params.seed is not None:
+        # Per-request determinism: row i's key is a pure function of its
+        # (seed, token position) — independent of batch composition, of
+        # sibling requests, and of the engine's global key stream.
+        base = jax.random.PRNGKey(0x5EED)
+
+        def rowkey(s, p):
+            return jax.random.fold_in(jax.random.fold_in(base, s), p)
+
+        keys = jax.vmap(rowkey)(params.seed, pos.astype(jnp.uint32))
+        sampled = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg)
+        )(keys, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(params.temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -31,6 +31,10 @@ class GenRequest:
     # (forces the whole-prompt plain prefill path).
     echo_logprobs: bool = False
     stop_ids: tuple = ()
+    # Per-request sampling seed (uint32): randomness is a pure function of
+    # (seed, token position) — batch-composition independent, reproducible.
+    # The engine auto-derives one from the request id when not given.
+    seed: int = 0
 
     def __post_init__(self) -> None:
         if not self.prompt_ids:

@@ -230,6 +230,17 @@ def test_ollama_options_sampling_knobs():
             obj = json.loads(await resp.read_all())
             assert resp.status == 200
             assert obj["eval_count"] >= 1
+            # num_predict 0 = generate nothing (a real Ollama 200s).
+            payload = json.dumps({
+                "prompt": "abc", "stream": False,
+                "options": {"num_predict": 0},
+            }).encode()
+            resp = await http11.http_request(
+                "POST", f"{base}/api/generate", {}, payload, timeout=60.0
+            )
+            obj = json.loads(await resp.read_all())
+            assert resp.status == 200
+            assert obj["eval_count"] == 0 and obj["response"] == ""
 
     asyncio.run(run())
 

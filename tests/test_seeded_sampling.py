@@ -1,0 +1,122 @@
+"""Per-request seeded sampling: reproducible AND batch-composition
+independent.
+
+Row randomness is a pure function of (seed, token position) — the
+property that makes `seed` requests reproducible across runs and makes a
+request's samples identical whether it ran alone or packed in a batch
+(vLLM's per-request seeds; OpenAI's `seed` parameter).
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+ECFG = EngineConfig(model="tiny", num_slots=4, max_seq=64, dtype="float32",
+                    seed=0)
+
+
+async def _collect(engine, prompt, **kw):
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=6, stop_ids=(),
+                                    **kw):
+        out.append(ev.token_id)
+    return out
+
+
+def test_same_seed_reproduces_different_seed_varies():
+    async def run():
+        engine = InferenceEngine(engine_cfg=ECFG)
+        await engine.start()
+        try:
+            a = await _collect(engine, [1, 2, 3], temperature=0.9, seed=7)
+            b = await _collect(engine, [1, 2, 3], temperature=0.9, seed=7)
+            c = await _collect(engine, [1, 2, 3], temperature=0.9, seed=8)
+            assert a == b, "same seed must reproduce exactly"
+            assert a != c, "different seeds should diverge (tiny vocab: " \
+                           "astronomically unlikely to collide on 6 tokens)"
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_seeded_sampling_independent_of_batch_composition():
+    """A seeded request's tokens must not change when other requests share
+    the batch — each row's key stream is its own."""
+    async def run():
+        engine = InferenceEngine(engine_cfg=ECFG)
+        await engine.start()
+        try:
+            solo = await _collect(engine, [5, 6, 7], temperature=0.8,
+                                  seed=42)
+            packed = await asyncio.gather(
+                _collect(engine, [5, 6, 7], temperature=0.8, seed=42),
+                _collect(engine, [9, 9], temperature=1.2, seed=3),
+                _collect(engine, [4, 4, 4, 4], temperature=0.5, seed=11),
+            )
+            assert packed[0] == solo, (
+                "batch composition changed a seeded request's tokens"
+            )
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_api_seed_param_reproduces():
+    from tests.test_engine_tunnel import engine_stack
+    from p2p_llm_tunnel_tpu.endpoints import http11
+    import json
+
+    async def run():
+        async with engine_stack() as (base, _):
+            async def once():
+                resp = await http11.http_request(
+                    "POST", f"{base}/v1/completions",
+                    {"content-type": "application/json"},
+                    json.dumps({"prompt": "abc", "max_tokens": 5,
+                                "temperature": 0.9, "seed": 123,
+                                "ignore_eos": True}).encode(),
+                    timeout=60.0,
+                )
+                return json.loads(await resp.read_all())["choices"][0]["text"]
+
+            t1, t2 = await once(), await once()
+            assert t1 == t2
+
+    asyncio.run(run())
+
+
+def test_api_seed_with_n_still_yields_distinct_choices():
+    """OpenAI semantics: seed pins the RUN, not one shared sample stream —
+    n choices must still differ from each other (per-run seed offsets),
+    while the whole response reproduces across calls."""
+    from tests.test_engine_tunnel import engine_stack
+    from p2p_llm_tunnel_tpu.endpoints import http11
+    import json
+
+    async def run():
+        async with engine_stack() as (base, _):
+            async def once():
+                resp = await http11.http_request(
+                    "POST", f"{base}/v1/completions",
+                    {"content-type": "application/json"},
+                    json.dumps({"prompt": "abc", "max_tokens": 6,
+                                "temperature": 1.0, "seed": 5, "n": 3,
+                                "ignore_eos": True}).encode(),
+                    timeout=60.0,
+                )
+                obj = json.loads(await resp.read_all())
+                return [c["text"] for c in obj["choices"]]
+
+            a, b = await once(), await once()
+            assert a == b, "seeded n-response must reproduce as a whole"
+            assert len(set(a)) > 1, "n choices collapsed to one sample"
+
+    asyncio.run(run())
