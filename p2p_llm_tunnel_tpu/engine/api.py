@@ -248,6 +248,23 @@ class EngineAPI:
         seed = field("seed")  # OpenAI `seed` / Ollama options.seed
         if seed is not None:
             kwargs["seed"] = int(seed)
+        lb = body.get("logit_bias")
+        if lb:
+            if not isinstance(lb, dict):
+                raise ValueError("logit_bias must be an object")
+            if len(lb) > 300:
+                raise ValueError("logit_bias supports at most 300 entries")
+            vocab = self.engine.mcfg.vocab_size
+            entries = []
+            for k, v in lb.items():
+                t = int(k)
+                if not 0 <= t < vocab:
+                    raise ValueError(
+                        f"logit_bias token {t} outside vocab [0, {vocab})"
+                    )
+                # OpenAI clamps to [-100, 100]
+                entries.append((t, max(-100.0, min(100.0, float(v)))))
+            kwargs["logit_bias"] = tuple(entries)
         return kwargs, n_top, echo, score_only
 
     @staticmethod

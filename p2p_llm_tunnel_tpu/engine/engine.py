@@ -38,6 +38,11 @@ from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
 
 log = get_logger(__name__)
 
+#: Queue sentinel distinguishing "engine crashed" from the clean
+#: end-of-stream None — consumers raise instead of returning a silently
+#: truncated 200.
+_CRASHED = object()
+
 
 @dataclass
 class EngineConfig:
@@ -235,6 +240,7 @@ class InferenceEngine:
 
         self._spmd = SpmdCoordinator.maybe(mesh)
         self._spmd_stop_sent = False
+        self._crashed = False
         if mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import (
                 param_shardings as _pshard,
@@ -346,6 +352,7 @@ class InferenceEngine:
         self._pres_pen = np.zeros((rows,), np.float32)
         self._logprobs = np.zeros((rows,), np.int32)
         self._sample_seed = np.zeros((rows,), np.uint32)
+        self._slot_bias_on = np.zeros((rows,), bool)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -370,24 +377,47 @@ class InferenceEngine:
         # admission latency (small, used while requests wait).
         self._jit_decode = jax.jit(
             self._decode_fn, donate_argnums=(1, 2, 3, 4),
-            static_argnums=(10, 11),
+            static_argnums=(11, 12),
         )
         self._jit_prefill = jax.jit(
-            self._prefill_fn, donate_argnums=(1,), static_argnums=(7,)
+            self._prefill_fn, donate_argnums=(1,), static_argnums=(8,)
         )
         self._jit_chunk_prefill = jax.jit(
-            self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=(8,)
+            self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=(9,)
         )
+
+        def _set_bias_fn(bias, row, ids, vals):
+            # Zero the slot's row, then scatter-add the padded entries —
+            # pads are (0, 0.0) so they contribute nothing (OpenAI
+            # logit_bias admission; one compile, static entry cap).
+            bias = bias.at[row].set(0.0)
+            return bias.at[row, ids].add(vals)
+
+        self._jit_set_bias = jax.jit(_set_bias_fn, donate_argnums=(0,))
         if self._spmd is not None:
-            # Carries (params + device caches) are spliced by each rank;
-            # everything after them is host input, broadcast by rank 0.
-            self._jit_decode = self._spmd.wrap("decode", self._jit_decode, 5)
+            # Carries (params + device caches + the bias plane) are spliced
+            # by each rank; everything after them is host input, broadcast
+            # by rank 0.
+            self._jit_decode = self._spmd.wrap("decode", self._jit_decode, 6)
             self._jit_prefill = self._spmd.wrap(
-                "prefill", self._jit_prefill, 2
+                "prefill", self._jit_prefill, 3
             )
             self._jit_chunk_prefill = self._spmd.wrap(
-                "chunk", self._jit_chunk_prefill, 2
+                "chunk", self._jit_chunk_prefill, 3
             )
+            self._jit_set_bias = self._spmd.wrap(
+                "set_bias", self._jit_set_bias, 1
+            )
+
+        # Per-slot OpenAI logit_bias plane [rows, V] (scratch row included
+        # so padded prefill rows can share the program).  ~17 MB at a 128k
+        # vocab — kept resident; the sampler's read hides behind a
+        # lax.cond on bias_on, so bias-free batches never touch it.
+        glob = (self._spmd.globalize if self._spmd is not None
+                else (lambda x: x))
+        self._bias = glob(
+            jnp.zeros((rows, self.mcfg.vocab_size), jnp.float32)
+        )
 
         # Device-side decode carry (created lazily) + host override patch.
         self._dev_tokens = None
@@ -398,8 +428,8 @@ class InferenceEngine:
     # -- XLA programs -----------------------------------------------------
 
     def _decode_fn(
-        self, params, kv_cache, tokens, positions, counts, ov_mask, ov_tok,
-        ov_pos, samp, key, kv_view, steps,
+        self, params, kv_cache, tokens, positions, counts, bias, ov_mask,
+        ov_tok, ov_pos, samp, key, kv_view, steps,
     ):
         """``decode_steps`` chained steps; sampled tokens feed back on-device.
 
@@ -449,7 +479,7 @@ class InferenceEngine:
             # stream — the burst key no longer feeds it (and the old split
             # per step was dead weight XLA DCE'd anyway).
             sampled = sampling.sample(logits, samp, None, counts=cnt,
-                                      pos=pos + 1)
+                                      pos=pos + 1, bias=bias)
             cnt = jax.lax.cond(
                 any_pen,
                 lambda: cnt.at[jnp.arange(b), sampled].add(1),
@@ -473,8 +503,8 @@ class InferenceEngine:
         )
         return toks.T, lp_out, tokens, positions, counts, kv_cache  # [B, k]
 
-    def _prefill_fn(self, params, kv_cache, tokens, lengths, slots, samp,
-                    key, echo=False):
+    def _prefill_fn(self, params, kv_cache, bias, tokens, lengths, slots,
+                    samp, key, echo=False):
         """Plain prefill; ``echo`` (STATIC) additionally returns per-prompt-
         token logprobs — the scoring path of the legacy completions API,
         compiled on first use (an explicitly-requested eval feature, not
@@ -491,7 +521,9 @@ class InferenceEngine:
                 self._prefill_mcfg, params, tokens, lengths, kv_cache, slots,
                 mesh=self.mesh,
             )
-        first = sampling.sample(last_logits, samp, key, pos=lengths)
+        # Prefill rows are packed; gather each row's SLOT bias plane.
+        first = sampling.sample(last_logits, samp, key, pos=lengths,
+                                bias=bias[slots])
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
             lambda: sampling.logprob_data(last_logits, first),
@@ -503,8 +535,8 @@ class InferenceEngine:
         return first, lp, kv_cache
 
     def _chunk_prefill_fn(
-        self, params, kv_cache, tokens, lengths, starts, slots, samp, key,
-        kv_view,
+        self, params, kv_cache, bias, tokens, lengths, starts, slots, samp,
+        key, kv_view,
     ):
         """Tail-only prefill against reused history KV (prefix-cache path).
         ``kv_view`` is static (one compiled program per (tail, view))."""
@@ -516,7 +548,8 @@ class InferenceEngine:
             self._prefill_mcfg, params, tokens, lengths, starts, kv_cache,
             slots, kv_view=kv_view,
         )
-        first = sampling.sample(last_logits, samp, key, pos=starts + lengths)
+        first = sampling.sample(last_logits, samp, key, pos=starts + lengths,
+                                bias=bias[slots])
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
             lambda: sampling.logprob_data(last_logits, first),
@@ -536,7 +569,13 @@ class InferenceEngine:
         self._running = False
         self._wake.set()
         if self._task is not None:
-            await self._task
+            try:
+                await self._task
+            except Exception:
+                # Already logged + surfaced to consumers by the loop's
+                # crash containment; stop() stays clean so teardown paths
+                # don't have to handle the crash a second time.
+                pass
             self._task = None
         # Persist warm prompt KV before the executor goes away (reads the
         # pool device arrays; must happen while XLA dispatch still works).
@@ -603,10 +642,12 @@ class InferenceEngine:
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.zeros((nb,), jnp.int32),
             seed=jnp.zeros((nb,), jnp.uint32),
+            bias_on=jnp.zeros((nb,), bool),
         )
         first, _lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
+            self._bias,
             jnp.zeros((nb, t), jnp.int32),
             jnp.ones((nb,), jnp.int32),
             jnp.zeros((nb,), jnp.int32),
@@ -667,8 +708,17 @@ class InferenceEngine:
         echo_logprobs: bool = False,
         stop_ids: Optional[Tuple[int, ...]] = None,
         seed: Optional[int] = None,
+        logit_bias: Tuple[Tuple[int, float], ...] = (),
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes."""
+        if self._crashed:
+            raise RuntimeError(
+                "engine loop crashed; restart the serve process"
+            )
+        if len(logit_bias) > self.BIAS_CAP:
+            raise ValueError(
+                f"logit_bias supports at most {self.BIAS_CAP} entries"
+            )
         if stop_ids is None:
             stop_ids = (self.tokenizer.eos_id,)
         rid = self._next_request_id
@@ -681,6 +731,7 @@ class InferenceEngine:
         req = GenRequest(
             request_id=rid,
             seed=int(seed) & 0xFFFFFFFF,
+            logit_bias=tuple(logit_bias),
             prompt_ids=list(prompt_ids),
             max_new_tokens=max_new_tokens,
             temperature=temperature,
@@ -704,6 +755,8 @@ class InferenceEngine:
         try:
             while True:
                 event = await state.queue.get()
+                if event is _CRASHED:
+                    raise RuntimeError("engine crashed mid-generation")
                 if event is None:
                     return
                 yield event
@@ -801,9 +854,12 @@ class InferenceEngine:
             total += len(ids)
         lps = np.zeros((nb,), np.int32)
         seeds = np.zeros((nb,), np.uint32)
+        bias_on = np.zeros((nb,), bool)
         for i, run in enumerate(runs):
             lps[i] = run.request.logprobs
             seeds[i] = run.request.seed
+            bias_on[i] = bool(run.request.logit_bias)
+        self._apply_logit_bias(runs)
         # Penalties are zero here by construction: the FIRST token has no
         # generated predecessors, so the prefill sampler needs no counts.
         samp = sampling.SamplingParams(
@@ -814,11 +870,13 @@ class InferenceEngine:
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
             seed=jnp.asarray(seeds),
+            bias_on=jnp.asarray(bias_on),
         )
         if echo:
             first, lp, plp, self.kv_cache = self._jit_prefill(
                 self.params,
                 self.kv_cache,
+                self._bias,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(slots),
@@ -831,6 +889,7 @@ class InferenceEngine:
             first, lp, self.kv_cache = self._jit_prefill(
                 self.params,
                 self.kv_cache,
+                self._bias,
                 jnp.asarray(tokens),
                 jnp.asarray(lengths),
                 jnp.asarray(slots),
@@ -859,6 +918,7 @@ class InferenceEngine:
         top_p = np.ones((nb,), np.float32)
         lps = np.zeros((nb,), np.int32)
         seeds = np.zeros((nb,), np.uint32)
+        bias_on = np.zeros((nb,), bool)
         total = 0
         for i, (run, start, seg, sample) in enumerate(rows):
             tokens[i, : len(seg)] = seg
@@ -871,7 +931,11 @@ class InferenceEngine:
                 top_p[i] = run.request.top_p
                 lps[i] = run.request.logprobs
                 seeds[i] = run.request.seed
+                bias_on[i] = bool(run.request.logit_bias)
             total += len(seg)
+        self._apply_logit_bias(
+            [run for (run, _s, _g, sample) in rows if sample]
+        )
         samp = sampling.SamplingParams(
             temperature=jnp.asarray(temp),
             top_k=jnp.asarray(top_k),
@@ -880,6 +944,7 @@ class InferenceEngine:
             pres_pen=jnp.zeros((nb,), jnp.float32),
             logprobs=jnp.asarray(lps),
             seed=jnp.asarray(seeds),
+            bias_on=jnp.asarray(bias_on),
         )
         # Smallest view covering every row's history + padded tail: the
         # attention read cost of an admission tracks the live context, not
@@ -888,6 +953,7 @@ class InferenceEngine:
         first, lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
+            self._bias,
             jnp.asarray(tokens),
             jnp.asarray(lengths),
             jnp.asarray(starts),
@@ -977,6 +1043,7 @@ class InferenceEngine:
             pres_pen=jnp.array(np.where(active, self._pres_pen, 0.0)),
             logprobs=jnp.array(np.where(active, self._logprobs, 0)),
             seed=jnp.array(self._sample_seed),
+            bias_on=jnp.array(self._slot_bias_on & active),
         )
         # INACTIVE rows are parked at position >= max_seq every dispatch:
         # decode_step writes KV at every row's carry position, and a stale
@@ -997,6 +1064,7 @@ class InferenceEngine:
             self._dev_tokens,
             self._dev_positions,
             self._dev_counts,
+            self._bias,
             jnp.array(ov_mask),
             jnp.array(self._last_token),
             jnp.array(ov_pos),
@@ -1086,16 +1154,20 @@ class InferenceEngine:
             (_s, _lp, self._dev_tokens, self._dev_positions,
              self._dev_counts, self.kv_cache) = self._jit_decode(
                 self.params, self.kv_cache, self._dev_tokens,
-                self._dev_positions, self._dev_counts, *args,
+                self._dev_positions, self._dev_counts, self._bias, *args,
             )
         elif op == "prefill":
-            out = self._jit_prefill(self.params, self.kv_cache, *args)
+            out = self._jit_prefill(
+                self.params, self.kv_cache, self._bias, *args
+            )
             self.kv_cache = out[-1]
         elif op == "chunk":
             out = self._jit_chunk_prefill(
-                self.params, self.kv_cache, *args
+                self.params, self.kv_cache, self._bias, *args
             )
             self.kv_cache = out[-1]
+        elif op == "set_bias":
+            self._bias = self._jit_set_bias(self._bias, *args)
         elif op == "copy_in":
             self.kv_cache = self._copy_in(self.kv_cache, self._pool, *args)
         elif op == "copy_out":
@@ -1113,6 +1185,29 @@ class InferenceEngine:
         while self.spmd_follower_step():
             n += 1
         log.info("SPMD follower loop done after %d ops", n)
+
+    #: Static entry cap of the set-bias program (OpenAI allows 300).
+    BIAS_CAP = 320
+
+    def _apply_logit_bias(self, runs) -> None:
+        """Write admitted requests' logit_bias rows into the device plane
+        (executor thread, before the admission's sampling dispatch).  Slots
+        whose previous occupant had a bias are cleared lazily — the common
+        bias-free admission costs zero dispatches."""
+        for run in runs:
+            i = run.slot
+            lb = run.request.logit_bias
+            if not lb and not self._slot_bias_on[i]:
+                continue
+            ids = np.zeros((self.BIAS_CAP,), np.int32)
+            vals = np.zeros((self.BIAS_CAP,), np.float32)
+            for j, (t, v) in enumerate(lb[: self.BIAS_CAP]):
+                ids[j] = t
+                vals[j] = v
+            self._bias = self._jit_set_bias(
+                self._bias, i, jnp.asarray(ids), jnp.asarray(vals)
+            )
+            self._slot_bias_on[i] = bool(lb)
 
     def _admit_one(self, run: RunningSlot) -> None:
         """Set up host slot state after prefill admission."""
@@ -1402,59 +1497,74 @@ class InferenceEngine:
             self.mcfg.name, self.ecfg.num_slots, self.ecfg.max_seq,
             self.ecfg.decode_steps,
         )
-        in_flight = None  # (sampled device array, request-id snapshot)
-        while self._running:
-            if self.scheduler.idle and in_flight is None:
-                self._wake.clear()
-                try:
-                    await asyncio.wait_for(self._wake.wait(), timeout=0.5)
-                except asyncio.TimeoutError:
+        # Crash containment: a dispatch exception must surface loudly
+        # and unblock every consumer — without this, one bad program
+        # (found the hard way: a shape bug in a new sampler input)
+        # strands all generate() callers on a queue nobody will feed.
+        try:
+            in_flight = None  # (sampled device array, request-id snapshot)
+            while self._running:
+                if self.scheduler.idle and in_flight is None:
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=0.5)
+                    except asyncio.TimeoutError:
+                        continue
                     continue
-                continue
 
-            await self._admit_pending(loop)
+                await self._admit_pending(loop)
 
-            global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
-            global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
+                global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
+                global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
 
-            # One chunked-prefill segment per iteration, dispatched before
-            # the decode burst: long prompts make steady progress while
-            # every running stream keeps decoding — the interleave that
-            # bounds how long one big prompt can stall the batch.
-            seg = (
-                await loop.run_in_executor(self._executor, self._dispatch_segments)
-                if self._segmented else None
-            )
-
-            # Pipeline: dispatch burst n (returns immediately; carry stays
-            # on device), THEN fetch+process burst n-1 — the ~90 ms RTT of
-            # the fetch overlaps with burst n computing.  Dispatch runs on
-            # the XLA executor thread: normally ~1 ms, but a first-hit
-            # (view, steps) compile takes tens of seconds, and on the event
-            # loop that would stall the tunnel past the transport's 15 s
-            # dead-peer timeout.  warmup() precompiles every variant; this
-            # is the belt to that suspender for consumers that skip it.
-            current = (
-                await loop.run_in_executor(self._executor, self._dispatch_decode)
-                if any(self._active_mask) else None
-            )
-            if in_flight is not None:
-                outs_dev, assign = in_flight
-                t0 = time.monotonic()
-                outs = await loop.run_in_executor(
-                    self._executor,
-                    lambda: jax.tree.map(np.asarray, jax.device_get(outs_dev)),
+                # One chunked-prefill segment per iteration, dispatched before
+                # the decode burst: long prompts make steady progress while
+                # every running stream keeps decoding — the interleave that
+                # bounds how long one big prompt can stall the batch.
+                seg = (
+                    await loop.run_in_executor(self._executor, self._dispatch_segments)
+                    if self._segmented else None
                 )
-                # Decode-phase stall: how long the host waited for the
-                # previous burst after dispatching the next one (0 ≈ the
-                # RTT is fully hidden by pipelining).
-                global_metrics.observe(
-                    "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
+
+                # Pipeline: dispatch burst n (returns immediately; carry stays
+                # on device), THEN fetch+process burst n-1 — the ~90 ms RTT of
+                # the fetch overlaps with burst n computing.  Dispatch runs on
+                # the XLA executor thread: normally ~1 ms, but a first-hit
+                # (view, steps) compile takes tens of seconds, and on the event
+                # loop that would stall the tunnel past the transport's 15 s
+                # dead-peer timeout.  warmup() precompiles every variant; this
+                # is the belt to that suspender for consumers that skip it.
+                current = (
+                    await loop.run_in_executor(self._executor, self._dispatch_decode)
+                    if any(self._active_mask) else None
                 )
-                await self._process_burst(outs, assign)
-            if seg is not None:
-                # Fetched after the decode work above, so the segment's
-                # device→host RTT rides under real compute.
-                await self._finish_segments(loop, seg)
-            in_flight = current
+                if in_flight is not None:
+                    outs_dev, assign = in_flight
+                    t0 = time.monotonic()
+                    outs = await loop.run_in_executor(
+                        self._executor,
+                        lambda: jax.tree.map(np.asarray, jax.device_get(outs_dev)),
+                    )
+                    # Decode-phase stall: how long the host waited for the
+                    # previous burst after dispatching the next one (0 ≈ the
+                    # RTT is fully hidden by pipelining).
+                    global_metrics.observe(
+                        "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
+                    )
+                    await self._process_burst(outs, assign)
+                if seg is not None:
+                    # Fetched after the decode work above, so the segment's
+                    # device→host RTT rides under real compute.
+                    await self._finish_segments(loop, seg)
+                in_flight = current
+        except Exception:
+            log.exception(
+                "engine loop crashed; failing %d in-flight requests",
+                len(self._requests),
+            )
+            self._running = False
+            self._crashed = True  # generate() rejects new submissions
+            for state in list(self._requests.values()):
+                state.queue.put_nowait(_CRASHED)
+            raise
         log.info("engine loop stopped")

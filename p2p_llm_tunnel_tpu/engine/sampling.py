@@ -35,6 +35,10 @@ class SamplingParams(NamedTuple):
     # (seed, token position): identical regardless of batch composition,
     # reproducible across runs — OpenAI `seed` / vLLM per-request seeds.
     seed: jnp.ndarray = None
+    # Rows with a live OpenAI logit_bias ([B] bool, None → feature unused
+    # in this program).  Gates the [B, V] bias add behind a lax.cond so
+    # bias-free batches never read the bias array.
+    bias_on: jnp.ndarray = None
 
 
 def make_params(batch, temperature=0.0, top_k=0, top_p=1.0,
@@ -92,6 +96,7 @@ def sample(
     key: jax.Array,
     counts: jnp.ndarray = None,  # [B, V] generated-token counts, or None
     pos: jnp.ndarray = None,  # [B] index of the token being sampled
+    bias: jnp.ndarray = None,  # [B, V] per-slot logit_bias, or None
 ) -> jnp.ndarray:
     """Sample one token per row. Greedy rows (temperature==0) are exact.
 
@@ -105,6 +110,13 @@ def sample(
     OpenAI semantics — they shift the logits before temperature, so they
     bias greedy decoding too.
     """
+    if bias is not None and params.bias_on is not None:
+        # OpenAI logit_bias: added to the raw logits before any other
+        # modifier; it therefore shifts greedy decoding too (a +100 bias
+        # forces the token, -100 bans it — the documented client pattern).
+        logits = jax.lax.cond(
+            jnp.any(params.bias_on), lambda: logits + bias, lambda: logits
+        )
     if counts is not None:
         def penalize():
             c = counts.astype(jnp.float32)

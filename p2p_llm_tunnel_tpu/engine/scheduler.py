@@ -35,6 +35,9 @@ class GenRequest:
     # (seed, token position) — batch-composition independent, reproducible.
     # The engine auto-derives one from the request id when not given.
     seed: int = 0
+    # OpenAI logit_bias as ((token_id, bias), ...); applied to the raw
+    # logits on-device for every sampled token of this request.
+    logit_bias: tuple = ()
 
     def __post_init__(self) -> None:
         if not self.prompt_ids:

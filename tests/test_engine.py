@@ -235,3 +235,24 @@ def test_numeric_tokenizer_renders_every_id():
     assert dec.push(99999) == "99999 "
     # encoding stays byte-level so prompts are valid ids
     assert all(i < 256 for i in tok.encode("hello"))
+
+
+def test_engine_crash_surfaces_instead_of_hanging():
+    """A dispatch exception must fail in-flight consumers with an error and
+    reject later submissions — never a silent 200 or a hung queue."""
+    async def run():
+        engine = make_engine()
+        await engine.start()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected dispatch failure")
+
+        engine._dispatch_prefill_batch = boom
+        with pytest.raises(RuntimeError):
+            await collect(engine, [1, 2, 3], max_new=4)
+        with pytest.raises(RuntimeError, match="crashed"):
+            await collect(engine, [4, 5], max_new=2)
+        # stop() remains clean after a crash.
+        await engine.stop()
+
+    asyncio.run(run())

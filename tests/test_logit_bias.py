@@ -1,0 +1,83 @@
+"""OpenAI logit_bias: device-side per-slot bias on the raw logits.
+
++100 forces a token, -100 bans it (the documented client patterns); the
+bias lives for the request and must be cleared when its slot is reused.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+ECFG = EngineConfig(model="tiny", num_slots=2, max_seq=64, dtype="float32",
+                    seed=0)
+
+
+async def _collect(engine, prompt, **kw):
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=5, stop_ids=(),
+                                    **kw):
+        out.append(ev.token_id)
+    return out
+
+
+def test_plus_100_forces_and_minus_100_bans():
+    async def run():
+        engine = InferenceEngine(engine_cfg=ECFG)
+        await engine.start()
+        try:
+            forced = await _collect(engine, [1, 2, 3],
+                                    logit_bias=((7, 100.0),))
+            assert forced == [7] * 5, forced
+            base = await _collect(engine, [1, 2, 3])
+            banned_tok = base[0]
+            banned = await _collect(engine, [1, 2, 3],
+                                    logit_bias=((banned_tok, -100.0),))
+            assert banned_tok not in banned
+            # Slot reuse after a biased request: bias must be gone.
+            again = await _collect(engine, [1, 2, 3])
+            assert again == base
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_api_logit_bias_and_validation():
+    from tests.test_engine_tunnel import engine_stack
+    from p2p_llm_tunnel_tpu.endpoints import http11
+
+    async def run():
+        async with engine_stack() as (base, _):
+            async def post(payload):
+                resp = await http11.http_request(
+                    "POST", f"{base}/v1/completions",
+                    {"content-type": "application/json"},
+                    json.dumps(payload).encode(), timeout=60.0,
+                )
+                return resp.status, json.loads(await resp.read_all())
+
+            status, obj = await post({
+                "prompt": "abc", "max_tokens": 4, "ignore_eos": True,
+                "logit_bias": {"65": 100},  # force 'A' (byte tokenizer)
+            })
+            assert status == 200
+            assert obj["choices"][0]["text"] == "AAAA"
+
+            status, _ = await post({
+                "prompt": "abc", "max_tokens": 2,
+                "logit_bias": {"999999": 1},
+            })
+            assert status == 400
+            status, _ = await post({
+                "prompt": "abc", "max_tokens": 2, "logit_bias": [1, 2],
+            })
+            assert status == 400
+
+    asyncio.run(run())
