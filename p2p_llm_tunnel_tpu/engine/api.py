@@ -12,11 +12,14 @@ Surfaces (BASELINE.md configs):
   boundary-safe matching, logprobs/top_logprobs — chat shape + legacy
   completions shape — stream_options.include_usage, legacy `echo` with
   prompt logprobs incl. max_tokens=0 pure scoring, ignore_eos, `n`
-  samples per prompt, and batched legacy prompts: list of strings /
+  samples per prompt, batched legacy prompts — list of strings /
   token ids / token-id lists, each choice indexed, all generations
-  sharing one continuous batch)
+  sharing one continuous batch — per-request `seed` with
+  batch-composition-independent reproducibility, and `logit_bias`
+  applied on-device)
 - Ollama: GET /api/tags, /api/version, POST /api/show, /api/generate,
-  /api/chat (NDJSON streaming; options.stop)
+  /api/chat (NDJSON streaming; options.stop/num_predict (incl. -1/-2/0
+  sentinels)/temperature/top_k/top_p/seed)
 - GET /health
 
 SSE chunk shape matches the conformance fixture tmp/mock_llm.py:36-88.
