@@ -180,6 +180,7 @@ async def _run_attempt(model: str) -> dict:
     # Chunked prefill: off by default (bench prompts are short); the
     # long-context sweep configs turn it on.
     prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0"))
+    spec_ngram = int(os.environ.get("BENCH_SPEC_NGRAM", "0"))
     if model == "tiny":
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
@@ -207,7 +208,7 @@ async def _run_attempt(model: str) -> dict:
             prefill_act_quant=pf8, flash_decode=flash_decode,
             flash_sgrid=flash_sgrid,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
-            prefill_chunk=prefill_chunk,
+            prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -342,6 +343,7 @@ async def _run_attempt(model: str) -> dict:
         "flash_decode": flash_decode,
         "flash_sgrid": flash_sgrid,
         "prefix_cache": prefix_cache,
+        "spec_ngram": spec_ngram,
         "prefix_hit_tokens": global_metrics.counter(
             "engine_prefix_hit_tokens_total"
         ),

@@ -134,6 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
                             "conversations); pure latency optimization, "
                             "outputs unchanged; disable with "
                             "--no-prefix-cache or TUNNEL_PREFIX_CACHE=0")
+    serve.add_argument("--spec-ngram", type=int,
+                       default=int(_env("TUNNEL_SPEC_NGRAM", "0")),
+                       help="prompt-lookup speculative decoding: match "
+                            "length (0 = off); exact-greedy verification, "
+                            "output identical to plain decode")
+    serve.add_argument("--spec-k", type=int,
+                       default=int(_env("TUNNEL_SPEC_K", "4")),
+                       help="speculative proposal length per step")
     serve.add_argument("--prefix-cache-dir",
                        default=_env("TUNNEL_PREFIX_CACHE_DIR"),
                        help="persist the prefix-cache block pool here: warm "
@@ -351,6 +359,8 @@ async def _engine_backend(args):
                     flash_sgrid=args.flash_sgrid,
                     prefix_cache=args.prefix_cache,
                     prefix_cache_dir=pfx_dir,
+                    spec_ngram=args.spec_ngram,
+                    spec_k=args.spec_k,
                     prefill_chunk=args.prefill_chunk,
                     seed=seed,
                 )

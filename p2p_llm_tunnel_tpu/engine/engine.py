@@ -125,6 +125,17 @@ class EngineConfig:
     # never on the serving path), and prefix reuse pays most when tails are
     # short anyway.
     prefix_tail_buckets: int = 2
+    # Prompt-lookup speculative decoding (vLLM's ngram speculator): when
+    # > 0, each decode dispatch proposes spec_k continuation tokens by
+    # matching the last spec_ngram generated/prompt tokens against the
+    # request's own history, verifies them in ONE forward over k+1
+    # positions, and emits the longest greedy-matching prefix + 1.
+    # Exact-greedy acceptance means output is token-identical to plain
+    # decode; repetitive text (code, RAG quotes, resent chat) emits up to
+    # spec_k+1 tokens per step.  Stochastic/penalty/logprobs rows fall
+    # back to plain behavior automatically.  Off by default (opt-in).
+    spec_ngram: int = 0
+    spec_k: int = 4
     # Chunked prefill (vLLM-style prefill/decode interleaving): prompts
     # whose (post-prefix-match) tail exceeds this many tokens advance one
     # fixed-size segment per engine-loop iteration instead of prefilling in
@@ -353,6 +364,7 @@ class InferenceEngine:
         self._logprobs = np.zeros((rows,), np.int32)
         self._sample_seed = np.zeros((rows,), np.uint32)
         self._slot_bias_on = np.zeros((rows,), bool)
+        self._spec_hist: Dict[int, tuple] = {}
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -386,6 +398,10 @@ class InferenceEngine:
             self._chunk_prefill_fn, donate_argnums=(1,), static_argnums=(9,)
         )
 
+        self._jit_spec = jax.jit(
+            self._spec_verify_fn, donate_argnums=(1,), static_argnums=(6,)
+        )
+
         def _set_bias_fn(bias, row, ids, vals):
             # Zero the slot's row, then scatter-add the padded entries —
             # pads are (0, 0.0) so they contribute nothing (OpenAI
@@ -408,6 +424,7 @@ class InferenceEngine:
             self._jit_set_bias = self._spmd.wrap(
                 "set_bias", self._jit_set_bias, 1
             )
+            self._jit_spec = self._spmd.wrap("spec", self._jit_spec, 3)
 
         # Per-slot OpenAI logit_bias plane [rows, V] (scratch row included
         # so padded prefill rows can share the program).  ~17 MB at a 128k
@@ -558,6 +575,65 @@ class InferenceEngine:
         )
         return first, lp, kv_cache
 
+    def _spec_verify_fn(self, params, kv_cache, bias, tokens, positions,
+                        samp, kv_view):
+        """One speculative step over every row: forward carry + k proposals
+        at positions [pos .. pos+k] (KV written in place — rejected
+        positions hold junk that the NEXT step for that row rewrites before
+        any query can attend it), accept the longest greedy-matching
+        proposal prefix, emit accepted + 1 tokens.
+
+        Greedy rows accept >0; stochastic rows accept 0 and sample
+        position pos+1 from their own (seed, pos) stream — exactly a plain
+        decode step.  Returns (emitted [B, k+1], counts [B], cache)."""
+        from p2p_llm_tunnel_tpu.models.transformer import (
+            chunk_prefill_into_cache,
+        )
+
+        b, t = tokens.shape  # t = 1 + spec_k
+        k = t - 1
+        slots = jnp.arange(b)
+        lengths = jnp.full((b,), t, jnp.int32)
+        logits, kv_cache = chunk_prefill_into_cache(
+            self.mcfg, params, tokens, lengths, positions, kv_cache,
+            slots, kv_view=kv_view, return_all_logits=True,
+        )  # [B, t, V]
+        if samp.bias_on is not None:
+            logits = jax.lax.cond(
+                jnp.any(samp.bias_on),
+                lambda: logits + bias[:, None, :],
+                lambda: logits,
+            )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, t]
+        proposals = tokens[:, 1:]  # [B, k]
+        match = greedy[:, :k] == proposals
+        greedy_row = samp.temperature <= 0.0
+        n_acc = jnp.where(
+            greedy_row,
+            jnp.cumprod(match.astype(jnp.int32), axis=-1).sum(axis=-1),
+            0,
+        )  # [B]
+        # Bonus token at the first mismatch (or the extension on full
+        # accept): greedy rows take the verifier's own argmax there;
+        # stochastic rows sample position 0's logits with their key
+        # stream (bias already folded in above).
+        bonus_greedy = jnp.take_along_axis(
+            greedy, n_acc[:, None], axis=1
+        )[:, 0]
+        sampled0 = sampling.sample(
+            logits[:, 0], samp, None, pos=positions + 1
+        )
+        bonus = jnp.where(greedy_row, bonus_greedy, sampled0)
+        idx = jnp.arange(t)[None, :]
+        prop_pad = jnp.concatenate(
+            [proposals, jnp.zeros((b, 1), jnp.int32)], axis=1
+        )
+        emitted = jnp.where(
+            idx < n_acc[:, None], prop_pad,
+            jnp.where(idx == n_acc[:, None], bonus[:, None], 0),
+        )
+        return emitted, n_acc + 1, kv_cache
+
     # -- lifecycle --------------------------------------------------------
 
     async def start(self) -> None:
@@ -618,6 +694,12 @@ class InferenceEngine:
             "decode warmup: %d view×steps variants compiled in %.1fs",
             len(views) * len(steps), time.monotonic() - t0,
         )
+        if self.ecfg.spec_ngram > 0:
+            for view in views:
+                def _one_spec(view=view):
+                    outs, _ = self._dispatch_spec(view=view)
+                    # nothing to process: no rows are active during warmup
+                await loop.run_in_executor(self._executor, _one_spec)
         if self._prefix is not None:
             await loop.run_in_executor(self._executor, self._warm_prefix)
         if self.ecfg.prefill_chunk > 0:
@@ -991,6 +1073,12 @@ class InferenceEngine:
         if active.any():
             need = int(self._positions[:n][active].max()) + 1
         need += 2 * self.ecfg.decode_steps + 1
+        if self.ecfg.spec_ngram > 0:
+            # Spec verify writes (and must be able to ATTEND) proposal KV
+            # at positions up to pos + spec_k; a view that excludes them
+            # would silently break exact-greedy equivalence at bucket
+            # boundaries.
+            need += self.ecfg.spec_k
         return self._chunk_view_bucket(need)
 
     def _burst_steps(self) -> int:
@@ -1168,6 +1256,11 @@ class InferenceEngine:
             self.kv_cache = out[-1]
         elif op == "set_bias":
             self._bias = self._jit_set_bias(self._bias, *args)
+        elif op == "spec":
+            out = self._jit_spec(
+                self.params, self.kv_cache, self._bias, *args
+            )
+            self.kv_cache = out[-1]
         elif op == "copy_in":
             self.kv_cache = self._copy_in(self.kv_cache, self._pool, *args)
         elif op == "copy_out":
@@ -1225,6 +1318,135 @@ class InferenceEngine:
         # The device-side carry knows nothing about this slot yet; patch it
         # in at the next dispatch.
         self._ov_mask[i] = True
+
+    #: Proposer search window: the backward n-gram scan is bounded so the
+    #: per-step host cost stays O(window), not O(context).
+    SPEC_SEARCH_WINDOW = 1024
+
+    def _propose(self, run: RunningSlot, k: int) -> np.ndarray:
+        """Prompt-lookup proposal: continuation of the most recent PRIOR
+        occurrence of the last spec_ngram tokens in this request's own
+        prompt + generation history (bounded backward search).  A bad
+        proposal is harmless — the verifier only accepts tokens greedy
+        decoding would have produced anyway — so no-match rows just
+        propose zeros.
+
+        History is cached per slot and appended incrementally, so a long
+        context is not re-materialized every step."""
+        out = np.zeros((k,), np.int32)
+        n = self.ecfg.spec_ngram
+        i = run.slot
+        cached = self._spec_hist.get(i)
+        if cached is None or cached[0] != run.request.request_id:
+            cached = (run.request.request_id,
+                      [int(t) for t in run.request.prompt_ids], 0)
+            self._spec_hist[i] = cached
+        rid, hist, consumed = cached
+        gen = run.generated
+        if consumed < len(gen):
+            hist.extend(int(t) for t in gen[consumed:])
+            self._spec_hist[i] = (rid, hist, len(gen))
+        if len(hist) <= n:
+            return out
+        tail = hist[-n:]
+        lo = max(0, len(hist) - n - self.SPEC_SEARCH_WINDOW)
+        for s in range(len(hist) - n - 1, lo - 1, -1):
+            if hist[s : s + n] == tail:
+                cont = hist[s + n : s + n + k]
+                out[: len(cont)] = cont
+                break
+        return out
+
+    def _spec_usable(self) -> bool:
+        """Spec covers rows whose features it supports; any active row
+        needing penalties or logprobs sends the whole batch down the plain
+        path (those features' device plumbing lives in _decode_fn)."""
+        if self.ecfg.spec_ngram <= 0:
+            return False
+        a = self._active_mask
+        if not bool(np.any(a & (self._temp <= 0.0))):
+            # No greedy row can accept anything: the spec step would emit
+            # exactly 1 token per row at a SYNCHRONOUS dispatch each — far
+            # worse than the pipelined k-step burst.  Plain path wins.
+            return False
+        return not bool(np.any(
+            a & ((self._freq_pen != 0.0) | (self._pres_pen != 0.0)
+                 | (self._logprobs > 0))
+        ))
+
+    def _dispatch_spec(self, *, view: Optional[int] = None):
+        """(executor thread) One speculative verify step over every row;
+        returns ((emitted [R, k+1], counts [R]), request-id snapshot).
+
+        Host-carried state (no device carry, no pipelining): the host must
+        read per-row counts before it can feed consumers anyway.  The
+        device decode carry goes stale here, so the next plain burst gets
+        a full override patch."""
+        rows = self.ecfg.num_slots + 1
+        k = self.ecfg.spec_k
+        tokens = np.zeros((rows, 1 + k), np.int32)
+        tokens[:, 0] = self._last_token
+        for i in np.nonzero(self._active_mask)[0]:
+            run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
+            if run is not None:
+                tokens[i, 1:] = self._propose(run, k)
+        inactive = ~self._active_mask
+        park = self.ecfg.max_seq
+        positions = np.where(inactive, park, self._positions)
+        active = self._active_mask
+        samp = sampling.SamplingParams(
+            temperature=jnp.array(self._temp),
+            top_k=jnp.array(self._top_k),
+            top_p=jnp.array(self._top_p),
+            freq_pen=jnp.zeros((rows,), jnp.float32),
+            pres_pen=jnp.zeros((rows,), jnp.float32),
+            logprobs=jnp.zeros((rows,), jnp.int32),
+            seed=jnp.array(self._sample_seed),
+            bias_on=jnp.array(self._slot_bias_on & active),
+        )
+        emitted, counts, self.kv_cache = self._jit_spec(
+            self.params,
+            self.kv_cache,
+            self._bias,
+            jnp.array(tokens),
+            jnp.array(positions),
+            samp,
+            self._kv_view_bucket() if view is None else view,
+        )
+        assign = [
+            run.request.request_id
+            if run is not None and self._active_mask[i] else None
+            for i, run in enumerate(self.scheduler.slots)
+        ] + [None]
+        emitted = np.asarray(emitted)
+        counts = np.asarray(counts)
+        # Device decode carry is now stale for every row.
+        self._ov_mask[:] = True
+        return (emitted, counts), assign
+
+    async def _process_spec(self, outs, assign: List) -> None:
+        emitted, counts = outs
+        n_emitted = 0
+        n_rows = 0
+        for i in np.nonzero(self._active_mask)[0]:
+            run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
+            if run is None:
+                self._active_mask[i] = False
+                continue
+            if run.request.request_id != assign[i]:
+                continue
+            n_rows += 1
+            for j in range(int(counts[i])):
+                n_emitted += 1
+                self._account_token(int(i), int(emitted[i, j]))
+                if not self._active_mask[i]:
+                    break  # stop/limit hit mid-acceptance: surplus dropped
+            await asyncio.sleep(0)
+        if n_rows:
+            global_metrics.inc("engine_spec_tokens_total", n_emitted)
+            global_metrics.inc(
+                "engine_spec_accepted_tokens_total", n_emitted - n_rows
+            )
 
     def _account_token(self, slot: int, tok: int, lp_info=None,
                        prompt_lps=None) -> None:
@@ -1525,6 +1747,28 @@ class InferenceEngine:
                     await loop.run_in_executor(self._executor, self._dispatch_segments)
                     if self._segmented else None
                 )
+
+                if self._spec_usable() and any(self._active_mask):
+                    # Speculative step (opt-in): synchronous dispatch+fetch
+                    # — counts must be read before consumers can be fed, so
+                    # there is no carry to pipeline.  Drain the pipelined
+                    # plain burst first (mode switch mid-stream).
+                    if in_flight is not None:
+                        outs_dev, assign = in_flight
+                        outs = await loop.run_in_executor(
+                            self._executor,
+                            lambda: jax.tree.map(
+                                np.asarray, jax.device_get(outs_dev)),
+                        )
+                        await self._process_burst(outs, assign)
+                        in_flight = None
+                    spec_out, spec_assign = await loop.run_in_executor(
+                        self._executor, self._dispatch_spec
+                    )
+                    await self._process_spec(spec_out, spec_assign)
+                    if seg is not None:
+                        await self._finish_segments(loop, seg)
+                    continue
 
                 # Pipeline: dispatch burst n (returns immediately; carry stays
                 # on device), THEN fetch+process burst n-1 — the ~90 ms RTT of

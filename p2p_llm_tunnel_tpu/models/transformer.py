@@ -446,6 +446,7 @@ def chunk_prefill_into_cache(
     kv_cache: KVCache,
     slots: jnp.ndarray,  # [Bp] cache slot per prompt
     kv_view: Optional[int] = None,  # static: attend only to cache[:kv_view]
+    return_all_logits: bool = False,  # static: [Bp,T,V] instead of last
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Prefill only the TAIL of each prompt against reused history KV.
 
@@ -547,6 +548,10 @@ def chunk_prefill_into_cache(
     )
     x = _norm(cfg, x, params["final_norm"])
     logits = _logits(cfg, params, x)  # [Bp,T,V]
+    if return_all_logits:
+        # Speculative verify (engine spec_ngram): every position's logits
+        # decide how many proposed tokens survive.
+        return logits, new_cache
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None], axis=1
     )[:, 0]

@@ -1,0 +1,123 @@
+"""Prompt-lookup speculative decoding: exact-greedy acceptance.
+
+The contract is absolute: speculation is a pure latency optimization —
+token output must be IDENTICAL to plain decode (greedy acceptance only
+admits tokens greedy decoding would have produced), for greedy rows,
+stochastic rows (which accept nothing and sample their own stream), stop
+sequences, and token limits alike.
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+
+def _cfg(**kw):
+    base = dict(model="tiny", num_slots=4, max_seq=128, dtype="float32",
+                seed=0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _collect(engine, prompt, max_new=24, **kw):
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=max_new,
+                                    stop_ids=(), **kw):
+        out.append(ev.token_id)
+    return out
+
+
+#: Highly repetitive prompt: the ngram proposer should fire constantly.
+REP = list(b"the cat sat on the mat. the cat sat on the mat. the cat")
+
+
+def test_greedy_equivalence_and_acceptance():
+    async def run(spec):
+        engine = InferenceEngine(
+            engine_cfg=_cfg(spec_ngram=3 if spec else 0, spec_k=4))
+        await engine.start()
+        try:
+            global_metrics.reset()
+            out = await _collect(engine, REP)
+            accepted = global_metrics.counter(
+                "engine_spec_accepted_tokens_total")
+            return out, accepted
+        finally:
+            await engine.stop()
+
+    plain, _ = asyncio.run(run(False))
+    spec, accepted = asyncio.run(run(True))
+    assert spec == plain, "speculation changed greedy output"
+    assert accepted > 0, "repetitive prompt never accepted a proposal"
+
+
+def test_stochastic_rows_identical_under_spec():
+    """Seeded stochastic requests accept nothing — their samples must be
+    bit-identical with and without speculation in the engine."""
+    async def run(spec):
+        engine = InferenceEngine(
+            engine_cfg=_cfg(spec_ngram=3 if spec else 0))
+        await engine.start()
+        try:
+            return await _collect(engine, REP, temperature=0.8, seed=9)
+        finally:
+            await engine.stop()
+
+    assert asyncio.run(run(True)) == asyncio.run(run(False))
+
+
+def test_mixed_batch_and_stops_under_spec():
+    """Concurrent greedy + stochastic + string-stop requests under spec:
+    every stream equals its plain-engine counterpart."""
+    async def run(spec):
+        engine = InferenceEngine(
+            engine_cfg=_cfg(spec_ngram=3 if spec else 0))
+        await engine.start()
+        try:
+            outs = await asyncio.gather(
+                _collect(engine, REP),
+                _collect(engine, REP, temperature=1.1, seed=4),
+                _collect(engine, list(b"xyxyxyxyxyxy"), max_new=10),
+                _collect(engine, REP, max_new=3),
+            )
+            return outs
+        finally:
+            await engine.stop()
+
+    assert asyncio.run(run(True)) == asyncio.run(run(False))
+
+
+def test_spec_respects_stop_ids_and_logprobs_fallback():
+    async def run():
+        engine = InferenceEngine(engine_cfg=_cfg(spec_ngram=3))
+        await engine.start()
+        try:
+            # stop token mid-acceptance: surplus accepted tokens dropped.
+            plain = InferenceEngine(engine_cfg=_cfg())
+            await plain.start()
+            a = []
+            async for ev in engine.generate(REP, max_new_tokens=20):
+                a.append((ev.token_id, ev.finish_reason))
+            b = []
+            async for ev in plain.generate(REP, max_new_tokens=20):
+                b.append((ev.token_id, ev.finish_reason))
+            assert a == b
+            # a logprobs request sends the batch down the plain path and
+            # still gets its logprobs.
+            evs = []
+            async for ev in engine.generate(REP, max_new_tokens=4,
+                                            stop_ids=(), logprobs=2):
+                evs.append(ev)
+            assert all(ev.logprob is not None for ev in evs)
+            await plain.stop()
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
