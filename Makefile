@@ -31,7 +31,10 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 		tests/test_engine_stress.py tests/test_transport_net.py \
 		tests/test_transport_lossy.py tests/test_flow_control.py \
 		tests/test_reconnect.py tests/test_coalesce.py \
-		tests/test_chunked_prefill.py tests/test_arq.py -q
+		tests/test_chunked_prefill.py tests/test_arq.py \
+		tests/test_spec_decode.py tests/test_multi_choice.py \
+		tests/test_seeded_sampling.py tests/test_logit_bias.py \
+		tests/test_spmd_serve.py -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py
