@@ -19,7 +19,9 @@ Surfaces (BASELINE.md configs):
   applied on-device)
 - Ollama: GET /api/tags, /api/version, POST /api/show, /api/generate,
   /api/chat (NDJSON streaming; options.stop/num_predict (incl. -1/-2/0
-  sentinels)/temperature/top_k/top_p/seed)
+  sentinels)/temperature/top_k/top_p/seed), /api/embed + legacy
+  /api/embeddings (mean-pooled normalized final hidden states; also
+  OpenAI /v1/embeddings)
 - GET /health
 
 SSE chunk shape matches the conformance fixture tmp/mock_llm.py:36-88.
@@ -819,6 +821,59 @@ class EngineAPI:
             payload = json.loads(body) if body else {}
         except json.JSONDecodeError as e:
             return _error(400, f"invalid JSON body: {e}")
+
+        if path in ("/v1/embeddings", "/api/embed", "/api/embeddings"):
+            # Handled before any generation-param parsing: max_tokens/n/
+            # stream knobs are meaningless here and must not 400 a valid
+            # embeddings payload.
+            try:
+                if path == "/v1/embeddings":
+                    if payload.get("encoding_format", "float") != "float":
+                        return _error(
+                            400, "only encoding_format 'float' is supported"
+                        )
+                    if payload.get("dimensions") is not None:
+                        return _error(
+                            400, "dimensions is not supported (full-width "
+                                 "vectors only)"
+                        )
+                if path == "/api/embeddings":
+                    raw_in = payload.get("prompt", "")
+                else:
+                    raw_in = payload.get("input", "")
+                prompts = self._parse_prompts(raw_in)
+                if len(prompts) > 64:
+                    return _error(400, "at most 64 inputs per request")
+                if path != "/v1/embeddings" and payload.get(
+                        "truncate", True):
+                    # Ollama semantics: over-length inputs truncate to the
+                    # context window by default (truncate=false rejects).
+                    limit = self.engine.ecfg.max_seq - 1
+                    prompts = [p[:limit] for p in prompts]
+                for pids in prompts:
+                    self._check_prompt(pids)
+            except (ValueError, TypeError) as e:
+                return _error(400, str(e))
+            vecs = await self.engine.embed(prompts)
+            pt = sum(len(p) for p in prompts)
+            if path == "/v1/embeddings":
+                return _json_response(200, {
+                    "object": "list",
+                    "model": self.model_name,
+                    "data": [
+                        {"object": "embedding", "index": i,
+                         "embedding": v}
+                        for i, v in enumerate(vecs.tolist())
+                    ],
+                    "usage": {"prompt_tokens": pt, "total_tokens": pt},
+                })
+            if path == "/api/embed":
+                return _json_response(200, {
+                    "model": self.model_name,
+                    "embeddings": vecs.tolist(),
+                })
+            # legacy /api/embeddings: single prompt, singular key
+            return _json_response(200, {"embedding": vecs[0].tolist()})
 
         opts_np = payload.get("options")
         opts_np = opts_np.get("num_predict") if isinstance(opts_np, dict) \

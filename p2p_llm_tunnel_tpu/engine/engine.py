@@ -402,6 +402,15 @@ class InferenceEngine:
             self._spec_verify_fn, donate_argnums=(1,), static_argnums=(6,)
         )
 
+        def _embed_pool_fn(params, tokens, valid):
+            from p2p_llm_tunnel_tpu.models.transformer import encode_pooled
+
+            return encode_pooled(
+                self._prefill_mcfg, params, tokens, valid, mesh=self.mesh
+            )
+
+        self._jit_embed = jax.jit(_embed_pool_fn)
+
         def _set_bias_fn(bias, row, ids, vals):
             # Zero the slot's row, then scatter-add the padded entries —
             # pads are (0, 0.0) so they contribute nothing (OpenAI
@@ -425,6 +434,7 @@ class InferenceEngine:
                 "set_bias", self._jit_set_bias, 1
             )
             self._jit_spec = self._spmd.wrap("spec", self._jit_spec, 3)
+            self._jit_embed = self._spmd.wrap("embed", self._jit_embed, 1)
 
         # Per-slot OpenAI logit_bias plane [rows, V] (scratch row included
         # so padded prefill rows can share the program).  ~17 MB at a 128k
@@ -776,6 +786,42 @@ class InferenceEngine:
         )
 
     # -- public API -------------------------------------------------------
+
+    async def embed(self, prompts: List[List[int]]) -> np.ndarray:
+        """Mean-pooled embeddings for a batch of token-id prompts.
+
+        Runs on the XLA executor thread (one program per (rows, width)
+        bucket pair; embeddings are not latency-critical, so a first-hit
+        compile is acceptable — it never blocks the event loop).  Returns
+        [len(prompts), dim] float32."""
+        if self._crashed:
+            raise RuntimeError(
+                "engine loop crashed; restart the serve process"
+            )
+        loop = asyncio.get_running_loop()
+        pr = self.ecfg.prefill_rows
+        outs = []
+        # Sub-batches of prefill_rows: the same activation bound every
+        # serving prefill respects — one 64-input request must not build a
+        # [64, max_seq] full-attention program on a serving-sized device.
+        for lo in range(0, len(prompts), pr):
+            chunk = prompts[lo : lo + pr]
+            width = self._bucket(max(len(p) for p in chunk))
+            tokens = np.zeros((pr, width), np.int32)
+            valid = np.zeros((pr, width), bool)
+            for i, p in enumerate(chunk):
+                tokens[i, : len(p)] = p
+                valid[i, : len(p)] = True
+
+            def run(tokens=tokens, valid=valid):
+                out = self._jit_embed(
+                    self.params, jnp.asarray(tokens), jnp.asarray(valid)
+                )
+                return np.asarray(out)
+
+            out = await loop.run_in_executor(self._executor, run)
+            outs.append(out[: len(chunk)])
+        return np.concatenate(outs, axis=0)
 
     async def generate(
         self,
@@ -1261,6 +1307,8 @@ class InferenceEngine:
                 self.params, self.kv_cache, self._bias, *args
             )
             self.kv_cache = out[-1]
+        elif op == "embed":
+            self._jit_embed(self.params, *args)
         elif op == "copy_in":
             self.kv_cache = self._copy_in(self.kv_cache, self._pool, *args)
         elif op == "copy_out":

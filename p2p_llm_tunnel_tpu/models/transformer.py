@@ -338,6 +338,29 @@ def prefill(
     return _logits(cfg, params, x), ks, vs
 
 
+def encode_pooled(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] right-padded
+    valid: jnp.ndarray,  # [B, T] bool
+    mesh=None,
+) -> jnp.ndarray:
+    """Mean-pooled, L2-normalized final hidden states — the embeddings
+    surface (/v1/embeddings, Ollama /api/embed).  Masked mean over the
+    real tokens of the post-final-norm activations; a standard last-layer
+    pooling baseline that becomes genuinely useful with real checkpoints.
+    Returns [B, Dm] float32."""
+    x = _embed(cfg, params, tokens)
+    attention = _prefill_attention_fn(cfg, mesh, tokens.shape[1])
+    x, _ks, _vs = apply_blocks(cfg, params["blocks"], x, valid, attention)
+    x = _norm(cfg, x, params["final_norm"]).astype(jnp.float32)
+    m = valid[..., None].astype(jnp.float32)
+    pooled = (x * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
+
+
 def apply_blocks(
     cfg: ModelConfig,
     blocks: Params,  # stacked [L_chunk, ...] (the whole stack or a pp stage)

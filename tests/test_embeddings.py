@@ -1,0 +1,103 @@
+"""Embeddings surface: /v1/embeddings + Ollama /api/embed(dings).
+
+Mean-pooled, L2-normalized final hidden states.  Structural contracts:
+unit norm, determinism, padding-invariance (an input's vector must not
+change with batch composition or padded width), and all three response
+shapes.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints import http11
+from tests.test_engine_tunnel import engine_stack
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+
+async def _post(base, path, payload):
+    resp = await http11.http_request(
+        "POST", f"{base}{path}", {"content-type": "application/json"},
+        json.dumps(payload).encode(), timeout=120.0,
+    )
+    return resp.status, json.loads(await resp.read_all())
+
+
+def test_openai_embeddings_shape_and_invariance():
+    async def run():
+        async with engine_stack() as (base, engine):
+            status, obj = await _post(base, "/v1/embeddings",
+                                      {"input": ["abc", "hello world"]})
+            assert status == 200
+            assert obj["object"] == "list"
+            assert [d["index"] for d in obj["data"]] == [0, 1]
+            v0 = np.asarray(obj["data"][0]["embedding"])
+            assert v0.shape == (engine.mcfg.dim,)
+            assert abs(np.linalg.norm(v0) - 1.0) < 1e-4
+            assert obj["usage"]["prompt_tokens"] == len("abc") + len(
+                "hello world")
+
+            # Determinism + batch-composition invariance.
+            _, solo = await _post(base, "/v1/embeddings", {"input": "abc"})
+            v_solo = np.asarray(solo["data"][0]["embedding"])
+            np.testing.assert_allclose(v0, v_solo, atol=1e-5)
+            # Different padded width (longer sibling forces a wider
+            # bucket): the masked pooling must ignore padding entirely.
+            _, wide = await _post(base, "/v1/embeddings", {
+                "input": ["abc", "a" * 60]})
+            v_wide = np.asarray(wide["data"][0]["embedding"])
+            np.testing.assert_allclose(v0, v_wide, atol=1e-4)
+
+    asyncio.run(run())
+
+
+def test_ollama_embed_shapes():
+    async def run():
+        async with engine_stack() as (base, engine):
+            status, obj = await _post(base, "/api/embed",
+                                      {"input": ["abc", "def"]})
+            assert status == 200
+            assert len(obj["embeddings"]) == 2
+            assert len(obj["embeddings"][0]) == engine.mcfg.dim
+
+            status, obj = await _post(base, "/api/embeddings",
+                                      {"prompt": "abc"})
+            assert status == 200
+            assert len(obj["embedding"]) == engine.mcfg.dim
+
+            status, _ = await _post(base, "/v1/embeddings", {"input": []})
+            assert status == 400
+
+    asyncio.run(run())
+
+
+def test_embed_param_edges():
+    """Generation params must not poison embeddings requests; Ollama
+    truncate defaults on; OpenAI unsupported knobs 400."""
+    async def run():
+        async with engine_stack() as (base, engine):
+            # Over-length input truncates (Ollama default) instead of 400.
+            status, obj = await _post(base, "/api/embed",
+                                      {"input": "x" * 500})
+            assert status == 200
+            # Generation-only params are ignored for embeddings.
+            status, _ = await _post(base, "/api/embed", {
+                "input": "abc", "options": {"num_predict": 0}})
+            assert status == 200
+            # OpenAI: unsupported knobs rejected loudly; overlong rejected.
+            status, _ = await _post(base, "/v1/embeddings", {
+                "input": "abc", "encoding_format": "base64"})
+            assert status == 400
+            status, _ = await _post(base, "/v1/embeddings", {
+                "input": "abc", "dimensions": 8})
+            assert status == 400
+            status, _ = await _post(base, "/v1/embeddings",
+                                    {"input": "x" * 500})
+            assert status == 400
+
+    asyncio.run(run())
