@@ -43,13 +43,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: then nice-to-haves.
 GRID = [
     ("base-32x16", {}),
-    ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
-    ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
+    # r5 on-chip reality check (01:05 window): base banked 1053 tok/s /
+    # TTFT 1084 ms and the chip wedged one config later — windows are
+    # ~one config long.  So the single most valuable row is a composed
+    # best-guess throughput shot, not another ablation: 64 slots amortise
+    # the per-step host path 2x, 32 steps halve fetch round-trips (this
+    # host has ONE core; the host path is the contended resource), int8 KV
+    # + S-grid flash decode cut the decode HBM term.
+    ("hero-64x32", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+                    "BENCH_DECODE_STEPS": "32", "BENCH_KV_QUANT": "int8",
+                    "BENCH_FLASH_SGRID": "1"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
-    ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
+    ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     ("flash-sgrid", {"BENCH_FLASH_SGRID": "1"}),
     # int8 KV + in-kernel dequant: the two decode-HBM levers composed.
     ("kv8-sgrid", {"BENCH_KV_QUANT": "int8", "BENCH_FLASH_SGRID": "1"}),
+    ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
+    ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
+    ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
     ("ctx2048", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
                  "BENCH_CLIENTS": "16"}),
     ("ctx2048-kv8", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
@@ -65,7 +76,6 @@ GRID = [
                             "BENCH_MAX_TOKENS": "64",
                             "BENCH_PREFILL_CHUNK": "256"}),
     ("steps8", {"BENCH_DECODE_STEPS": "8"}),
-    ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     # Same config as base with a jax.profiler trace of the measured
     # window — the on-chip evidence VERDICT r3 item 1 asked for
     # (profile_out/ is gitignored; findings go to PERF.md).
@@ -185,6 +195,7 @@ def main() -> None:
     # are skipped.  Only rows WITH a ts field count — pre-r5 rows in the
     # accumulated jsonl predate the current methodology.
     done_labels: set = set()
+    poison_labels: set = set()
     if os.environ.get("SWEEP_SKIP_DONE") == "1" and os.path.exists(out_path):
         with open(out_path) as f:
             for line in f:
@@ -199,8 +210,17 @@ def main() -> None:
                 if (r.get("ts") and not r.get("error") and "value" in r
                         and (not require_tpu or not r.get("no_tpu"))):
                     done_labels.add(r.get("sweep_label"))
+                # A config that wedged the chip mid-run (r4: pf8-off, r5:
+                # pfx-off) must not burn the NEXT scarce window first thing
+                # on resume — defer it behind every not-yet-banked config.
+                # (Whether it caused the wedge or was merely present for it,
+                # the cheap insurance is the same.)
+                if r.get("error") in ("chip_gone_during_run", "timeout"):
+                    poison_labels.add(r.get("sweep_label"))
 
-    for label, overrides in GRID:
+    grid = sorted(GRID, key=lambda e: e[0] in poison_labels
+                  and e[0] not in done_labels)
+    for label, overrides in grid:
         if label in done_labels:
             print(f"skip {label}: already banked", file=sys.stderr)
             continue
