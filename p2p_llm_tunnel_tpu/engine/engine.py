@@ -302,7 +302,7 @@ class InferenceEngine:
             from p2p_llm_tunnel_tpu.engine.prefix_cache import (
                 PrefixIndex,
                 init_pool,
-                make_copy_ops,
+                make_batch_copy_ops,
             )
 
             blk = self.ecfg.min_prefill_bucket
@@ -336,8 +336,12 @@ class InferenceEngine:
                 # Pool leaves are rank-congruent with cache leaves (K axis
                 # in the same place), so the cache specs apply verbatim.
                 self._pool = shard_kv_cache(self._pool, self.mesh)
-            self._copy_in, self._copy_out = make_copy_ops(
-                blk, self._prefix_max_blocks
+            # Row-batched (prefill_rows-wide) copy programs: one dispatch
+            # per admission-wave sub-batch, not per request — per-request
+            # dispatches through the device tunnel tripled prefill p50 in
+            # the r5 on-chip window (PERF.md).
+            self._copy_in, self._copy_out = make_batch_copy_ops(
+                blk, self._prefix_max_blocks, self.ecfg.prefill_rows
             )
             if self._spmd is not None:
                 self._copy_in = self._spmd.wrap("copy_in", self._copy_in, 2)
@@ -763,16 +767,23 @@ class InferenceEngine:
         """Compile the prefix-cache programs (both copy ops + every
         tail-bucket chunk prefill) against scratch rows so none of them
         cold-compiles on the serving path (executor thread)."""
-        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_rows
 
         t0 = time.monotonic()
-        pids, bnos = pad_ids([0], [0], self._prefix_max_blocks, scratch=None)
-        self.kv_cache = self._copy_in(
-            self.kv_cache, self._pool, self._scratch_slot, pids, bnos
+        pr = self.ecfg.prefill_rows
+        slots, pids, bnos = pad_rows(
+            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
+            scratch=None,
         )
-        pids, bnos = pad_ids([0], [0], self._prefix_max_blocks, scratch=0)
+        self.kv_cache = self._copy_in(
+            self.kv_cache, self._pool, slots, pids, bnos
+        )
+        slots, pids, bnos = pad_rows(
+            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
+            scratch=0,
+        )
         self._pool = self._copy_out(
-            self._pool, self.kv_cache, self._scratch_slot, pids, bnos
+            self._pool, self.kv_cache, slots, pids, bnos
         )
         views = self._view_buckets()
         for t in self._chunk_buckets:
@@ -1511,43 +1522,51 @@ class InferenceEngine:
             self._positions[slot] = out.cache_len - 1
         self._emit(out, tok, evicted, lp_info, prompt_lps)
 
-    def _prefix_copy_in(self, run: RunningSlot, pool_ids: List[int]) -> None:
-        """Copy matched pool blocks into the run's slot (executor thread)."""
-        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
+    def _prefix_copy_in(self, hits: List[Tuple[int, List[int]]]) -> None:
+        """Copy matched pool blocks into the hit slots (executor thread):
+        ``hits`` is [(slot, pool_ids)], ONE batched dispatch per
+        prefill_rows-wide sub-batch."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_rows
 
-        pids, bnos = pad_ids(
-            pool_ids, list(range(len(pool_ids))),
-            self._prefix_max_blocks, scratch=None,
-        )
-        self.kv_cache = self._copy_in(
-            self.kv_cache, self._pool, run.slot, pids, bnos
+        pr = self.ecfg.prefill_rows
+        for lo in range(0, len(hits), pr):
+            entries = [
+                (slot, ids, list(range(len(ids))))
+                for slot, ids in hits[lo : lo + pr]
+            ]
+            slots, pids, bnos = pad_rows(
+                entries, pr, self._prefix_max_blocks, scratch=None
+            )
+            self.kv_cache = self._copy_in(
+                self.kv_cache, self._pool, slots, pids, bnos
+            )
+
+    def _prefix_insert(self, runs: List[RunningSlot]) -> None:
+        """Save the runs' now-prefilled, not-yet-pooled prompt blocks into
+        the pool (executor thread), one batched dispatch per prefill_rows.
+        Same-wave eviction hazards are handled by
+        :func:`prefix_cache.plan_inserts` (see its docstring)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            pad_rows,
+            plan_inserts,
         )
 
-    def _prefix_insert(self, run: RunningSlot) -> None:
-        """Save this run's now-prefilled full prompt blocks into the pool
-        (executor thread); blocks already pooled are skipped."""
-        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_ids
-
-        missing = self._prefix.missing(run.request.prompt_ids)
-        if not missing:
-            return
-        keys = [k for _, k in missing]
-        blk_nos = [i for i, _ in missing]
-        pool_ids = self._prefix.allocate(keys)
-        if not pool_ids:
-            return
-        # allocate() may return a PREFIX of the request when the pool is
-        # smaller than the prompt; insert exactly the blocks that got ids.
-        blk_nos = blk_nos[: len(pool_ids)]
-        pids, bnos = pad_ids(
-            pool_ids, blk_nos, self._prefix_max_blocks, scratch=0
+        entries = plan_inserts(
+            self._prefix,
+            [(run.slot, run.request.prompt_ids) for run in runs],
         )
-        self._pool = self._copy_out(
-            self._pool, self.kv_cache, run.slot, pids, bnos
-        )
-        global_metrics.inc(
-            "engine_prefix_saved_blocks_total", len(pool_ids)
-        )
+        total = sum(len(ids) for _, ids, _ in entries)
+        pr = self.ecfg.prefill_rows
+        for lo in range(0, len(entries), pr):
+            slots, pids, bnos = pad_rows(
+                entries[lo : lo + pr], pr, self._prefix_max_blocks,
+                scratch=0,
+            )
+            self._pool = self._copy_out(
+                self._pool, self.kv_cache, slots, pids, bnos
+            )
+        if total:
+            global_metrics.inc("engine_prefix_saved_blocks_total", total)
 
     async def _admit_pending(self, loop) -> None:
         """Batched prefill: one XLA call per prompt-length bucket chunk.
@@ -1588,21 +1607,23 @@ class InferenceEngine:
         # prefill_chunk-wide program, so a long tail composes with any
         # history length.)
         if self.ecfg.prefill_chunk > 0:
+            seg_hits: List[Tuple[int, List[int]]] = []
             for run in list(admitted):
                 if run.request.echo_logprobs:
                     continue  # echo: whole-prompt prefill only (see above)
                 hist = hist_of[run.slot]
                 if len(run.request.prompt_ids) - hist > self.ecfg.prefill_chunk:
                     if hist:
-                        await loop.run_in_executor(
-                            self._executor, self._prefix_copy_in,
-                            run, pool_ids_of[run.slot],
-                        )
+                        seg_hits.append((run.slot, pool_ids_of[run.slot]))
                         global_metrics.inc(
                             "engine_prefix_hit_tokens_total", hist
                         )
                     self._segmented[run.slot] = (run, hist)
                     admitted.remove(run)
+            if seg_hits:
+                await loop.run_in_executor(
+                    self._executor, self._prefix_copy_in, seg_hits
+                )
         # Group by (tail bucket, cached?): cached runs use the chunk-prefill
         # program, whose bucket is the tail length.  A matched prefix whose
         # tail exceeds every compiled chunk bucket is dropped back to the
@@ -1628,11 +1649,10 @@ class InferenceEngine:
         for t, cached, echo, runs in chunked:
             t0 = time.monotonic()
             if cached:
-                for run in runs:
-                    await loop.run_in_executor(
-                        self._executor, self._prefix_copy_in,
-                        run, pool_ids_of[run.slot],
-                    )
+                await loop.run_in_executor(
+                    self._executor, self._prefix_copy_in,
+                    [(run.slot, pool_ids_of[run.slot]) for run in runs],
+                )
             hists = [hist_of[r.slot] for r in runs] if cached else None
             first_dev = await loop.run_in_executor(
                 self._executor, self._dispatch_prefill_batch, runs, t, hists,
@@ -1668,11 +1688,11 @@ class InferenceEngine:
         # Pool inserts run after EVERY first token of the wave is out —
         # they only pay off future admissions, so they must not sit between
         # a chunk's fetch and the next chunk's (the TTFT-critical path).
-        for run in inserts:
-            if self.scheduler.slots[run.slot] is run:
-                await loop.run_in_executor(
-                    self._executor, self._prefix_insert, run
-                )
+        live = [r for r in inserts if self.scheduler.slots[r.slot] is r]
+        if live:
+            await loop.run_in_executor(
+                self._executor, self._prefix_insert, live
+            )
 
     def _dispatch_segments(self):
         """Advance up to ``prefill_rows`` chunked-prefill slots by ONE
@@ -1722,6 +1742,7 @@ class InferenceEngine:
             self._executor,
             lambda: jax.tree.map(np.asarray, jax.device_get(first_dev)),
         )
+        inserts: List[RunningSlot] = []
         for i, ((run, final), first) in enumerate(
             zip(rows, firsts[: len(rows)])
         ):
@@ -1731,9 +1752,11 @@ class InferenceEngine:
             lp_row = None if lp is None else (lp[0][i], lp[1][i], lp[2][i])
             self._account_token(run.slot, int(first), lp_row)
             if self._prefix is not None:
-                await loop.run_in_executor(
-                    self._executor, self._prefix_insert, run
-                )
+                inserts.append(run)
+        if inserts:
+            await loop.run_in_executor(
+                self._executor, self._prefix_insert, inserts
+            )
 
     async def _process_burst(self, outs, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.

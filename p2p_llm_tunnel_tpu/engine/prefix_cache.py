@@ -132,6 +132,12 @@ class PrefixIndex:
             self.hits += 1
         return len(ids) * self.block, ids
 
+    def id_of(self, key: bytes) -> Optional[int]:
+        """Current pool slot for ``key`` (no LRU touch), or None if evicted
+        — the engine's batched insert uses this to drop (key, id) pairs a
+        later same-wave allocation evicted."""
+        return self._lru.get(key)
+
     def missing(self, prompt_ids) -> List[Tuple[int, bytes]]:
         """Fully-covered prompt blocks not yet pooled: [(block_no, key)]."""
         return [
@@ -305,6 +311,151 @@ def make_copy_ops(block: int, max_blocks: int):
     return (
         jax.jit(blocks_to_cache, donate_argnums=(0,)),
         jax.jit(cache_to_pool, donate_argnums=(0,)),
+    )
+
+
+def plan_inserts(
+    index: PrefixIndex, wave: List[Tuple[int, List[int]]]
+) -> List[Tuple[int, List[int], List[int]]]:
+    """Host-side planning for a batched pool insert: allocate blocks for
+    every run's missing prompt blocks, then drop pairs a later same-wave
+    allocation evicted.
+
+    ``wave`` is [(slot, prompt_ids)].  All index updates happen here for
+    the WHOLE wave before any device copy; with a tiny pool and a big wave
+    a later run's allocation may evict an earlier run's fresh key, and
+    writing both into one batched scatter would leave the block holding
+    whichever content the scatter ordered last while the index points at
+    one of them.  The filter keeps only (key, id) pairs the index still
+    maps exactly as allocated — the index is a bijection (one id per key),
+    so surviving ids are wave-distinct and every surviving write is the
+    content its key names.
+
+    Returns [(slot, pool_ids, blk_nos)] ready for :func:`pad_rows`.
+    """
+    allocs: List[Tuple[int, List[bytes], List[int], List[int]]] = []
+    for slot, prompt_ids in wave:
+        missing = index.missing(prompt_ids)
+        if not missing:
+            continue
+        keys = [k for _, k in missing]
+        blk_nos = [i for i, _ in missing]
+        # allocate() may return a PREFIX of the request when the pool is
+        # smaller than the prompt; insert exactly what got ids.
+        ids = index.allocate(keys)
+        if ids:
+            allocs.append((slot, keys[: len(ids)], blk_nos[: len(ids)], ids))
+    entries: List[Tuple[int, List[int], List[int]]] = []
+    seen: set = set()
+    for slot, keys, blks, ids in allocs:
+        # The per-wave ``seen`` dedupe closes the remaining aliasing hole:
+        # two runs sharing a prompt can BOTH end up with the same live
+        # (key, id) pair when eviction ping-pongs the id (A allocates k->i,
+        # C evicts k reusing i, D re-allocates k back onto i).  Both writes
+        # would hold KV of the same token prefix, but a duplicate id in one
+        # scatter is formally nondeterministic — keep the first pair only.
+        live = [
+            (i, b)
+            for k, b, i in zip(keys, blks, ids)
+            if index.id_of(k) == i and i not in seen
+        ]
+        if live:
+            seen.update(i for i, _ in live)
+            entries.append(
+                (slot, [i for i, _ in live], [b for _, b in live])
+            )
+    return entries
+
+
+def make_batch_copy_ops(block: int, max_blocks: int, rows: int):
+    """Row-batched copy programs: ONE dispatch serves up to ``rows``
+    requests' block copies.
+
+    r5 on-chip finding (PERF.md): per-request copy dispatches serialize on
+    the engine's XLA executor ahead of the wave's prefills, and through the
+    device tunnel each dispatch costs a host round-trip — a 32-client
+    admission wave paid ~32 extra round-trips and prefill p50 tripled vs
+    the r4 pre-prefix-cache measurement.  Batching the wave's copies into
+    one program makes the prefix-cache dispatch cost O(1) per wave instead
+    of O(clients).
+
+    Same static-shape discipline as :func:`make_copy_ops`: ids pad
+    within-row (clamped duplicate pairs / scratch block 0) AND across rows
+    (row 0 repeated, or all-scratch rows), so each op compiles once ever.
+    """
+
+    def blocks_to_cache(cache, pool, slots, pool_ids, blk_nos):
+        """cache[slots[r]] positions [blk_nos[r,i]*B, +B) <- pool[pool_ids[r,i]].
+
+        slots [R]; pool_ids/blk_nos [R, Nmax].  Padding rows repeat a real
+        row — duplicate scatters write identical bytes, so order cannot
+        matter."""
+        offs = jnp.arange(block)[None, None, :]
+        pos = (blk_nos[:, :, None] * block + offs).reshape(rows, -1)
+        out = dict(cache)
+        for key, arr in cache.items():
+            vals = pool[key][:, pool_ids]  # [L, R, Nmax, B, ...]
+            flat = vals.reshape(
+                (vals.shape[0], rows, pos.shape[1]) + vals.shape[4:]
+            )
+            out[key] = arr.at[:, slots[:, None], pos].set(flat)
+        return out
+
+    def cache_to_pool(pool, cache, slots, pool_ids, blk_nos):
+        """pool[pool_ids[r,i]] <- cache[slots[r]]; padding (within-row and
+        whole rows) targets the scratch pool block 0, which is never
+        matched.  Real pool ids must be wave-distinct — the caller filters
+        same-wave eviction casualties so the flat scatter never writes two
+        different contents to one live block."""
+        offs = jnp.arange(block)[None, None, :]
+        pos = (blk_nos[:, :, None] * block + offs).reshape(rows, -1)
+        flat_ids = pool_ids.reshape(-1)
+        out = dict(pool)
+        for key, arr in pool.items():
+            vals = cache[key][:, slots[:, None], pos]  # [L, R, Nmax*B, ...]
+            vals = vals.reshape(
+                (vals.shape[0], rows * max_blocks, block) + vals.shape[3:]
+            )
+            out[key] = arr.at[:, flat_ids].set(vals)
+        return out
+
+    return (
+        jax.jit(blocks_to_cache, donate_argnums=(0,)),
+        jax.jit(cache_to_pool, donate_argnums=(0,)),
+    )
+
+
+def pad_rows(
+    entries: List[Tuple[int, List[int], List[int]]],
+    rows: int, max_blocks: int, scratch: Optional[int],
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pad ``[(slot, pool_ids, blk_nos)]`` to the static [R]/[R, Nmax]
+    shapes of :func:`make_batch_copy_ops`.
+
+    Within-row padding follows :func:`pad_ids` (duplicate last pair /
+    scratch target); missing rows repeat row 0 for cache<-pool copies
+    (identical duplicate writes) or write scratch-only rows for
+    pool<-cache copies."""
+    assert 0 < len(entries) <= rows
+    slots: List[int] = []
+    pids: List[List[int]] = []
+    bnos: List[List[int]] = []
+    for slot, ids, blks in entries:
+        n = len(ids)
+        assert 0 < n <= max_blocks and len(blks) == n
+        pad = scratch if scratch is not None else ids[-1]
+        slots.append(slot)
+        pids.append(list(ids) + [pad] * (max_blocks - n))
+        bnos.append(list(blks) + [blks[-1]] * (max_blocks - n))
+    while len(slots) < rows:
+        slots.append(slots[0])
+        pids.append([scratch] * max_blocks if scratch is not None
+                    else pids[0])
+        bnos.append(bnos[0])
+    return (
+        jnp.asarray(slots, jnp.int32),
+        jnp.asarray(pids, jnp.int32),
+        jnp.asarray(bnos, jnp.int32),
     )
 
 

@@ -334,6 +334,111 @@ def test_copy_ops_roundtrip():
         np.testing.assert_array_equal(np.asarray(cache[k][:, 0]), orig[k][:, 0])
 
 
+def test_plan_inserts_same_wave_eviction_keeps_ids_distinct():
+    """With a pool smaller than one admission wave, a later run's
+    allocation evicts an earlier run's fresh keys.  plan_inserts must drop
+    the evicted pairs so the batched scatter never writes two different
+    runs' KV into one live pool block, and every surviving (key -> id)
+    mapping must match what the index will serve on later hits."""
+    from p2p_llm_tunnel_tpu.engine.prefix_cache import plan_inserts
+
+    block = 4
+    idx = PrefixIndex(block, capacity=4)  # scratch + 3 real blocks
+    # Three runs x 2 blocks = 6 blocks wanted, 3 available: run C's
+    # allocation evicts run A's keys (LRU order = insertion order here).
+    wave = [
+        (0, list(range(100, 100 + 2 * block))),
+        (1, list(range(200, 200 + 2 * block))),
+        (2, list(range(300, 300 + 2 * block))),
+    ]
+    entries = plan_inserts(idx, wave)
+    # Surviving pool ids are distinct across the whole wave — the batched
+    # scatter invariant.
+    flat = [i for _, ids, _ in entries for i in ids]
+    assert len(flat) == len(set(flat)) and flat
+    assert all(i != 0 for i in flat)  # scratch is never a real target
+    # Every surviving id is exactly what the index maps that slot's block
+    # to now — i.e. later matches will read the content this wave wrote.
+    for slot, ids, blks in entries:
+        prompt = dict(wave)[slot]
+        keys = idx._keys_of(prompt)
+        for i, b in zip(ids, blks):
+            assert idx.id_of(keys[b]) == i
+    # Duplicate prompts across a wave dedupe: the second run has nothing
+    # missing once the first allocated, whatever survived eviction.
+    idx2 = PrefixIndex(block, capacity=6)
+    dup = [(0, list(range(50, 50 + 2 * block))),
+           (1, list(range(50, 50 + 2 * block)))]
+    entries2 = plan_inserts(idx2, dup)
+    assert len(entries2) == 1 and entries2[0][0] == 0
+    # Eviction ping-pong: A allocates k->1, C evicts k reusing id 1, D
+    # (same prompt as A) re-allocates k back onto id 1.  A's and D's pairs
+    # both pass the id_of filter; exactly ONE may reach the scatter.
+    idx3 = PrefixIndex(block, capacity=2)  # scratch + one real block
+    pp = [(0, list(range(100, 100 + block + 1))),
+          (1, list(range(200, 200 + block + 1))),
+          (2, list(range(100, 100 + block + 1)))]
+    entries3 = plan_inserts(idx3, pp)
+    flat3 = [i for _, ids, _ in entries3 for i in ids]
+    assert flat3 == [1]  # one surviving write for pool block 1, not two
+
+
+def test_batch_copy_ops_match_sequential_single_ops():
+    """The row-batched programs (one dispatch per admission wave) must be
+    bit-identical to running the single-slot ops sequentially, including
+    within-row padding and repeated/scratch padding rows."""
+    from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+        make_batch_copy_ops,
+        pad_rows,
+    )
+
+    cfg = get_config("tiny")
+    block, cap, rows = 4, 8, 3
+    max_blocks = 16 // block
+    cache = init_kv_cache(cfg, 4, 16, jnp.float32)
+    key = jax.random.PRNGKey(23)
+    cache = {
+        k: jax.random.normal(jax.random.fold_in(key, i), v.shape, v.dtype)
+        for i, (k, v) in enumerate(cache.items())
+    }
+    pool = init_pool(cache, block, cap)
+    copy_in, copy_out = make_copy_ops(block, max_blocks)
+    bcopy_in, bcopy_out = make_batch_copy_ops(block, max_blocks, rows)
+
+    # Two slots save different numbers of blocks (within-row padding) and
+    # only two real rows (row padding targets scratch).
+    entries = [(1, [3, 4, 5], [0, 1, 2]), (2, [6, 7], [0, 1])]
+    # Both ops donate their first argument — hand each its own copy.
+    seq_pool = jax.tree.map(jnp.copy, pool)
+    for slot, ids, blks in entries:
+        pids, bnos = pad_ids(ids, blks, max_blocks, scratch=0)
+        seq_pool = copy_out(seq_pool, cache, slot, pids, bnos)
+    slots, pids, bnos = pad_rows(entries, rows, max_blocks, scratch=0)
+    bat_pool = bcopy_out(jax.tree.map(jnp.copy, pool), cache, slots, pids,
+                         bnos)
+    for k in pool:
+        # Scratch block 0 content is undefined (padding target) — compare
+        # the real blocks only.
+        np.testing.assert_array_equal(
+            np.asarray(seq_pool[k][:, 1:]), np.asarray(bat_pool[k][:, 1:])
+        )
+
+    # Restore into two other slots; batch (with a duplicated padding row)
+    # must equal sequential single-slot restores.
+    entries_in = [(0, [3, 4, 5], [0, 1, 2]), (3, [6, 7], [0, 1])]
+    seq_cache = jax.tree.map(jnp.copy, cache)
+    for slot, ids, blks in entries_in:
+        p, b = pad_ids(ids, blks, max_blocks, scratch=None)
+        seq_cache = copy_in(seq_cache, bat_pool, slot, p, b)
+    slots, pids, bnos = pad_rows(entries_in, rows, max_blocks, scratch=None)
+    bat_cache = bcopy_in(jax.tree.map(jnp.copy, cache), bat_pool, slots,
+                         pids, bnos)
+    for k in cache:
+        np.testing.assert_array_equal(
+            np.asarray(seq_cache[k]), np.asarray(bat_cache[k])
+        )
+
+
 # ---------------------------------------------------------------------------
 # engine end-to-end
 # ---------------------------------------------------------------------------
