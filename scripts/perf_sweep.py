@@ -53,6 +53,15 @@ GRID = [
     ("hero-64x32", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
                     "BENCH_DECODE_STEPS": "32", "BENCH_KV_QUANT": "int8",
                     "BENCH_FLASH_SGRID": "1"}),
+    # base-32x16 re-run AFTER the batched prefix-copy fix (3a3c141): the
+    # banked 01:05 row measured per-request copy dispatches (prefill p50
+    # 964 ms); this label is the default-config datapoint for BENCH_r05.
+    ("base-32x16-v2", {}),
+    # Joint-target variant: 48 slots raise the decode ceiling without the
+    # 64-wide admission herd that blows the <400 ms TTFT bar.
+    ("hero-48x24", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48",
+                    "BENCH_DECODE_STEPS": "24", "BENCH_KV_QUANT": "int8",
+                    "BENCH_FLASH_SGRID": "1"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
     ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     ("flash-sgrid", {"BENCH_FLASH_SGRID": "1"}),
