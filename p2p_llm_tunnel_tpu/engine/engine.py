@@ -252,6 +252,7 @@ class InferenceEngine:
         self._spmd = SpmdCoordinator.maybe(mesh)
         self._spmd_stop_sent = False
         self._crashed = False
+        self._warming = False  # warmup dispatches skip D2H copy enqueue
         if mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import (
                 param_shardings as _pshard,
@@ -698,22 +699,26 @@ class InferenceEngine:
         if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
             steps.add(self.ecfg.decode_steps_eager)
         t0 = time.monotonic()
-        for view in views:
-            for k in sorted(steps):
-                def _one(view=view, k=k):
-                    outs, _ = self._dispatch_decode(view=view, steps=k)
-                    jax.block_until_ready(outs[0])
-                await loop.run_in_executor(self._executor, _one)
-        log.info(
-            "decode warmup: %d view×steps variants compiled in %.1fs",
-            len(views) * len(steps), time.monotonic() - t0,
-        )
-        if self.ecfg.spec_ngram > 0:
+        self._warming = True
+        try:
             for view in views:
-                def _one_spec(view=view):
-                    outs, _ = self._dispatch_spec(view=view)
-                    # nothing to process: no rows are active during warmup
-                await loop.run_in_executor(self._executor, _one_spec)
+                for k in sorted(steps):
+                    def _one(view=view, k=k):
+                        outs, _ = self._dispatch_decode(view=view, steps=k)
+                        jax.block_until_ready(outs[0])
+                    await loop.run_in_executor(self._executor, _one)
+            log.info(
+                "decode warmup: %d view×steps variants compiled in %.1fs",
+                len(views) * len(steps), time.monotonic() - t0,
+            )
+            if self.ecfg.spec_ngram > 0:
+                for view in views:
+                    def _one_spec(view=view):
+                        outs, _ = self._dispatch_spec(view=view)
+                        # nothing to process: no rows active during warmup
+                    await loop.run_in_executor(self._executor, _one_spec)
+        finally:
+            self._warming = False
         if self._prefix is not None:
             await loop.run_in_executor(self._executor, self._warm_prefix)
         if self.ecfg.prefill_chunk > 0:
@@ -1036,7 +1041,27 @@ class InferenceEngine:
                 self._next_key(),
             )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, (lp if lps.any() else None), plp
+        out = first, (lp if lps.any() else None), plp
+        self._start_host_copy(out)
+        return out
+
+    def _start_host_copy(self, tree) -> None:
+        """Begin the device→host transfer of every array in ``tree``
+        without blocking (executor thread, right after dispatch).  The
+        copy queues behind the producing computation on the device, so by
+        the time the pipelined fetch calls device_get the bytes are
+        already host-side.  Without this the ~90 ms tunnel RTT per fetch
+        started only AT the fetch: the decode-fetch p50 measured it
+        almost entirely un-hidden despite the dispatch/fetch pipelining
+        (PERF.md r5 session 2).  Warmup dispatches are discarded, never
+        fetched — no copies for them."""
+        if self._warming:
+            return
+        jax.tree.map(
+            lambda x: x.copy_to_host_async()
+            if hasattr(x, "copy_to_host_async") else None,
+            tree,
+        )
 
     def _dispatch_chunk_rows(self, rows, t: int):
         """Pack rows of ``(run, start, segment_ids, sample?)`` into ONE
@@ -1102,7 +1127,9 @@ class InferenceEngine:
             view,
         )
         global_metrics.inc("engine_prefill_tokens_total", total)
-        return first, (lp if lps.any() else None), None
+        out = first, (lp if lps.any() else None), None
+        self._start_host_copy(out)
+        return out
 
     def _view_buckets(self) -> List[int]:
         """The full set of kv-view buckets this engine can ever dispatch:
@@ -1233,6 +1260,7 @@ class InferenceEngine:
         # link where transfer time is the bottleneck.
         if not np.any(np.where(active, self._logprobs, 0)):
             lp_out = None
+        self._start_host_copy((sampled, lp_out))
         return (sampled, lp_out), assign
 
     def _prefix_snapshot_meta(self) -> dict:
