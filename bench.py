@@ -185,6 +185,14 @@ async def _run_attempt(model: str) -> dict:
         # tiny is the CPU correctness/fallback path; keep it light.
         clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
 
+    prompt = "Benchmark this tunnel with a steady stream of tokens."
+    # Long-prompt runs (chunked-prefill / long-context configs): repeat the
+    # base text to ~BENCH_PROMPT_TOKENS byte-tokens.
+    want_tokens = int(os.environ.get("BENCH_PROMPT_TOKENS", "0"))
+    if want_tokens > 0:
+        reps = max(1, want_tokens // (len(prompt) + 1))
+        prompt = " ".join([prompt] * reps)
+
     _log(
         f"attempt model={model} clients={clients} max_tokens={max_tokens} "
         f"slots={slots} decode_steps={decode_steps} quant={quant} "
@@ -214,6 +222,24 @@ async def _run_attempt(model: str) -> dict:
     )
     _log(f"engine init (weights on device) took {time.monotonic() - t0:.1f}s")
     await engine.start()
+
+    # Warmup hints (see engine._warmup_views / _warm_aot_parallel): the
+    # bench KNOWS its maximum reachable context — the server's OWN chat
+    # rendering of the longest client prompt, tokenized by the engine's
+    # OWN tokenizer, +1 BOS, +max_tokens — so warmup can skip kv-view
+    # buckets the traffic cannot hit, and AOT-compile the rest in
+    # parallel.  Fresh compiles cost ~20 s each through the device tunnel
+    # and chip windows last minutes; both hints exist to fit warmup +
+    # measurement inside one window.
+    from p2p_llm_tunnel_tpu.engine.api import render_chat_prompt
+
+    worst = render_chat_prompt(
+        [{"role": "user", "content": f"{prompt} ({clients - 1})"}]
+    )
+    ctx_cap = len(engine.tokenizer.encode(worst)) + 1 + max_tokens
+    os.environ.setdefault("TUNNEL_WARMUP_VIEW_CAP", str(ctx_cap))
+    os.environ.setdefault("TUNNEL_WARMUP_PAR", "4")
+
     t0 = time.monotonic()
     await engine.warmup()
     _log(f"decode warmup (view x steps compiles) took {time.monotonic() - t0:.1f}s")
@@ -227,14 +253,6 @@ async def _run_attempt(model: str) -> dict:
         run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready)
     )
     port = await asyncio.wait_for(ready, 30.0)
-
-    prompt = "Benchmark this tunnel with a steady stream of tokens."
-    # Long-prompt runs (chunked-prefill / long-context configs): repeat the
-    # base text to ~BENCH_PROMPT_TOKENS byte-tokens.
-    want_tokens = int(os.environ.get("BENCH_PROMPT_TOKENS", "0"))
-    if want_tokens > 0:
-        reps = max(1, want_tokens // (len(prompt) + 1))
-        prompt = " ".join([prompt] * reps)
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     profiling = False

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import os
 import time
 from dataclasses import dataclass, replace as dc_replace
 from typing import AsyncIterator, Dict, List, Optional, Tuple
@@ -694,19 +695,28 @@ class InferenceEngine:
         segments made idle-row junk writes unsafe — see the parking comment
         there)."""
         loop = asyncio.get_running_loop()
-        views = self._view_buckets()
+        views = self._warmup_views()
         steps = {self.ecfg.decode_steps}
         if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
             steps.add(self.ecfg.decode_steps_eager)
+        await self._warm_aot_parallel(loop, views, sorted(steps))
         t0 = time.monotonic()
         self._warming = True
         try:
             for view in views:
                 for k in sorted(steps):
+                    t1 = time.monotonic()
+
                     def _one(view=view, k=k):
                         outs, _ = self._dispatch_decode(view=view, steps=k)
                         jax.block_until_ready(outs[0])
                     await loop.run_in_executor(self._executor, _one)
+                    dt = time.monotonic() - t1
+                    if dt > 1.0:
+                        log.info(
+                            "decode warmup[v%d,k%d] ready in %.1fs",
+                            view, k, dt,
+                        )
             log.info(
                 "decode warmup: %d view×steps variants compiled in %.1fs",
                 len(views) * len(steps), time.monotonic() - t0,
@@ -731,21 +741,57 @@ class InferenceEngine:
                         self.ecfg.prefill_chunk, view,
                     )
 
-    def _warm_chunk_program(self, t: int, view: int) -> None:
-        """Compile the chunk-prefill program at tail width ``t`` and kv-view
-        ``view`` against scratch rows (executor thread)."""
-        nb = self.ecfg.prefill_rows
-        samp = sampling.SamplingParams(
-            temperature=jnp.zeros((nb,), jnp.float32),
-            top_k=jnp.zeros((nb,), jnp.int32),
-            top_p=jnp.ones((nb,), jnp.float32),
-            freq_pen=jnp.zeros((nb,), jnp.float32),
-            pres_pen=jnp.zeros((nb,), jnp.float32),
-            logprobs=jnp.zeros((nb,), jnp.int32),
-            seed=jnp.zeros((nb,), jnp.uint32),
-            bias_on=jnp.zeros((nb,), bool),
+    def _warmup_views(self) -> List[int]:
+        """View buckets warmup precompiles.  ``TUNNEL_WARMUP_VIEW_CAP=<n>``
+        is a workload hint — the largest prompt+generated token count any
+        request can reach — that drops buckets the traffic cannot hit
+        (mirroring _kv_view_bucket's pipelining/spec pad).  Dispatch still
+        selects from the FULL bucket list, so an out-of-hint request
+        on-demand-compiles instead of breaking; the hint only trades warmup
+        time against that risk.  On the tunneled-TPU deployment each fresh
+        compile costs ~20 s of a chip window that may only last minutes
+        (PERF.md r5), which is why the bench sets it."""
+        views = self._view_buckets()
+        cap = int(os.environ.get("TUNNEL_WARMUP_VIEW_CAP", "0") or 0)
+        if cap <= 0:
+            return views
+        need = cap + 2 * self.ecfg.decode_steps + 1
+        if self.ecfg.spec_ngram > 0:
+            need += self.ecfg.spec_k
+        needed = next((v for v in views if v >= need), views[-1])
+        return [v for v in views if v <= needed]
+
+    def _warm_samp(self, rows: int) -> sampling.SamplingParams:
+        """Zero-valued sampling plane with the exact dtypes live dispatch
+        uses — warm/AOT programs must hash identically to serving ones."""
+        return sampling.SamplingParams(
+            temperature=jnp.zeros((rows,), jnp.float32),
+            top_k=jnp.zeros((rows,), jnp.int32),
+            top_p=jnp.ones((rows,), jnp.float32),
+            freq_pen=jnp.zeros((rows,), jnp.float32),
+            pres_pen=jnp.zeros((rows,), jnp.float32),
+            logprobs=jnp.zeros((rows,), jnp.int32),
+            seed=jnp.zeros((rows,), jnp.uint32),
+            bias_on=jnp.zeros((rows,), bool),
         )
-        first, _lp, self.kv_cache = self._jit_chunk_prefill(
+
+    def _decode_warm_args(self, view: int, steps: int):
+        """Positional args for a decode-burst program, aval-identical to
+        _dispatch_decode's live call (same shapes/dtypes, zero values)."""
+        rows = self.ecfg.num_slots + 1
+        return (
+            self.params, self.kv_cache, self._dev_tokens,
+            self._dev_positions, self._dev_counts, self._bias,
+            jnp.zeros((rows,), bool), jnp.zeros((rows,), jnp.int32),
+            jnp.zeros((rows,), jnp.int32), self._warm_samp(rows),
+            self._key, view, steps,
+        )
+
+    def _chunk_warm_args(self, t: int, view: int):
+        """Positional args for the chunk-prefill program at tail ``t`` /
+        kv-view ``view`` against scratch rows."""
+        nb = self.ecfg.prefill_rows
+        return (
             self.params,
             self.kv_cache,
             self._bias,
@@ -753,9 +799,142 @@ class InferenceEngine:
             jnp.ones((nb,), jnp.int32),
             jnp.zeros((nb,), jnp.int32),
             jnp.full((nb,), self._scratch_slot, jnp.int32),
-            samp,
-            self._next_key(),
+            self._warm_samp(nb),
+            self._key,
             view,
+        )
+
+    def _spec_warm_args(self, view: int):
+        """Positional args for the spec-verify program, aval-identical to
+        _dispatch_spec's live call."""
+        rows = self.ecfg.num_slots + 1
+        return (
+            self.params, self.kv_cache, self._bias,
+            jnp.zeros((rows, 1 + self.ecfg.spec_k), jnp.int32),
+            jnp.zeros((rows,), jnp.int32), self._warm_samp(rows), view,
+        )
+
+    def _copy_warm_args(self):
+        """(copy_in args, copy_out args) against the scratch slot."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_rows
+
+        pr = self.ecfg.prefill_rows
+        slots_i, pids_i, bnos_i = pad_rows(
+            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
+            scratch=None,
+        )
+        slots_o, pids_o, bnos_o = pad_rows(
+            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
+            scratch=0,
+        )
+        return (
+            (self.kv_cache, self._pool, slots_i, pids_i, bnos_i),
+            (self._pool, self.kv_cache, slots_o, pids_o, bnos_o),
+        )
+
+    async def _warm_aot_parallel(self, loop, views, steps) -> None:
+        """Phase-A warmup: AOT lower+compile every warm program CONCURRENTLY
+        (``TUNNEL_WARMUP_PAR`` threads), then let the serial execute pass
+        load the results back from the persistent compilation cache.
+
+        ``.lower(...).compile()`` traces and compiles without executing —
+        no donation is consumed and no engine state mutates, so unlike the
+        dispatching warmup it is safe to fan out across threads.  XLA
+        releases the GIL during compilation, and on the tunneled-TPU
+        deployment the compile RPCs overlap server-side, turning ~15
+        serial ~20 s compiles into a few parallel waves (PERF.md r5: the
+        03:19 chip window died inside serial warmup compiles).  Results
+        land in the persistent cache keyed by program hash; requires
+        ``jax_compilation_cache_dir`` (without it the AOT executables
+        would be dropped and every program would compile twice), and is
+        skipped under multi-process SPMD where dispatch order must stay
+        rank-identical."""
+        par = int(os.environ.get("TUNNEL_WARMUP_PAR", "0") or 0)
+        if par <= 0 or self._spmd is not None:
+            return
+        if not jax.config.jax_compilation_cache_dir:
+            log.warning(
+                "TUNNEL_WARMUP_PAR set but no jax_compilation_cache_dir; "
+                "skipping parallel AOT warmup"
+            )
+            return
+        await loop.run_in_executor(self._executor, self._ensure_decode_carry)
+        jobs: List[Tuple[str, object]] = []
+        for view in views:
+            for k in steps:
+                jobs.append((
+                    f"decode[v{view},k{k}]",
+                    lambda view=view, k=k: self._jit_decode.lower(
+                        *self._decode_warm_args(view, k)
+                    ),
+                ))
+        if self.ecfg.spec_ngram > 0:
+            for view in views:
+                jobs.append((
+                    f"spec[v{view}]",
+                    lambda view=view: self._jit_spec.lower(
+                        *self._spec_warm_args(view)
+                    ),
+                ))
+        if self._prefix is not None:
+            in_args, out_args = self._copy_warm_args()
+            jobs.append(("copy_in", lambda: self._copy_in.lower(*in_args)))
+            jobs.append(
+                ("copy_out", lambda: self._copy_out.lower(*out_args))
+            )
+            for t in self._chunk_buckets:
+                for view in views:
+                    if view >= t:
+                        jobs.append((
+                            f"chunk[t{t},v{view}]",
+                            lambda t=t, view=view:
+                                self._jit_chunk_prefill.lower(
+                                    *self._chunk_warm_args(t, view)
+                                ),
+                        ))
+        if self.ecfg.prefill_chunk > 0:
+            for view in views:
+                if view >= self.ecfg.prefill_chunk:
+                    c = self.ecfg.prefill_chunk
+                    jobs.append((
+                        f"chunkseg[t{c},v{view}]",
+                        lambda c=c, view=view:
+                            self._jit_chunk_prefill.lower(
+                                *self._chunk_warm_args(c, view)
+                            ),
+                    ))
+
+        def _one(label, thunk):
+            t1 = time.monotonic()
+            try:
+                thunk().compile()
+                log.info(
+                    "warmup aot %s compiled in %.1fs",
+                    label, time.monotonic() - t1,
+                )
+            except Exception as exc:  # best-effort: serial pass is truth
+                log.warning("warmup aot %s failed: %s", label, exc)
+
+        def _all():
+            t1 = time.monotonic()
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=par, thread_name_prefix="warm-aot"
+            ) as pool:
+                futs = [pool.submit(_one, lbl, fn) for lbl, fn in jobs]
+                for f in futs:
+                    f.result()
+            log.info(
+                "warmup aot: %d programs in %.1fs (%d threads)",
+                len(jobs), time.monotonic() - t1, par,
+            )
+
+        await loop.run_in_executor(self._executor, _all)
+
+    def _warm_chunk_program(self, t: int, view: int) -> None:
+        """Compile the chunk-prefill program at tail width ``t`` and kv-view
+        ``view`` against scratch rows (executor thread)."""
+        first, _lp, self.kv_cache = self._jit_chunk_prefill(
+            *self._chunk_warm_args(t, view)
         )
         jax.block_until_ready(first)
 
@@ -772,25 +951,12 @@ class InferenceEngine:
         """Compile the prefix-cache programs (both copy ops + every
         tail-bucket chunk prefill) against scratch rows so none of them
         cold-compiles on the serving path (executor thread)."""
-        from p2p_llm_tunnel_tpu.engine.prefix_cache import pad_rows
-
         t0 = time.monotonic()
-        pr = self.ecfg.prefill_rows
-        slots, pids, bnos = pad_rows(
-            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
-            scratch=None,
-        )
-        self.kv_cache = self._copy_in(
-            self.kv_cache, self._pool, slots, pids, bnos
-        )
-        slots, pids, bnos = pad_rows(
-            [(self._scratch_slot, [0], [0])], pr, self._prefix_max_blocks,
-            scratch=0,
-        )
-        self._pool = self._copy_out(
-            self._pool, self.kv_cache, slots, pids, bnos
-        )
-        views = self._view_buckets()
+        in_args, _ = self._copy_warm_args()
+        self.kv_cache = self._copy_in(*in_args)
+        _, out_args = self._copy_warm_args()
+        self._pool = self._copy_out(*out_args)
+        views = self._warmup_views()
         for t in self._chunk_buckets:
             for view in views:
                 if view >= t:

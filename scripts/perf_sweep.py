@@ -58,26 +58,35 @@ GRID = [
     # cached config banks the round's key datapoint before any compile
     # gamble, in ~2 min of a ~7 min window.
     ("base-32x16-v2", {}),
-    ("hero-64x32", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
-                    "BENCH_DECODE_STEPS": "32", "BENCH_KV_QUANT": "int8",
-                    "BENCH_FLASH_SGRID": "1",
-                    # All-fresh programs: compiles alone can eat the
-                    # default 420 s on this 1-core host.  Completed
-                    # compiles persist in .jax_cache, so even a wedged
-                    # attempt banks progress for the next window.
-                    "SWEEP_DEADLINE_S": "900"}),
+    # pfx-off IMMEDIATELY after base: it needs ZERO fresh compiles beyond
+    # base's program set (same decode variants, plain prefill only — the
+    # copy/chunk programs it skips are extra, not different), so with base
+    # banked this row costs ~2 min and completes the r4-requested
+    # prefix-cache ablation even in a short window.
+    ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
+    # int8 KV + in-kernel dequant at the BASE shape: the two decode-HBM
+    # levers, directly comparable to base-v2.  Fresh decode programs only
+    # (prefill/chunk/copy shared with base).
+    ("kv8-sgrid", {"BENCH_KV_QUANT": "int8", "BENCH_FLASH_SGRID": "1"}),
     # Joint-target variant: 48 slots raise the decode ceiling without the
-    # 64-wide admission herd that blows the <400 ms TTFT bar.
+    # 64-wide admission herd that blows the <400 ms TTFT bar.  All-fresh
+    # programs: compiles alone can eat the default 420 s on this 1-core
+    # host; completed compiles persist in .jax_cache, so even a wedged
+    # attempt banks progress for the next window.
     ("hero-48x24", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48",
                     "BENCH_DECODE_STEPS": "24", "BENCH_KV_QUANT": "int8",
+                    "BENCH_FLASH_SGRID": "1",
+                    "SWEEP_DEADLINE_S": "900"}),
+    # BASELINE config 2 datapoint with the current client-side-SSE
+    # methodology (VERDICT item 6); 2B-model compiles are quick.
+    ("gemma2-2b", {"BENCH_MODEL": "gemma2-2b"}),
+    ("hero-64x32", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+                    "BENCH_DECODE_STEPS": "32", "BENCH_KV_QUANT": "int8",
                     "BENCH_FLASH_SGRID": "1",
                     "SWEEP_DEADLINE_S": "900"}),
     ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
     ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     ("flash-sgrid", {"BENCH_FLASH_SGRID": "1"}),
-    # int8 KV + in-kernel dequant: the two decode-HBM levers composed.
-    ("kv8-sgrid", {"BENCH_KV_QUANT": "int8", "BENCH_FLASH_SGRID": "1"}),
-    ("pfx-off", {"BENCH_PREFIX_CACHE": "0"}),
     ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),
     ("flash-decode", {"BENCH_FLASH_DECODE": "1"}),
     ("ctx2048", {"BENCH_MAX_SEQ": "2048", "BENCH_SLOTS": "16",
@@ -99,7 +108,6 @@ GRID = [
     # window — the on-chip evidence VERDICT r3 item 1 asked for
     # (profile_out/ is gitignored; findings go to PERF.md).
     ("base-profiled", {"BENCH_PROFILE_DIR": "profile_out"}),
-    ("gemma2-2b", {"BENCH_MODEL": "gemma2-2b"}),
     ("rows16", {"BENCH_PREFILL_ROWS": "16"}),
     ("kv-int8", {"BENCH_KV_QUANT": "int8"}),
     ("w8a8", {"BENCH_QUANT": "w8a8"}),

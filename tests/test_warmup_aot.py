@@ -1,0 +1,108 @@
+"""Parallel AOT warmup (engine._warm_aot_parallel) equivalence tests.
+
+The warmup's phase A AOT-compiles every warm program from concurrent
+threads via ``jit.lower(...).compile()`` and relies on the persistent
+compilation cache to hand those executables back to the serial execute
+pass (and to live dispatch).  That only works if the AOT-lowered programs
+hash IDENTICALLY to the ones live dispatch builds — any aval drift
+(shape/dtype/static-arg mismatch in _decode_warm_args/_chunk_warm_args)
+silently doubles compile work on the serving path, which on the
+tunneled-TPU deployment costs a whole chip window (PERF.md r5).
+
+The hash-identity proof: warm up engine A with the AOT phase ON, snapshot
+the persistent-cache file set, then warm up an identically-configured
+engine B with the AOT phase OFF — B's serial compiles must ALL hit the
+persistent cache, i.e. add zero new files.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+import jax
+
+from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+from p2p_llm_tunnel_tpu.engine.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.slow
+
+ECFG = dict(
+    model="tiny", num_slots=4, max_seq=256, dtype="float32", seed=0,
+    decode_steps=4, decode_steps_eager=2, prefill_rows=2,
+    prefix_cache=True,
+)
+
+
+async def _collect(engine, prompt, max_new=8):
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=max_new,
+                                    stop_ids=()):
+        out.append(ev.token_id)
+    return out
+
+
+def _cache_files(path):
+    return {f for f in os.listdir(path)}
+
+
+@pytest.fixture()
+def persistent_cache(tmp_path, monkeypatch):
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    yield str(tmp_path)
+    jax.config.update("jax_compilation_cache_dir", old_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
+def test_aot_programs_hash_identical_to_dispatch(persistent_cache,
+                                                 monkeypatch):
+    monkeypatch.setenv("TUNNEL_WARMUP_PAR", "2")
+    monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "100")
+
+    async def run(par):
+        monkeypatch.setenv("TUNNEL_WARMUP_PAR", par)
+        eng = InferenceEngine(
+            engine_cfg=EngineConfig(**ECFG), tokenizer=ByteTokenizer()
+        )
+        await eng.start()
+        await eng.warmup()
+        toks = await _collect(eng, ByteTokenizer().encode("hello aot"))
+        await eng.stop()
+        return toks
+
+    toks_a = asyncio.run(run("2"))
+    files_after_aot = _cache_files(persistent_cache)
+    assert files_after_aot, "AOT warmup wrote nothing to the cache"
+
+    toks_b = asyncio.run(run("0"))
+    files_after_serial = _cache_files(persistent_cache)
+    new = files_after_serial - files_after_aot
+    assert not new, (
+        f"serial warmup compiled {len(new)} programs the AOT phase "
+        f"missed or mis-hashed"
+    )
+    assert toks_a == toks_b
+
+
+def test_warmup_view_cap():
+    """Cap arithmetic mirrors _kv_view_bucket's pipelining pad."""
+    eng = InferenceEngine(
+        engine_cfg=EngineConfig(**{**ECFG, "prefix_cache": False}),
+        tokenizer=ByteTokenizer(),
+    )
+    # max_seq 256 -> full bucket list [128, 256].
+    assert eng._view_buckets() == [128, 256]
+    # No cap: everything.
+    assert eng._warmup_views() == [128, 256]
+    # cap 100 + 2*4+1 pad = 109 -> bucket 128 only.
+    os.environ["TUNNEL_WARMUP_VIEW_CAP"] = "100"
+    try:
+        assert eng._warmup_views() == [128]
+        # cap 140 -> need 149 -> bucket 256: keeps both.
+        os.environ["TUNNEL_WARMUP_VIEW_CAP"] = "140"
+        assert eng._warmup_views() == [128, 256]
+    finally:
+        del os.environ["TUNNEL_WARMUP_VIEW_CAP"]
