@@ -233,12 +233,27 @@ async def _run_attempt(model: str) -> dict:
     # measurement inside one window.
     from p2p_llm_tunnel_tpu.engine.api import render_chat_prompt
 
+    # No BOS adjustment: the chat route prefills exactly
+    # tok.encode(render_chat_prompt(...)) — and the counts must be EXACT,
+    # not conservative: the prefill hint warms the bucket of precisely
+    # this length, and a +1 landing on a bucket boundary would warm the
+    # next bucket up while live traffic dispatches the lower one.
     worst = render_chat_prompt(
         [{"role": "user", "content": f"{prompt} ({clients - 1})"}]
     )
-    ctx_cap = len(engine.tokenizer.encode(worst)) + 1 + max_tokens
+    worst_toks = len(engine.tokenizer.encode(worst))
+    ctx_cap = worst_toks + max_tokens
     os.environ.setdefault("TUNNEL_WARMUP_VIEW_CAP", str(ctx_cap))
     os.environ.setdefault("TUNNEL_WARMUP_PAR", "4")
+    if prefill_chunk == 0:
+        # Both prompt shapes the run prefills: the warm client (no " (i)"
+        # suffix) and the measured clients.  Chunked-prefill configs skip
+        # the hint — their prompts take the segment path instead.
+        warm_prompt = render_chat_prompt([{"role": "user", "content": prompt}])
+        warm_toks = len(engine.tokenizer.encode(warm_prompt))
+        os.environ.setdefault(
+            "TUNNEL_WARMUP_PREFILL_TOKENS", f"{warm_toks},{worst_toks}"
+        )
 
     t0 = time.monotonic()
     await engine.warmup()

@@ -721,6 +721,14 @@ class InferenceEngine:
                 "decode warmup: %d view×steps variants compiled in %.1fs",
                 len(views) * len(steps), time.monotonic() - t0,
             )
+            for w in self._warm_prefill_widths():
+                t1 = time.monotonic()
+                await loop.run_in_executor(
+                    self._executor, self._warm_prefill_program, w
+                )
+                dt = time.monotonic() - t1
+                if dt > 1.0:
+                    log.info("prefill warmup[w%d] ready in %.1fs", w, dt)
             if self.ecfg.spec_ngram > 0:
                 for view in views:
                     def _one_spec(view=view):
@@ -804,6 +812,39 @@ class InferenceEngine:
             view,
         )
 
+    def _warm_prefill_widths(self) -> List[int]:
+        """Distinct plain-prefill width buckets from the
+        ``TUNNEL_WARMUP_PREFILL_TOKENS="77,83"`` workload hint — prompt
+        token counts the workload will prefill (the bench knows its own
+        prompts).  Honored by BOTH the parallel AOT phase and the serial
+        execute pass, so the hint works even when AOT is skipped (PAR
+        unset, SPMD, no persistent cache dir)."""
+        hint = os.environ.get("TUNNEL_WARMUP_PREFILL_TOKENS", "")
+        return sorted({
+            self._bucket(int(n)) for n in hint.split(",") if n.strip()
+        })
+
+    def _warm_prefill_program(self, width: int) -> None:
+        """Execute-warm the plain-prefill program at prompt bucket
+        ``width`` against scratch rows (executor thread)."""
+        first, _lp, self.kv_cache = self._jit_prefill(
+            *self._prefill_warm_args(width)
+        )
+        jax.block_until_ready(first)
+
+    def _prefill_warm_args(self, width: int):
+        """Positional args for the plain batched-prefill program at prompt
+        bucket ``width``, aval-identical to _dispatch_prefill_batch's
+        non-echo live call."""
+        nb = self.ecfg.prefill_rows
+        return (
+            self.params, self.kv_cache, self._bias,
+            jnp.zeros((nb, width), jnp.int32),
+            jnp.ones((nb,), jnp.int32),
+            jnp.full((nb,), self._scratch_slot, jnp.int32),
+            self._warm_samp(nb), self._key,
+        )
+
     def _spec_warm_args(self, view: int):
         """Positional args for the spec-verify program, aval-identical to
         _dispatch_spec's live call."""
@@ -876,6 +917,13 @@ class InferenceEngine:
                         *self._spec_warm_args(view)
                     ),
                 ))
+        for w in self._warm_prefill_widths():
+            jobs.append((
+                f"prefill[w{w}]",
+                lambda w=w: self._jit_prefill.lower(
+                    *self._prefill_warm_args(w)
+                ),
+            ))
         if self._prefix is not None:
             in_args, out_args = self._copy_warm_args()
             jobs.append(("copy_in", lambda: self._copy_in.lower(*in_args)))

@@ -57,10 +57,17 @@ def persistent_cache(tmp_path, monkeypatch):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
 
 
+PROMPT = ByteTokenizer().encode("hello aot")
+
+
 def test_aot_programs_hash_identical_to_dispatch(persistent_cache,
                                                  monkeypatch):
-    monkeypatch.setenv("TUNNEL_WARMUP_PAR", "2")
     monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "100")
+    # Covers the prefill-hint path: the live generate below prefills
+    # len(PROMPT) tokens, so its bucket must be AOT-warmed too.
+    monkeypatch.setenv("TUNNEL_WARMUP_PREFILL_TOKENS", str(len(PROMPT)))
+
+    marks = {}
 
     async def run(par):
         monkeypatch.setenv("TUNNEL_WARMUP_PAR", par)
@@ -69,17 +76,25 @@ def test_aot_programs_hash_identical_to_dispatch(persistent_cache,
         )
         await eng.start()
         await eng.warmup()
-        toks = await _collect(eng, ByteTokenizer().encode("hello aot"))
+        marks[f"warm{par}"] = _cache_files(persistent_cache)
+        toks = await _collect(eng, PROMPT)
         await eng.stop()
         return toks
 
     toks_a = asyncio.run(run("2"))
-    files_after_aot = _cache_files(persistent_cache)
-    assert files_after_aot, "AOT warmup wrote nothing to the cache"
+    files_after_a = _cache_files(persistent_cache)
+    assert marks["warm2"], "AOT warmup wrote nothing to the cache"
+    # Live dispatch (prefill wave + decode bursts + prefix insert) must
+    # hit only pre-warmed programs — any new cache file means a warm-args
+    # builder drifted from its live call and a fresh compile landed on
+    # the serving path.
+    live_new = files_after_a - marks["warm2"]
+    assert not live_new, (
+        f"live dispatch compiled {len(live_new)} programs warmup missed"
+    )
 
     toks_b = asyncio.run(run("0"))
-    files_after_serial = _cache_files(persistent_cache)
-    new = files_after_serial - files_after_aot
+    new = _cache_files(persistent_cache) - files_after_a
     assert not new, (
         f"serial warmup compiled {len(new)} programs the AOT phase "
         f"missed or mis-hashed"
