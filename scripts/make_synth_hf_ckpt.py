@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Generate a REAL-FORMAT HuggingFace llama-family checkpoint directory
+with tiny random weights and a genuine fast tokenizer + chat template.
+
+Why this exists: VERDICT r4 item 5 asks for the opt-in real-checkpoint
+e2e (tests/test_real_checkpoint.py) to run at least once, but this image
+has no model weights and no network egress.  What that test actually
+exercises — HF config parsing, safetensors loading, convert_hf weight
+remapping/transposition, AutoTokenizer, apply_chat_template, int8
+quantization, serving through the tunnel — depends on the FILE FORMATS
+and KEY LAYOUT, not on the weight values.  This script emits a directory
+that is byte-format-identical to a real `Llama-*` export (config.json +
+model.safetensors + tokenizer.json/tokenizer_config.json with a jinja
+chat template), so the whole path runs for real:
+
+    python scripts/make_synth_hf_ckpt.py /tmp/synth-llama
+    TUNNEL_HF_CKPT=/tmp/synth-llama TUNNEL_HF_FAMILY=llama \
+    TUNNEL_HF_SYNTH=1 python -m pytest tests/test_real_checkpoint.py -v
+
+Capability parity target: the reference serves real Ollama models
+transparently (reference tunnel/src/serve.rs:219); our engine-mode
+equivalent is this HF-checkpoint path.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+# Tiny llama-family shape: big enough that every convert_hf transposition
+# would crash on a layout mistake, small enough for CPU CI seconds.
+DIM = 128
+LAYERS = 2
+HEADS = 4
+KV_HEADS = 2
+# HEADS*HEAD_DIM (192) deliberately != DIM so q_proj/o_proj are NON-square:
+# a missed or extra transpose in convert_hf crashes instead of silently
+# producing a shape-valid wrong matrix.
+HEAD_DIM = 48
+FFN = 256
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|' + message['role'] + '|>\n' + message['content'] + '</s>' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|assistant|>\n' }}{% endif %}"
+)
+
+CORPUS = [
+    "The capital of France is Paris.",
+    "Benchmark this tunnel with a steady stream of tokens.",
+    "A peer to peer tunnel streams tokens over encrypted UDP.",
+    "hello world these are words for the byte pair encoder to merge",
+]
+
+
+def build_tokenizer(out_dir: str) -> int:
+    """Train a real ByteLevel BPE fast tokenizer; returns vocab size."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers, decoders
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512,
+        special_tokens=["<s>", "</s>", "<|user|>", "<|assistant|>",
+                        "<|system|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.save(os.path.join(out_dir, "tokenizer.json"))
+    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<s>",
+            "eos_token": "</s>",
+            "chat_template": CHAT_TEMPLATE,
+        }, f, indent=1)
+    with open(os.path.join(out_dir, "special_tokens_map.json"), "w") as f:
+        json.dump({"bos_token": "<s>", "eos_token": "</s>"}, f, indent=1)
+    return tok.get_vocab_size()
+
+
+def main(out_dir: str, seed: int = 0) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    vocab = build_tokenizer(out_dir)
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        # Small init so bf16/int8 activations stay finite through 2 layers.
+        return (rng.standard_normal(shape) * 0.02).astype(np.float32)
+
+    state = {
+        "model.embed_tokens.weight": w(vocab, DIM),
+        "model.norm.weight": np.ones((DIM,), np.float32),
+        "lm_head.weight": w(vocab, DIM),
+    }
+    for i in range(LAYERS):
+        p = f"model.layers.{i}"
+        state[f"{p}.input_layernorm.weight"] = np.ones((DIM,), np.float32)
+        state[f"{p}.post_attention_layernorm.weight"] = np.ones(
+            (DIM,), np.float32
+        )
+        # HF convention: [out_features, in_features].
+        state[f"{p}.self_attn.q_proj.weight"] = w(HEADS * HEAD_DIM, DIM)
+        state[f"{p}.self_attn.k_proj.weight"] = w(KV_HEADS * HEAD_DIM, DIM)
+        state[f"{p}.self_attn.v_proj.weight"] = w(KV_HEADS * HEAD_DIM, DIM)
+        state[f"{p}.self_attn.o_proj.weight"] = w(DIM, HEADS * HEAD_DIM)
+        state[f"{p}.mlp.gate_proj.weight"] = w(FFN, DIM)
+        state[f"{p}.mlp.up_proj.weight"] = w(FFN, DIM)
+        state[f"{p}.mlp.down_proj.weight"] = w(DIM, FFN)
+
+    from safetensors.numpy import save_file
+
+    save_file(state, os.path.join(out_dir, "model.safetensors"))
+
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": vocab,
+            "hidden_size": DIM,
+            "num_hidden_layers": LAYERS,
+            "num_attention_heads": HEADS,
+            "num_key_value_heads": KV_HEADS,
+            "head_dim": HEAD_DIM,
+            "intermediate_size": FFN,
+            "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-5,
+            "tie_word_embeddings": False,
+        }, f, indent=1)
+    print(f"wrote synthetic llama checkpoint to {out_dir} (vocab={vocab})")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/synth-llama")

@@ -150,17 +150,45 @@ def test_real_checkpoint_streams_coherent_text():
                 b"".join([c async for c in resp.iter_chunks()])
             )
             text = body["choices"][0]["message"]["content"]
-            # Coherence bar: real weights under greedy decode must produce
-            # language, not noise.  Any competent base model continues the
-            # prompt with "Paris"; failing that, require the output to be
-            # mostly letters/spaces (catches garbage like "aQz!!" that a
-            # broken conversion produces).
-            assert text.strip(), "model produced no text"
-            wordish = sum(c.isalpha() or c.isspace() for c in text) / len(text)
-            assert "paris" in text.lower() or wordish > 0.8, (
-                f"output fails the coherence bar: {text!r}"
-            )
-            print(f"model output: {text!r}")
+            if os.environ.get("TUNNEL_HF_SYNTH") == "1":
+                # Synthetic real-format checkpoint
+                # (scripts/make_synth_hf_ckpt.py): random weights cannot
+                # clear a LANGUAGE bar, so assert the mechanical
+                # invariants the formats path must uphold.  Greedy decode
+                # under random weights CAN hit </s> at any step (and the
+                # exact ids shift with tokenizers/numpy versions), so
+                # accept either finish reason and any non-zero token
+                # count within budget.
+                assert text, "no text decoded from synthetic model"
+                assert body["choices"][0]["finish_reason"] in (
+                    "length", "stop",
+                )
+                assert 1 <= body["usage"]["completion_tokens"] <= 12
+                # The prompt must have gone through the tokenizer's OWN
+                # chat template: the templated rendering strictly extends
+                # the raw prompt with role/eos special tokens.
+                raw_len = len(tok.encode("The capital of France is"))
+                assert body["usage"]["prompt_tokens"] > raw_len, (
+                    "prompt_tokens suggests apply_chat_template was "
+                    "bypassed"
+                )
+                print(f"synthetic-ckpt output: {text!r}")
+            else:
+                # Coherence bar: real weights under greedy decode must
+                # produce language, not noise.  Any competent base model
+                # continues the prompt with "Paris"; failing that,
+                # require the output to be mostly letters/spaces (catches
+                # garbage like "aQz!!" that a broken conversion
+                # produces).
+                assert text.strip(), "model produced no text"
+                wordish = (
+                    sum(c.isalpha() or c.isspace() for c in text)
+                    / len(text)
+                )
+                assert "paris" in text.lower() or wordish > 0.8, (
+                    f"output fails the coherence bar: {text!r}"
+                )
+                print(f"model output: {text!r}")
         finally:
             serve_task.cancel()
             proxy_task.cancel()
