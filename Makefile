@@ -42,6 +42,9 @@ bench:             ## end-to-end tok/s + TTFT through the tunnel
 multichip:         ## harness dryrun: dp+tp train step on a virtual mesh
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python __graft_entry__.py
 
+synth-ckpt:        ## real-format synthetic HF checkpoint + serving e2e
+	python -m pytest tests/test_hf_synth.py -v
+
 signal:            ## run the rendezvous server
 	python -m p2p_llm_tunnel_tpu.cli signal --port 8787
 

@@ -82,34 +82,62 @@ def build_tokenizer(out_dir: str) -> int:
     return tok.get_vocab_size()
 
 
-def main(out_dir: str, seed: int = 0) -> None:
-    os.makedirs(out_dir, exist_ok=True)
-    vocab = build_tokenizer(out_dir)
+def fake_llama_state(cfg, seed: int = 0) -> dict:
+    """Random HF-llama state dict in the exact key layout + [out, in]
+    orientation `convert_hf("llama", ...)` expects.  THE single source of
+    that layout for synthetic weights — tests/test_checkpoint.py imports
+    this instead of keeping its own copy, so the converter's expected
+    keys cannot drift between the unit tests and this e2e generator.
+    ``cfg`` needs vocab_size/dim/n_layers/n_heads/n_kv_heads/head_dim/
+    ffn_dim (a ModelConfig or any namespace)."""
     rng = np.random.default_rng(seed)
 
     def w(*shape):
-        # Small init so bf16/int8 activations stay finite through 2 layers.
+        # Small init so bf16/int8 activations stay finite through layers.
         return (rng.standard_normal(shape) * 0.02).astype(np.float32)
 
     state = {
-        "model.embed_tokens.weight": w(vocab, DIM),
-        "model.norm.weight": np.ones((DIM,), np.float32),
-        "lm_head.weight": w(vocab, DIM),
+        "model.embed_tokens.weight": w(cfg.vocab_size, cfg.dim),
+        "model.norm.weight": np.ones((cfg.dim,), np.float32),
+        "lm_head.weight": w(cfg.vocab_size, cfg.dim),
     }
-    for i in range(LAYERS):
+    for i in range(cfg.n_layers):
         p = f"model.layers.{i}"
-        state[f"{p}.input_layernorm.weight"] = np.ones((DIM,), np.float32)
+        state[f"{p}.input_layernorm.weight"] = np.ones(
+            (cfg.dim,), np.float32
+        )
         state[f"{p}.post_attention_layernorm.weight"] = np.ones(
-            (DIM,), np.float32
+            (cfg.dim,), np.float32
         )
         # HF convention: [out_features, in_features].
-        state[f"{p}.self_attn.q_proj.weight"] = w(HEADS * HEAD_DIM, DIM)
-        state[f"{p}.self_attn.k_proj.weight"] = w(KV_HEADS * HEAD_DIM, DIM)
-        state[f"{p}.self_attn.v_proj.weight"] = w(KV_HEADS * HEAD_DIM, DIM)
-        state[f"{p}.self_attn.o_proj.weight"] = w(DIM, HEADS * HEAD_DIM)
-        state[f"{p}.mlp.gate_proj.weight"] = w(FFN, DIM)
-        state[f"{p}.mlp.up_proj.weight"] = w(FFN, DIM)
-        state[f"{p}.mlp.down_proj.weight"] = w(DIM, FFN)
+        state[f"{p}.self_attn.q_proj.weight"] = w(
+            cfg.n_heads * cfg.head_dim, cfg.dim
+        )
+        state[f"{p}.self_attn.k_proj.weight"] = w(
+            cfg.n_kv_heads * cfg.head_dim, cfg.dim
+        )
+        state[f"{p}.self_attn.v_proj.weight"] = w(
+            cfg.n_kv_heads * cfg.head_dim, cfg.dim
+        )
+        state[f"{p}.self_attn.o_proj.weight"] = w(
+            cfg.dim, cfg.n_heads * cfg.head_dim
+        )
+        state[f"{p}.mlp.gate_proj.weight"] = w(cfg.ffn_dim, cfg.dim)
+        state[f"{p}.mlp.up_proj.weight"] = w(cfg.ffn_dim, cfg.dim)
+        state[f"{p}.mlp.down_proj.weight"] = w(cfg.dim, cfg.ffn_dim)
+    return state
+
+
+def main(out_dir: str, seed: int = 0) -> None:
+    import types
+
+    os.makedirs(out_dir, exist_ok=True)
+    vocab = build_tokenizer(out_dir)
+    shape = types.SimpleNamespace(
+        vocab_size=vocab, dim=DIM, n_layers=LAYERS, n_heads=HEADS,
+        n_kv_heads=KV_HEADS, head_dim=HEAD_DIM, ffn_dim=FFN,
+    )
+    state = fake_llama_state(shape, seed)
 
     from safetensors.numpy import save_file
 

@@ -1,5 +1,8 @@
 """Checkpoint roundtrips (orbax) and HF layout conversion on tiny models."""
 
+import importlib.util
+import os
+
 import numpy as np
 import pytest
 
@@ -13,6 +16,17 @@ from p2p_llm_tunnel_tpu.models.checkpoint import (
 )
 from p2p_llm_tunnel_tpu.models.config import get_config
 from p2p_llm_tunnel_tpu.models.transformer import init_params, prefill
+
+# The canonical synthetic HF-llama state builder lives with the e2e
+# checkpoint generator so the unit tests and the generated exports can
+# never drift on the key layout convert_hf expects.
+_spec = importlib.util.spec_from_file_location(
+    "make_synth_hf_ckpt",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "make_synth_hf_ckpt.py"),
+)
+_synth = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_synth)
 
 
 def test_orbax_roundtrip(tmp_path, cpu_devices):
@@ -28,29 +42,7 @@ def test_orbax_roundtrip(tmp_path, cpu_devices):
     )
 
 
-def _fake_hf_llama_state(cfg, seed=0):
-    rng = np.random.default_rng(seed)
-
-    def t(*shape):
-        return rng.standard_normal(shape).astype(np.float32) * 0.02
-
-    state = {
-        "model.embed_tokens.weight": t(cfg.vocab_size, cfg.dim),
-        "model.norm.weight": np.ones(cfg.dim, np.float32),
-        "lm_head.weight": t(cfg.vocab_size, cfg.dim),
-    }
-    for i in range(cfg.n_layers):
-        p = f"model.layers.{i}."
-        state[p + "input_layernorm.weight"] = np.ones(cfg.dim, np.float32)
-        state[p + "post_attention_layernorm.weight"] = np.ones(cfg.dim, np.float32)
-        state[p + "self_attn.q_proj.weight"] = t(cfg.n_heads * cfg.head_dim, cfg.dim)
-        state[p + "self_attn.k_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
-        state[p + "self_attn.v_proj.weight"] = t(cfg.n_kv_heads * cfg.head_dim, cfg.dim)
-        state[p + "self_attn.o_proj.weight"] = t(cfg.dim, cfg.n_heads * cfg.head_dim)
-        state[p + "mlp.gate_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
-        state[p + "mlp.up_proj.weight"] = t(cfg.ffn_dim, cfg.dim)
-        state[p + "mlp.down_proj.weight"] = t(cfg.dim, cfg.ffn_dim)
-    return state
+_fake_hf_llama_state = _synth.fake_llama_state
 
 
 def test_convert_hf_llama_shapes_and_forward(cpu_devices):

@@ -159,9 +159,13 @@ def test_real_checkpoint_streams_coherent_text():
                 # exact ids shift with tokenizers/numpy versions), so
                 # accept either finish reason and any non-zero token
                 # count within budget.
-                assert text, "no text decoded from synthetic model"
-                assert body["choices"][0]["finish_reason"] in (
-                    "length", "stop",
+                finish = body["choices"][0]["finish_reason"]
+                assert finish in ("length", "stop")
+                # An immediate greedy </s> legitimately yields empty text
+                # (skip_special_tokens) — require text only when the run
+                # went the distance.
+                assert text or finish == "stop", (
+                    "no text decoded from synthetic model"
                 )
                 assert 1 <= body["usage"]["completion_tokens"] <= 12
                 # The prompt must have gone through the tokenizer's OWN
