@@ -55,6 +55,59 @@ class TestFinalize:
         out = bench_mod._finalize({"value": 1.0, "vs_baseline": 1.0})
         assert out["no_tpu"] is True and out["vs_baseline"] is None
 
+    def test_best_banked_row_selection(self, bench_mod, tmp_path):
+        log = tmp_path / "sweep.jsonl"
+        log.write_text("\n".join([
+            json.dumps({"platform": "tpu", "value": 900.0,
+                        "sweep_label": "a", "unit": "tok/s"}),
+            json.dumps({"platform": "tpu", "value": 1700.0,
+                        "sweep_label": "b", "unit": "tok/s",
+                        "ttft_p50_ms": 390.0}),
+            json.dumps({"platform": "cpu", "value": 9999.0,
+                        "sweep_label": "cpu-noise"}),
+            json.dumps({"error": "chip_gone", "platform": "tpu",
+                        "value": 5000.0, "sweep_label": "dead"}),
+            "not json",
+        ]))
+        best = bench_mod._best_banked_tpu_row(str(log))
+        assert best["sweep_label"] == "b" and best["value"] == 1700.0
+        assert bench_mod._best_banked_tpu_row(str(tmp_path / "nope")) is None
+
+    def test_no_tpu_result_carries_banked_row(self, bench_mod, monkeypatch):
+        stub = {"sweep_label": "x", "value": 1700.0, "unit": "tok/s"}
+        monkeypatch.setattr(
+            bench_mod, "_best_banked_tpu_row", lambda path="": dict(stub)
+        )
+        # banked=True is the DRIVER-facing artifact path only.
+        out = bench_mod._finalize(
+            {"platform": "cpu", "value": 1.0, "vs_baseline": 0.1},
+            banked=True,
+        )
+        assert out["no_tpu"] is True
+        assert out["best_banked_tpu"]["value"] == 1700.0
+        # Sweep children / nested secondary results must NOT embed it.
+        child = bench_mod._finalize({"platform": "cpu", "value": 1.0})
+        assert "best_banked_tpu" not in child
+        parent = bench_mod._finalize(
+            {"platform": "cpu", "secondary": {"platform": "cpu"}},
+            banked=True,
+        )
+        assert "best_banked_tpu" not in parent["secondary"]
+
+    def test_banked_row_accepts_legacy_rows_and_bad_values(
+            self, bench_mod, tmp_path):
+        log = tmp_path / "sweep.jsonl"
+        log.write_text("\n".join([
+            # Pre-platform-field row (r4 on-chip): must count.
+            json.dumps({"value": 1684.78, "sweep_label": "legacy",
+                        "unit": "tok/s", "vs_baseline": 0.936}),
+            # Error-free row with null value: must not crash selection.
+            json.dumps({"platform": "tpu", "value": None,
+                        "sweep_label": "nullval"}),
+        ]))
+        best = bench_mod._best_banked_tpu_row(str(log))
+        assert best["sweep_label"] == "legacy"
+
     def test_secondary_finalized_recursively(self, bench_mod):
         row = {
             "platform": "tpu", "vs_baseline": 1.0,
