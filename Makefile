@@ -1,7 +1,7 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native native-san test test-unit test-fast test-local test-race bench serve proxy signal multichip
+.PHONY: native native-san test test-unit test-fast test-local test-race chaos bench serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
@@ -34,7 +34,17 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 		tests/test_chunked_prefill.py tests/test_arq.py \
 		tests/test_spec_decode.py tests/test_multi_choice.py \
 		tests/test_seeded_sampling.py tests/test_logit_bias.py \
-		tests/test_spmd_serve.py -q
+		tests/test_spmd_serve.py tests/test_chaos.py \
+		tests/test_deadlines.py -q
+
+# Three fixed seeds: each pins a different deterministic fault schedule
+# (drops land on different frames); the e2e scenario asserts identical
+# outcomes across two runs per seed.  Seeds are chosen so injected drops
+# hit only loss-tolerant padding frames — see tests/test_chaos.py.
+chaos:             ## request-lifecycle suite under seeded fault injection
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_chaos.py tests/test_deadlines.py -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_chaos.py -q
+	CHAOS_TEST_SEED=23 python -m pytest tests/test_chaos.py -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

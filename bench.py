@@ -226,7 +226,7 @@ async def _run_attempt(model: str) -> dict:
     # Warmup hints (see engine._warmup_views / _warm_aot_parallel): the
     # bench KNOWS its maximum reachable context — the server's OWN chat
     # rendering of the longest client prompt, tokenized by the engine's
-    # OWN tokenizer, +1 BOS, +max_tokens — so warmup can skip kv-view
+    # OWN tokenizer (no BOS: see below), +max_tokens — so warmup can skip kv-view
     # buckets the traffic cannot hit, and AOT-compile the rest in
     # parallel.  Fresh compiles cost ~20 s each through the device tunnel
     # and chip windows last minutes; both hints exist to fit warmup +
@@ -446,10 +446,10 @@ def _finalize(result: dict, banked: bool = False) -> dict:
 def _best_banked_tpu_row(path: str = ""):
     """Highest-throughput error-free on-chip row from the sweep log,
     compacted to the fields a reader needs; None when there is none.
-    Rows predating the ``platform`` field count as on-chip — the sweep
-    only ran with a live-TPU probe gate back then (SWEEP_REQUIRE_TPU
-    defaulted on), so a missing key means 'measured before the field
-    existed', not 'unknown platform'."""
+    Only rows EXPLICITLY tagged platform == "tpu" qualify: a row missing
+    the key (future writer path, stub output, hand edit) must never be
+    surfaced as the best on-chip datapoint — that is exactly the
+    CPU-as-TPU misreporting VERDICT r4 item 3 forbids."""
     path = path or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "PERF_SWEEP.jsonl"
     )
@@ -461,7 +461,7 @@ def _best_banked_tpu_row(path: str = ""):
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if row.get("error") or row.get("platform", "tpu") != "tpu":
+                if row.get("error") or row.get("platform") != "tpu":
                     continue
                 val = row.get("value")
                 if not isinstance(val, (int, float)):

@@ -87,6 +87,24 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--decode-steps", type=int,
                        default=int(_env("TUNNEL_DECODE_STEPS", "8")),
                        help="decode steps per device call (tpu backend)")
+    serve.add_argument("--max-waiting", type=int,
+                       default=int(_env("TUNNEL_MAX_WAITING", "64")),
+                       help="admission control: max requests buffered in "
+                            "the engine's waiting queue before new work is "
+                            "shed with HTTP 429 + Retry-After (0 = "
+                            "unbounded; tpu backend)")
+    serve.add_argument("--max-inflight", type=int,
+                       default=int(_env("TUNNEL_MAX_INFLIGHT", "256")),
+                       help="admission control at the tunnel layer: max "
+                            "concurrently-dispatched requests before 429 "
+                            "(0 = unbounded)")
+    serve.add_argument("--watchdog-budget", type=float,
+                       default=float(_env("TUNNEL_WATCHDOG_BUDGET", "60")),
+                       help="decode-stall watchdog: mark the engine "
+                            "degraded (surfaced via /healthz) when no "
+                            "decode progress happens for this many seconds "
+                            "while requests are in flight (0 = off; tpu "
+                            "backend)")
     serve.add_argument("--tp", type=int, default=int(_env("TUNNEL_TP", "1")),
                        help="tensor-parallel degree over the device mesh")
     serve.add_argument("--ckpt", default=_env("TUNNEL_CKPT"),
@@ -208,17 +226,27 @@ def build_parser() -> argparse.ArgumentParser:
 # retry supervisor (main.rs:111-159)
 # ---------------------------------------------------------------------------
 
-async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0) -> None:
+async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0,
+                         stop: "Optional[asyncio.Event]" = None) -> None:
     """Run ``attempt_fn()`` forever, reconnecting with capped backoff.
 
     ``max_attempts=0`` means infinite (the reference hardcodes infinite).
     Cancellation (SIGINT) aborts both the running attempt and the backoff
     sleep — matching main.rs:119-125, :148-155.
+
+    ``stop`` (optional) is the graceful-drain switch: once set, no new
+    attempt starts and a backoff sleep ends early — so SIGTERM during a
+    reconnect loop (dead signal server, flaky WAN) exits promptly instead
+    of retrying forever.  An attempt already serving handles the same
+    event itself (run_serve's drain path).
     """
     import time as _time
 
     attempt = 0
     while True:
+        if stop is not None and stop.is_set():
+            log.info("%s: drain requested; not reconnecting", name)
+            return
         attempt += 1
         started = _time.monotonic()
         try:
@@ -238,14 +266,21 @@ async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0) -> Non
             raise RuntimeError(f"{name}: giving up after {attempt} attempts")
         backoff = min(INITIAL_BACKOFF * (2 ** (attempt - 1)), MAX_BACKOFF)
         log.info("%s: reconnecting in %.0fs", name, backoff)
-        await asyncio.sleep(backoff)  # CancelledError propagates → Ctrl+C
+        if stop is None:
+            await asyncio.sleep(backoff)  # CancelledError propagates → Ctrl+C
+        else:
+            # Backoff that a drain can interrupt.
+            try:
+                await asyncio.wait_for(stop.wait(), backoff)
+            except asyncio.TimeoutError:
+                pass
 
 
 # ---------------------------------------------------------------------------
 # subcommand bodies
 # ---------------------------------------------------------------------------
 
-async def _serve_once(args) -> None:
+async def _serve_once(args, drain: "Optional[asyncio.Event]" = None) -> None:
     from p2p_llm_tunnel_tpu.endpoints.serve import http_backend, run_serve
     from p2p_llm_tunnel_tpu.transport import connect
 
@@ -260,12 +295,17 @@ async def _serve_once(args) -> None:
                                        stun_server=args.stun, relay=args.relay,
                                        relay_secret=args.relay_secret)
     try:
+        kwargs = dict(
+            max_inflight=getattr(args, "max_inflight", 0), drain=drain
+        )
         if backend is not None:
-            await run_serve(channel, backend=backend)
+            await run_serve(channel, backend=backend, **kwargs)
         else:
-            await run_serve(channel, args.upstream, args.advertise)
+            await run_serve(channel, args.upstream, args.advertise, **kwargs)
     finally:
         channel.close()
+        # Clean close sends `bye` on signaling — peers learn of the drain
+        # instead of waiting out their dead-peer timers.
         await signaling.close()
 
 
@@ -362,6 +402,8 @@ async def _engine_backend(args):
                     spec_ngram=args.spec_ngram,
                     spec_k=args.spec_k,
                     prefill_chunk=args.prefill_chunk,
+                    max_waiting=args.max_waiting,
+                    watchdog_budget_s=args.watchdog_budget,
                     seed=seed,
                 )
             )
@@ -445,7 +487,37 @@ async def _amain(args) -> None:
     if not args.room:
         raise SystemExit("--room (or TUNNEL_ROOM) is required")
     if args.command == "serve":
-        await run_with_retry("serve", lambda: _serve_once(args))
+        # Graceful drain: the FIRST SIGTERM stops admission and lets
+        # in-flight streams finish (run_serve returns cleanly, the retry
+        # supervisor sees a clean end); a SECOND SIGTERM force-exits via
+        # the default handler.  SIGINT keeps the immediate-interrupt path.
+        import os as _os
+        import signal as _signal
+
+        drain = asyncio.Event()
+
+        def _drain_now() -> None:
+            if drain.is_set():
+                log.warning("second SIGTERM: exiting immediately")
+                _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            log.info("SIGTERM: draining (finishing in-flight requests)")
+            drain.set()
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(_signal.SIGTERM, _drain_now)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platforms without signal support
+        try:
+            await run_with_retry(
+                "serve", lambda: _serve_once(args, drain), stop=drain
+            )
+        finally:
+            try:
+                loop.remove_signal_handler(_signal.SIGTERM)
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass
     elif args.command == "proxy":
         await run_with_retry("proxy", lambda: _proxy_once(args))
 
@@ -468,16 +540,23 @@ def main(argv: Optional[list] = None) -> None:
     _signal.signal(_signal.SIGTERM, _graceful)
     if _signal.getsignal(_signal.SIGINT) == _signal.SIG_IGN:
         _signal.signal(_signal.SIGINT, _graceful)
-    args = build_parser().parse_args(argv)
-    try:
-        asyncio.run(_amain(args))
-    except KeyboardInterrupt:
-        log.info("interrupted, shutting down")
+    def _save_snapshots() -> None:
+        # Warm prompt KV must survive BOTH exit paths — Ctrl+C and a
+        # clean SIGTERM drain (asyncio.run tears engines down before any
+        # engine.stop() would run).
         for eng in _ENGINES:
             try:
                 eng.save_prefix_snapshot()
             except Exception as e:  # best-effort on the exit path
                 log.warning("prefix snapshot on shutdown failed: %s", e)
+
+    args = build_parser().parse_args(argv)
+    try:
+        asyncio.run(_amain(args))
+        _save_snapshots()
+    except KeyboardInterrupt:
+        log.info("interrupted, shutting down")
+        _save_snapshots()
         if got_sig["num"] == _signal.SIGTERM:
             # Die BY SIGTERM so supervisors (systemd SuccessExitStatus,
             # docker) see a normal stop, not exit code 130.

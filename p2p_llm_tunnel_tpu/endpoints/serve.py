@@ -18,10 +18,13 @@ backend instead (engine/api.py) — that swap is this project's whole point.
 from __future__ import annotations
 
 import asyncio
+import json
+import time
 from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from p2p_llm_tunnel_tpu.endpoints import http11
 from p2p_llm_tunnel_tpu.protocol.frames import (
+    DEADLINE_HEADER,  # noqa: F401  (re-exported: the serve-side surface)
     INITIAL_CREDIT,
     MAX_BODY_CHUNK,
     Agree,
@@ -32,6 +35,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     ResponseHeaders,
     TunnelMessage,
     encode_body_frames,
+    parse_deadline_ms,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
@@ -232,8 +236,35 @@ async def _handle_request_inner(
 ) -> None:
     stream_id = req.stream_id
     global_metrics.inc("serve_requests_total")
+    # Per-request deadline (x-tunnel-deadline-ms): enforced here over the
+    # whole backend call + body relay, independently of the engine's own
+    # scheduler-side eviction — this layer also covers the HTTP backend
+    # and a stalled flow-control/transport path.
+    deadline: Optional[float] = None
+    dl_ms = parse_deadline_ms(req.headers)
+    if dl_ms is not None:
+        deadline = time.monotonic() + dl_ms / 1000.0
     try:
-        status, headers, chunks = await backend(req, body)
+        if deadline is not None:
+            status, headers, chunks = await asyncio.wait_for(
+                backend(req, body), deadline - time.monotonic()
+            )
+        else:
+            status, headers, chunks = await backend(req, body)
+    except asyncio.TimeoutError:
+        log.warning("stream %d hit its %.0fms deadline before headers",
+                    stream_id, dl_ms)
+        global_metrics.inc("serve_timeouts_total")
+        await channel.send(
+            TunnelMessage.res_headers(
+                ResponseHeaders(stream_id, 504, {"content-type": "text/plain"})
+            ).encode()
+        )
+        await channel.send(
+            TunnelMessage.res_body(stream_id, b"Gateway Timeout: deadline exceeded").encode()
+        )
+        await channel.send(TunnelMessage.res_end(stream_id).encode())
+        return
     except Exception as e:
         log.error("upstream request failed for stream %d: %s", stream_id, e)
         global_metrics.inc("serve_upstream_errors_total")
@@ -251,18 +282,94 @@ async def _handle_request_inner(
     await channel.send(
         TunnelMessage.res_headers(ResponseHeaders(stream_id, status, headers)).encode()
     )
+    agen = _coalesce(chunks)
+
+    async def bounded(awaitable):
+        """Await under what remains of the deadline — covers the backend
+        iterator AND the flow-control debit, so a credit-starved peer
+        cannot pin the stream past its budget either."""
+        if deadline is None:
+            return await awaitable
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise asyncio.TimeoutError
+        return await asyncio.wait_for(awaitable, remaining)
+
     try:
-        async for chunk in _coalesce(chunks):
-            await flow.consume(stream_id, len(chunk))
+        while True:
+            try:
+                chunk = await bounded(agen.__anext__())
+            except StopAsyncIteration:
+                break
+            await bounded(flow.consume(stream_id, len(chunk)))
             for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
                 await channel.send(frame)
+    except asyncio.TimeoutError:
+        # Deadline blown mid-stream: truncate with a TYPED error frame so
+        # protocol-aware peers can distinguish a timeout from an upstream
+        # crash (the reference's ERROR payload is free text).
+        log.warning("stream %d hit its %.0fms deadline mid-stream",
+                    stream_id, dl_ms)
+        global_metrics.inc("serve_timeouts_total")
+        await channel.send(
+            TunnelMessage.typed_error(
+                stream_id, "timeout", "deadline exceeded"
+            ).encode()
+        )
     except Exception as e:
         # Upstream dropped mid-stream — truncate with an ERROR frame
         # (serve.rs:278-284); the proxy ends the HTTP body without an error.
+        # Exceptions that carry a tunnel_code (engine DeadlineExceeded,
+        # scheduler QueueFull) emit the typed form.
         log.error("upstream stream error for stream %d: %s", stream_id, e)
-        await channel.send(TunnelMessage.error(stream_id, f"upstream error: {e}").encode())
+        code = getattr(e, "tunnel_code", None)
+        if code == "timeout":
+            global_metrics.inc("serve_timeouts_total")
+        if code is not None:
+            frame = TunnelMessage.typed_error(stream_id, code, str(e))
+        else:
+            frame = TunnelMessage.error(stream_id, f"upstream error: {e}")
+        await channel.send(frame.encode())
+    finally:
+        await agen.aclose()
     await channel.send(TunnelMessage.res_end(stream_id).encode())
     log.debug("response %d complete: status=%d", stream_id, status)
+
+
+async def _send_simple(
+    channel: Channel, stream_id: int, status: int, body: bytes,
+    headers: Optional[Dict[str, str]] = None,
+) -> None:
+    """One complete small response: headers + body + end."""
+    h = {"content-type": "text/plain"}
+    if headers:
+        h.update(headers)
+    await channel.send(
+        TunnelMessage.res_headers(ResponseHeaders(stream_id, status, h)).encode()
+    )
+    if body:
+        await channel.send(TunnelMessage.res_body(stream_id, body).encode())
+    await channel.send(TunnelMessage.res_end(stream_id).encode())
+
+
+async def _send_healthz(
+    channel: Channel, stream_id: int, draining: bool, inflight: int,
+) -> None:
+    """/healthz: ok|degraded|draining + queue/occupancy from the metrics
+    registry (engine gauges; zeros under the plain HTTP backend).  200 only
+    when fully healthy, 503 otherwise — the load-balancer convention."""
+    degraded = global_metrics.gauge("engine_degraded") > 0
+    state = "draining" if draining else ("degraded" if degraded else "ok")
+    payload = {
+        "status": state,
+        "queue_depth": int(global_metrics.gauge("engine_queue_depth")),
+        "slot_occupancy": global_metrics.gauge("engine_batch_occupancy"),
+        "inflight_requests": inflight,
+    }
+    await _send_simple(
+        channel, stream_id, 200 if state == "ok" else 503,
+        json.dumps(payload).encode(), {"content-type": "application/json"},
+    )
 
 
 async def run_serve(
@@ -270,8 +377,20 @@ async def run_serve(
     upstream_url: str = "",
     advertise_prefix: str = "/",
     backend: Optional[Backend] = None,
+    max_inflight: int = 0,
+    drain: Optional[asyncio.Event] = None,
 ) -> None:
-    """Run the provider side until the tunnel dies; raises to trigger retry."""
+    """Run the provider side until the tunnel dies; raises to trigger retry.
+
+    ``max_inflight`` bounds concurrently-dispatched requests (0 = unbounded):
+    beyond it, requests get HTTP 429 + Retry-After plus a typed ``busy``
+    tunnel-error frame instead of queueing without bound.
+
+    ``drain`` (optional) is the graceful-shutdown switch: once set, no new
+    request is admitted (503 ``draining``), in-flight responses run to
+    completion, then the channel closes and run_serve RETURNS cleanly
+    instead of raising — the supervisor sees a clean exit, not a retry.
+    """
     if backend is None:
         backend = http_backend(upstream_url, advertise_prefix)
 
@@ -309,11 +428,30 @@ async def run_serve(
                 return
 
     ping_task = asyncio.create_task(keepalive())
+
+    async def drainer() -> None:
+        """Wait for the drain signal, let in-flight streams finish, then
+        close the channel — which pops the recv loop with ChannelClosed
+        and turns into a CLEAN return below."""
+        await drain.wait()
+        log.info(
+            "drain: stopped admitting; %d request(s) in flight",
+            len(request_tasks),
+        )
+        while request_tasks:
+            await asyncio.wait(set(request_tasks))
+        log.info("drain complete, closing tunnel")
+        channel.close()
+
+    drain_task = asyncio.create_task(drainer()) if drain is not None else None
     try:
         while True:
             try:
                 raw = await channel.recv()
             except ChannelClosed:
+                if drain is not None and drain.is_set():
+                    log.info("serve drained cleanly")
+                    return
                 raise RuntimeError("channel closed, serve ending")
 
             try:
@@ -322,43 +460,110 @@ async def run_serve(
                 log.warning("failed to decode tunnel message: %s", e)
                 continue
 
-            if msg.msg_type == MessageType.REQ_HEADERS:
-                try:
-                    headers = RequestHeaders.from_json(msg.payload)
-                except ProtocolError as e:
-                    # One malformed frame must not tear down every stream.
-                    log.warning("bad REQ_HEADERS payload: %s", e)
-                    continue
-                log.debug("request %d %s %s", headers.stream_id, headers.method, headers.path)
-                pending[headers.stream_id] = (headers, bytearray())
-            elif msg.msg_type == MessageType.REQ_BODY:
-                entry = pending.get(msg.stream_id)
-                if entry is not None:
-                    entry[1].extend(msg.payload)
-            elif msg.msg_type == MessageType.REQ_END:
-                entry = pending.pop(msg.stream_id, None)
-                if entry is not None:
-                    req, body = entry
-                    task = asyncio.create_task(
-                        _handle_request(channel, backend, req, bytes(body), flow)
-                    )
-                    request_tasks.add(task)
-                    task.add_done_callback(request_tasks.discard)
-            elif msg.msg_type == MessageType.FLOW:
-                try:
-                    flow.grant(msg.stream_id, msg.flow_credit())
-                except ProtocolError as e:
-                    log.warning("bad FLOW frame: %s", e)
-            elif msg.msg_type == MessageType.PING:
-                try:
-                    await channel.send(TunnelMessage.pong().encode())
-                except ChannelClosed:
-                    raise RuntimeError("channel closed, serve ending")
-            elif msg.msg_type == MessageType.PONG:
-                log.debug("received pong")
-            else:
-                log.debug("serve ignoring message type %s", msg.msg_type.name)
+            try:
+                await _serve_dispatch(
+                    channel, backend, flow, pending, request_tasks,
+                    max_inflight, drain, msg,
+                )
+            except ChannelClosed:
+                # The drainer can close the channel between our recv and a
+                # reply send (healthz/shed responses); that window must
+                # still count as a clean drain, not a failed attempt.
+                if drain is not None and drain.is_set():
+                    log.info("serve drained cleanly")
+                    return
+                raise RuntimeError("channel closed, serve ending")
     finally:
         ping_task.cancel()
+        if drain_task is not None:
+            drain_task.cancel()
         for t in request_tasks:
             t.cancel()
+
+
+async def _serve_dispatch(
+    channel: Channel,
+    backend: Backend,
+    flow: FlowControl,
+    pending: Dict[int, Tuple[RequestHeaders, bytearray]],
+    request_tasks: "set[asyncio.Task]",
+    max_inflight: int,
+    drain: Optional[asyncio.Event],
+    msg: TunnelMessage,
+) -> None:
+    """Handle one decoded inbound frame for the serve loop.
+
+    ChannelClosed from any reply send propagates to the caller, which
+    distinguishes a drain-close (clean return) from a dead tunnel (retry).
+    """
+    if msg.msg_type == MessageType.REQ_HEADERS:
+        try:
+            headers = RequestHeaders.from_json(msg.payload)
+        except ProtocolError as e:
+            # One malformed frame must not tear down every stream.
+            log.warning("bad REQ_HEADERS payload: %s", e)
+            return
+        log.debug("request %d %s %s", headers.stream_id, headers.method, headers.path)
+        pending[headers.stream_id] = (headers, bytearray())
+    elif msg.msg_type == MessageType.REQ_BODY:
+        entry = pending.get(msg.stream_id)
+        if entry is not None:
+            entry[1].extend(msg.payload)
+    elif msg.msg_type == MessageType.REQ_END:
+        entry = pending.pop(msg.stream_id, None)
+        if entry is not None:
+            req, body = entry
+            path = req.path.split("?")[0]
+            if req.method.upper() == "GET" and path == "/healthz":
+                # Answered by the serve loop itself (not the backend) so
+                # health works identically for the HTTP and TPU backends.
+                await _send_healthz(
+                    channel, req.stream_id,
+                    draining=drain is not None and drain.is_set(),
+                    inflight=len(request_tasks),
+                )
+                return
+            if drain is not None and drain.is_set():
+                global_metrics.inc("serve_shed_total")
+                await _send_simple(
+                    channel, req.stream_id, 503,
+                    b"Service Unavailable: draining",
+                )
+                await channel.send(TunnelMessage.typed_error(
+                    req.stream_id, "draining",
+                    "server draining; not admitting new requests",
+                ).encode())
+                return
+            if max_inflight > 0 and len(request_tasks) >= max_inflight:
+                # Admission control at the tunnel layer: shed with 429 +
+                # Retry-After (HTTP clients) AND a typed `busy` error
+                # frame (protocol-aware peers).  The error frame follows
+                # RES_END, so the proxy — which forgets the stream at
+                # RES_END — is unaffected.
+                global_metrics.inc("serve_shed_total")
+                await _send_simple(
+                    channel, req.stream_id, 429,
+                    b"Too Many Requests: in-flight limit reached",
+                    {"retry-after": "1"},
+                )
+                await channel.send(TunnelMessage.typed_error(
+                    req.stream_id, "busy",
+                    f"in-flight limit {max_inflight} reached",
+                ).encode())
+                return
+            task = asyncio.create_task(
+                _handle_request(channel, backend, req, bytes(body), flow)
+            )
+            request_tasks.add(task)
+            task.add_done_callback(request_tasks.discard)
+    elif msg.msg_type == MessageType.FLOW:
+        try:
+            flow.grant(msg.stream_id, msg.flow_credit())
+        except ProtocolError as e:
+            log.warning("bad FLOW frame: %s", e)
+    elif msg.msg_type == MessageType.PING:
+        await channel.send(TunnelMessage.pong().encode())
+    elif msg.msg_type == MessageType.PONG:
+        log.debug("received pong")
+    else:
+        log.debug("serve ignoring message type %s", msg.msg_type.name)

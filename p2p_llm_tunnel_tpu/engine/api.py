@@ -33,8 +33,9 @@ import json
 import time
 from typing import AsyncIterator, Dict, Tuple
 
-from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
-from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders
+from p2p_llm_tunnel_tpu.engine.engine import DeadlineExceeded, InferenceEngine
+from p2p_llm_tunnel_tpu.engine.scheduler import QueueFull
+from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders, parse_deadline_ms
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -54,6 +55,25 @@ def _json_response(status: int, obj) -> Tuple[int, Dict[str, str], AsyncIterator
 
 def _error(status: int, message: str):
     return _json_response(status, {"error": {"message": message, "type": "invalid_request_error"}})
+
+
+def _overloaded():
+    """HTTP 429 + Retry-After: the admission queue is full (shed, don't
+    buffer — the goodput argument of DistServe/AlignedServe, PAPERS.md)."""
+    status, headers, it = _json_response(
+        429,
+        {"error": {"message": "server overloaded: admission queue full",
+                   "type": "overloaded_error"}},
+    )
+    headers["retry-after"] = "1"
+    return status, headers, it
+
+
+def _timeout(message: str):
+    return _json_response(
+        504, {"error": {"message": message or "deadline exceeded",
+                        "type": "timeout_error"}},
+    )
 
 
 def render_chat_prompt(messages) -> str:
@@ -893,6 +913,13 @@ class EngineAPI:
 
         try:
             kwargs, n_top, echo, score_only = self._gen_kwargs(payload)
+            deadline_ms = parse_deadline_ms(req.headers)
+            if deadline_ms is not None:
+                # Absolute monotonic deadline: enforced by the scheduler
+                # (slot eviction) AND by the serve endpoint (frame path),
+                # so neither a stuck engine nor a stalled tunnel can pin
+                # the request past its budget.
+                kwargs["deadline"] = time.monotonic() + deadline_ms / 1000.0
             stops = self._stop_strings(payload)
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")
@@ -911,6 +938,12 @@ class EngineAPI:
             # Total per-request fan-out cap (prompts x n): the batched
             # prompt-list dimension must not escape the bound n has.
             max_fanout = 16
+            # Admission control BEFORE any streaming 200 goes out: a full
+            # waiting queue means this request would only buffer, so shed
+            # it now with 429 + Retry-After.  (QueueFull from a submit race
+            # is additionally caught below for the non-stream paths.)
+            if self.engine.overloaded(n_choices):
+                return _overloaded()
 
             if path == "/v1/chat/completions":
                 if echo:
@@ -1002,6 +1035,10 @@ class EngineAPI:
                           "message": {"role": "assistant", "content": text},
                           "done": True, "done_reason": finish, "eval_count": n},
                 )
+        except QueueFull:
+            return _overloaded()
+        except DeadlineExceeded as e:
+            return _timeout(str(e))
         except (ValueError, TypeError) as e:
             return _error(400, str(e))
 

@@ -44,6 +44,18 @@ log = get_logger(__name__)
 #: truncated 200.
 _CRASHED = object()
 
+#: Queue sentinel for a deadline eviction: the scheduler already reclaimed
+#: the slot/queue entry; generate() raises DeadlineExceeded so the response
+#: layer can emit a typed timeout instead of a silently truncated stream.
+_TIMED_OUT = object()
+
+
+class DeadlineExceeded(Exception):
+    """The request's x-tunnel-deadline-ms budget ran out before completion."""
+
+    #: Typed tunnel-error code (protocol.frames.TunnelMessage.typed_error).
+    tunnel_code = "timeout"
+
 
 @dataclass
 class EngineConfig:
@@ -145,6 +157,16 @@ class EngineConfig:
     # (the segment width); the LAST segment's logits sample the first
     # token.  0 disables (prompts prefill whole, the pre-r4 behavior).
     prefill_chunk: int = 0
+    # Admission control: max requests buffered in the scheduler's waiting
+    # queue.  Overflow raises scheduler.QueueFull, which the API maps to
+    # HTTP 429 + Retry-After — shedding beats buffering work that cannot
+    # finish (goodput, PAPERS.md DistServe/AlignedServe).  0 = unbounded.
+    max_waiting: int = 0
+    # Decode-stall watchdog: if requests are active but no token is
+    # accounted for this many seconds, log an error and mark the engine
+    # degraded (surfaced by serve's /healthz).  Detection only — a stalled
+    # XLA dispatch cannot be safely interrupted.  0 disables.
+    watchdog_budget_s: float = 0.0
 
 
 @dataclass
@@ -282,7 +304,7 @@ class InferenceEngine:
             # tp shards the kv-head axis; the slot axis stays whole (the
             # engine's dp axis is 1 — replica routing is a layer above).
             self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
-        self.scheduler = Scheduler(b, s)
+        self.scheduler = Scheduler(b, s, max_waiting=self.ecfg.max_waiting)
 
         if self.ecfg.prefill_chunk > 0 and self.ecfg.sp > 1:
             # Same scope limit as the prefix cache below: the chunk-prefill
@@ -382,6 +404,12 @@ class InferenceEngine:
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        # Watchdog state: monotonic time of the last accounted token (or
+        # idle period); degraded flips when the budget is blown while work
+        # is active, and clears on the next progress.
+        self._last_progress = time.monotonic()
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self.degraded = False
         # Dedicated single thread for blocking XLA calls: sharing the default
         # executor starves decode when other components run blocking work.
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -656,10 +684,49 @@ class InferenceEngine:
         if self._task is None:
             self._running = True
             self._task = asyncio.create_task(self._loop())
+            if self.ecfg.watchdog_budget_s > 0:
+                self._watchdog_task = asyncio.create_task(self._watchdog())
+
+    async def _watchdog(self) -> None:
+        """Flag (never interrupt) a stalled decode path.
+
+        Runs as its own task because the engine loop itself is what stalls:
+        a wedged XLA dispatch blocks the executor thread and the loop's
+        run_in_executor await with it.  The watchdog only observes
+        host-side state, so it keeps ticking and can mark the engine
+        degraded for /healthz while the loop is stuck.
+        """
+        budget = self.ecfg.watchdog_budget_s
+        while self._running:
+            await asyncio.sleep(min(1.0, budget / 4))
+            busy = bool(self._requests)
+            stalled = time.monotonic() - self._last_progress > budget
+            if busy and stalled:
+                if not self.degraded:
+                    log.error(
+                        "decode-stall watchdog: no token accounted in "
+                        "%.1fs with %d request(s) in flight; marking "
+                        "engine degraded", budget, len(self._requests),
+                    )
+                    global_metrics.inc("engine_watchdog_stalls_total")
+                self.degraded = True
+            elif self.degraded and not stalled:
+                log.info("decode-stall watchdog: progress resumed")
+                self.degraded = False
+            global_metrics.set_gauge(
+                "engine_degraded", 1.0 if self.degraded else 0.0
+            )
 
     async def stop(self) -> None:
         self._running = False
         self._wake.set()
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except asyncio.CancelledError:
+                pass
+            self._watchdog_task = None
         if self._task is not None:
             try:
                 await self._task
@@ -820,9 +887,20 @@ class InferenceEngine:
         execute pass, so the hint works even when AOT is skipped (PAR
         unset, SPMD, no persistent cache dir)."""
         hint = os.environ.get("TUNNEL_WARMUP_PREFILL_TOKENS", "")
-        return sorted({
-            self._bucket(int(n)) for n in hint.split(",") if n.strip()
-        })
+        widths = set()
+        for n in hint.split(","):
+            if not n.strip():
+                continue
+            try:
+                widths.add(self._bucket(int(n)))
+            except ValueError:
+                # Best-effort hint: a malformed entry must not abort engine
+                # startup (ADVICE item 4) — skip it and warm the rest.
+                log.warning(
+                    "ignoring malformed TUNNEL_WARMUP_PREFILL_TOKENS "
+                    "entry %r", n.strip(),
+                )
+        return sorted(widths)
 
     def _warm_prefill_program(self, width: int) -> None:
         """Execute-warm the plain-prefill program at prompt bucket
@@ -930,27 +1008,30 @@ class InferenceEngine:
             jobs.append(
                 ("copy_out", lambda: self._copy_out.lower(*out_args))
             )
+        # Chunk-prefill programs are keyed by (tail, view) only: when
+        # ecfg.prefill_chunk matches a prefix-cache tail bucket, the
+        # prefix path and the segment path want the IDENTICAL program —
+        # dedupe before submitting, or two threads compile it concurrently
+        # (the persistent cache does not dedupe in-flight compiles,
+        # ADVICE item 2).
+        chunk_pairs = set()
+        if self._prefix is not None:
             for t in self._chunk_buckets:
                 for view in views:
                     if view >= t:
-                        jobs.append((
-                            f"chunk[t{t},v{view}]",
-                            lambda t=t, view=view:
-                                self._jit_chunk_prefill.lower(
-                                    *self._chunk_warm_args(t, view)
-                                ),
-                        ))
+                        chunk_pairs.add((t, view))
         if self.ecfg.prefill_chunk > 0:
             for view in views:
                 if view >= self.ecfg.prefill_chunk:
-                    c = self.ecfg.prefill_chunk
-                    jobs.append((
-                        f"chunkseg[t{c},v{view}]",
-                        lambda c=c, view=view:
-                            self._jit_chunk_prefill.lower(
-                                *self._chunk_warm_args(c, view)
-                            ),
-                    ))
+                    chunk_pairs.add((self.ecfg.prefill_chunk, view))
+        for t, view in sorted(chunk_pairs):
+            jobs.append((
+                f"chunk[t{t},v{view}]",
+                lambda t=t, view=view:
+                    self._jit_chunk_prefill.lower(
+                        *self._chunk_warm_args(t, view)
+                    ),
+            ))
 
         def _one(label, thunk):
             t1 = time.monotonic()
@@ -1017,6 +1098,13 @@ class InferenceEngine:
 
     # -- public API -------------------------------------------------------
 
+    def overloaded(self, n: int = 1) -> bool:
+        """Would submitting ``n`` more requests overflow the bounded
+        waiting queue?  Always False with max_waiting=0 (unbounded).
+        Callers use this to shed BEFORE committing to a streaming 200."""
+        mw = self.ecfg.max_waiting
+        return mw > 0 and self.scheduler.queue_depth + n > mw
+
     async def embed(self, prompts: List[List[int]]) -> np.ndarray:
         """Mean-pooled embeddings for a batch of token-id prompts.
 
@@ -1067,8 +1155,14 @@ class InferenceEngine:
         stop_ids: Optional[Tuple[int, ...]] = None,
         seed: Optional[int] = None,
         logit_bias: Tuple[Tuple[int, float], ...] = (),
+        deadline: Optional[float] = None,
     ) -> AsyncIterator[TokenEvent]:
-        """Submit one request; yields TokenEvents as the batch decodes."""
+        """Submit one request; yields TokenEvents as the batch decodes.
+
+        ``deadline`` is an absolute ``time.monotonic()`` instant: once
+        passed, the scheduler evicts the request wherever it is (waiting
+        queue or decode slot) and this generator raises DeadlineExceeded.
+        """
         if self._crashed:
             raise RuntimeError(
                 "engine loop crashed; restart the serve process"
@@ -1077,6 +1171,8 @@ class InferenceEngine:
             raise ValueError(
                 f"logit_bias supports at most {self.BIAS_CAP} entries"
             )
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded("deadline already expired at submit")
         if stop_ids is None:
             stop_ids = (self.tokenizer.eos_id,)
         rid = self._next_request_id
@@ -1100,6 +1196,7 @@ class InferenceEngine:
             logprobs=logprobs,
             echo_logprobs=echo_logprobs,
             stop_ids=tuple(stop_ids),
+            deadline=deadline,
         )
         state = _ActiveRequest(
             queue=asyncio.Queue(), decoder=StreamDecoder(self.tokenizer),
@@ -1115,6 +1212,10 @@ class InferenceEngine:
                 event = await state.queue.get()
                 if event is _CRASHED:
                     raise RuntimeError("engine crashed mid-generation")
+                if event is _TIMED_OUT:
+                    raise DeadlineExceeded(
+                        "deadline exceeded; request evicted"
+                    )
                 if event is None:
                     return
                 yield event
@@ -1749,10 +1850,30 @@ class InferenceEngine:
                 "engine_spec_accepted_tokens_total", n_emitted - n_rows
             )
 
+    def _expire_deadlines(self) -> None:
+        """Evict deadline-blown requests (queue or slot) and fail their
+        consumers with DeadlineExceeded.  Runs once per loop iteration —
+        granularity is one burst, which is the finest the engine can evict
+        at anyway (a slot frees between dispatches, never inside one)."""
+        expired = self.scheduler.expire(time.monotonic())
+        for slot, req in expired:
+            if slot is not None:
+                self._active_mask[slot] = False
+            global_metrics.inc("engine_deadline_timeouts_total")
+            log.warning(
+                "request %d exceeded its deadline (%s); slot reclaimed",
+                req.request_id,
+                "waiting" if slot is None else f"slot {slot}",
+            )
+            state = self._requests.get(req.request_id)
+            if state is not None:
+                state.queue.put_nowait(_TIMED_OUT)
+
     def _account_token(self, slot: int, tok: int, lp_info=None,
                        prompt_lps=None) -> None:
         """Record one generated token: scheduler accounting, slot-state
         update for the next decode call, eviction, emission."""
+        self._last_progress = time.monotonic()
         out = self.scheduler.record_token(slot, tok)
         evicted = self.scheduler.slots[slot] is None
         if evicted:
@@ -2040,6 +2161,9 @@ class InferenceEngine:
             in_flight = None  # (sampled device array, request-id snapshot)
             while self._running:
                 if self.scheduler.idle and in_flight is None:
+                    # Idle time is not a stall: keep the watchdog anchored
+                    # to "now" so the next request's budget starts fresh.
+                    self._last_progress = time.monotonic()
                     self._wake.clear()
                     try:
                         await asyncio.wait_for(self._wake.wait(), timeout=0.5)
@@ -2047,6 +2171,7 @@ class InferenceEngine:
                         continue
                     continue
 
+                self._expire_deadlines()
                 await self._admit_pending(loop)
 
                 global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)

@@ -11,7 +11,18 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional
+from typing import Deque, List, Optional, Tuple
+
+
+class QueueFull(Exception):
+    """The bounded waiting queue is at capacity; shed instead of buffering.
+
+    Carries no retry hint — admission latency depends on in-flight work the
+    scheduler cannot see; callers map this to HTTP 429 + ``Retry-After``.
+    """
+
+    #: Typed tunnel-error code (protocol.frames.TunnelMessage.typed_error).
+    tunnel_code = "busy"
 
 
 @dataclass
@@ -38,6 +49,10 @@ class GenRequest:
     # OpenAI logit_bias as ((token_id, bias), ...); applied to the raw
     # logits on-device for every sampled token of this request.
     logit_bias: tuple = ()
+    # Absolute monotonic-clock deadline (seconds); expire() evicts the
+    # request — queued OR running — once now passes it, so a slow client
+    # can never pin a decode slot forever.  None = no deadline.
+    deadline: Optional[float] = None
 
     def __post_init__(self) -> None:
         if not self.prompt_ids:
@@ -61,13 +76,20 @@ class RunningSlot:
 
 
 class Scheduler:
-    """Fixed-slot admission/eviction; FIFO among waiting requests."""
+    """Fixed-slot admission/eviction; FIFO among waiting requests.
 
-    def __init__(self, num_slots: int, max_seq: int):
+    ``max_waiting`` bounds the waiting queue (0 = unbounded): under overload
+    submit() raises QueueFull instead of buffering work the engine cannot
+    finish — the goodput-over-throughput shedding DistServe/AlignedServe
+    argue for (PAPERS.md).
+    """
+
+    def __init__(self, num_slots: int, max_seq: int, max_waiting: int = 0):
         if num_slots < 1:
             raise ValueError("need at least one slot")
         self.num_slots = num_slots
         self.max_seq = max_seq
+        self.max_waiting = max_waiting
         self.waiting: Deque[GenRequest] = deque()
         self.slots: List[Optional[RunningSlot]] = [None] * num_slots
 
@@ -77,6 +99,10 @@ class Scheduler:
         if len(req.prompt_ids) >= self.max_seq:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens does not fit max_seq={self.max_seq}"
+            )
+        if self.max_waiting > 0 and len(self.waiting) >= self.max_waiting:
+            raise QueueFull(
+                f"waiting queue full ({len(self.waiting)}/{self.max_waiting})"
             )
         self.waiting.append(req)
 
@@ -119,6 +145,33 @@ class Scheduler:
                 self.slots[i] = None
                 return True
         return False
+
+    def expire(self, now: float) -> List[Tuple[Optional[int], GenRequest]]:
+        """Evict every request whose deadline has passed.
+
+        Returns ``(slot, request)`` pairs — ``slot`` is None for requests
+        still waiting — in a deterministic order: waiting requests in FIFO
+        order first, then running slots by slot index.  Deterministic
+        ordering matters when a cancel and an expiry race within one engine
+        step (tests/test_scheduler.py): the outcome must not depend on dict
+        iteration order.
+        """
+        expired: List[Tuple[Optional[int], GenRequest]] = []
+        keep: Deque[GenRequest] = deque()
+        for req in self.waiting:
+            if req.deadline is not None and now >= req.deadline:
+                expired.append((None, req))
+            else:
+                keep.append(req)
+        self.waiting = keep
+        for i, run in enumerate(self.slots):
+            if run is None:
+                continue
+            d = run.request.deadline
+            if d is not None and now >= d:
+                self.slots[i] = None
+                expired.append((i, run.request))
+        return expired
 
     # -- introspection ----------------------------------------------------
 

@@ -25,7 +25,7 @@ import enum
 import json
 import struct
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 PROTOCOL_VERSION = 1
 PROTOCOL_NAME = "httptunnel"
@@ -301,6 +301,26 @@ class TunnelMessage:
         return cls(MessageType.ERROR, stream_id, msg.encode())
 
     @classmethod
+    def typed_error(cls, stream_id: int, code: str, msg: str) -> "TunnelMessage":
+        """ERROR frame with a machine-readable ``[code]`` prefix.
+
+        The payload stays plain UTF-8 text — reference peers render it
+        verbatim — but robustness-aware peers can dispatch on the code
+        (``timeout`` / ``busy`` / ``draining`` / ``upstream``) via
+        :meth:`error_code` instead of string-matching free text.
+        """
+        return cls.error(stream_id, f"[{code}] {msg}")
+
+    def error_code(self) -> Optional[str]:
+        """The ``[code]`` of a typed ERROR frame, or None for plain text."""
+        if self.msg_type != MessageType.ERROR:
+            return None
+        text = self.payload.decode("utf-8", "replace")
+        if text.startswith("[") and "]" in text:
+            return text[1 : text.index("]")]
+        return None
+
+    @classmethod
     def flow(cls, stream_id: int, credit: int) -> "TunnelMessage":
         """Grant ``credit`` more response-body bytes for one stream."""
         return cls(MessageType.FLOW, stream_id, struct.pack(">I", credit))
@@ -309,6 +329,34 @@ class TunnelMessage:
         if len(self.payload) < 4:
             raise ProtocolError("FLOW payload must be a u32 credit")
         return struct.unpack_from(">I", self.payload)[0]
+
+
+#: Optional per-request time budget, in milliseconds, set by the client.
+#: Enforced by the serve endpoint (frame relay) and the engine scheduler
+#: (slot eviction).  A wire convention, so it lives with the frame codec —
+#: both the endpoints and the engine layers consume it.
+DEADLINE_HEADER = "x-tunnel-deadline-ms"
+
+
+def parse_deadline_ms(headers: Dict[str, str]) -> "Optional[float]":
+    """The request's ``x-tunnel-deadline-ms`` budget, or None.
+
+    Malformed or non-positive values are ignored with a warning — a bad
+    hint must never fail a request that would otherwise succeed.
+    """
+    from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+    for k, v in headers.items():
+        if k.lower() == DEADLINE_HEADER:
+            try:
+                ms = float(v)
+            except (TypeError, ValueError):
+                get_logger(__name__).warning(
+                    "ignoring malformed %s: %r", DEADLINE_HEADER, v
+                )
+                return None
+            return ms if ms > 0 else None
+    return None
 
 
 def iter_body_chunks(data: bytes, chunk_size: int = MAX_BODY_CHUNK):

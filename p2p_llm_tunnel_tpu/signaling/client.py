@@ -17,8 +17,16 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-import websockets
-from websockets.asyncio.client import connect as ws_connect
+try:
+    import websockets
+    from websockets.asyncio.client import connect as ws_connect
+except ImportError:  # gated optional dep: only live signaling needs it.
+    # Everything above this module (transport package, endpoints, engine
+    # API) must stay importable without websockets — loopback stacks,
+    # tests, and offline tools never open a signaling socket.  connect()
+    # raises a clear error if actually used.
+    websockets = None
+    ws_connect = None
 
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
@@ -111,6 +119,11 @@ class SignalingClient:
     async def connect(
         cls, signal_url: str, room: str, timeout: float = 15.0
     ) -> "SignalingClient":
+        if ws_connect is None:
+            raise RuntimeError(
+                "the 'websockets' package is required for live signaling "
+                "(pip install websockets)"
+            )
         ws = await asyncio.wait_for(ws_connect(signal_url), timeout)
         client = cls(room=room, _ws=ws)
         # join-on-connect (signaling.rs:94-99)

@@ -24,8 +24,15 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Set
 
-import websockets
-from websockets.asyncio.server import ServerConnection, serve
+try:
+    import websockets
+    from websockets.asyncio.server import ServerConnection, serve
+except ImportError:  # gated optional dep (see signaling/client.py): the
+    # rendezvous server cannot RUN without websockets, but importing this
+    # module must not fail — loopback stacks and tests never start it.
+    websockets = None
+    ServerConnection = None
+    serve = None
 
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
@@ -59,6 +66,11 @@ class SignalServer:
 
     async def start(self) -> int:
         """Bind and serve; returns the bound port (for port 0)."""
+        if serve is None:
+            raise RuntimeError(
+                "the 'websockets' package is required to run the signal "
+                "server (pip install websockets)"
+            )
         self._server = await serve(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("signal server listening on ws://%s:%d", self.host, self.port)

@@ -12,6 +12,7 @@ received raw frames, and connected/disconnected events.  Implementations:
 """
 
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.transport.chaos import ChaosChannel, ChaosSpec, maybe_chaos
 from p2p_llm_tunnel_tpu.transport.connect import ConnectError, connect
 from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
 from p2p_llm_tunnel_tpu.transport.tcp import TcpChannel
@@ -20,6 +21,9 @@ from p2p_llm_tunnel_tpu.transport.udp import UdpChannel
 __all__ = [
     "Channel",
     "ChannelClosed",
+    "ChaosChannel",
+    "ChaosSpec",
+    "maybe_chaos",
     "loopback_pair",
     "TcpChannel",
     "UdpChannel",

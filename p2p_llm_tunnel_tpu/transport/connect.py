@@ -17,6 +17,7 @@ The rtc.rs:463-514 equivalent, with the same observable semantics:
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 from typing import List, Optional, Tuple
 
@@ -101,6 +102,12 @@ async def _connect_inner(
     stun_server: Optional[str], relay: Optional[str],
     relay_secret: Optional[str] = None,
 ) -> Tuple[Channel, SignalingClient]:
+    # Validate any TUNNEL_CHAOS spec BEFORE any resource exists: a typo'd
+    # spec must fail fast, not leak an established channel per retry.
+    from p2p_llm_tunnel_tpu.transport.chaos import ChaosSpec, ENV_VAR
+
+    ChaosSpec.parse(os.environ.get(ENV_VAR, ""))
+
     signaling = await SignalingClient.connect(signal_url, room)
     try:
         joined = await _expect(signaling, Joined)
@@ -118,7 +125,11 @@ async def _connect_inner(
             channel = await _establish(signaling, room, observed_ip, transport,
                                        offerer=False, stun_server=stun_server,
                                        relay=relay, relay_secret=relay_secret)
-        return channel, signaling
+        # Opt-in fault injection (TUNNEL_CHAOS): wraps the established
+        # channel so every endpoint above sees the injected faults.
+        from p2p_llm_tunnel_tpu.transport.chaos import maybe_chaos
+
+        return maybe_chaos(channel), signaling
     except BaseException:
         await signaling.close()
         raise

@@ -17,22 +17,38 @@ import os
 import struct
 from typing import Tuple
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    HAVE_CRYPTO = True
+except ImportError:  # gated optional dep: the loopback transport, the
+    # frame/endpoint layers, and the engine need no crypto; only the real
+    # UDP/TCP data planes do.  Importing must succeed so those layers stay
+    # usable — constructing keys without the package raises clearly.
+    HAVE_CRYPTO = False
 
 NONCE_SIZE = 12
 TAG_SIZE = 16
+
+
+def _require_crypto() -> None:
+    if not HAVE_CRYPTO:
+        raise RuntimeError(
+            "the 'cryptography' package is required for encrypted "
+            "transports (pip install cryptography)"
+        )
 
 
 class HandshakeKeys:
     """One peer's ephemeral keypair and the derived session keys."""
 
     def __init__(self) -> None:
+        _require_crypto()
         self._private = X25519PrivateKey.generate()
         self.public_bytes = self._private.public_key().public_bytes_raw()
 
@@ -64,6 +80,7 @@ class SecureBox:
     """
 
     def __init__(self, send_key: bytes, recv_key: bytes) -> None:
+        _require_crypto()
         self._send = ChaCha20Poly1305(send_key)
         self._recv = ChaCha20Poly1305(recv_key)
         self._send_ctr = 0

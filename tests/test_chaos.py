@@ -1,0 +1,345 @@
+"""Chaos-injection transport: spec grammar, fault semantics, determinism,
+and the end-to-end request-lifecycle acceptance scenario.
+
+The e2e scenario (slow, seeded via CHAOS_TEST_SEED — `make chaos` runs three
+fixed seeds) proves, under seeded drop+stall injection on the client→serve
+path:
+
+- a request with a 2 s deadline returns a typed timeout ERROR frame and its
+  decode slot is reclaimed (asserted via scheduler state);
+- a burst beyond the admission queue limit yields 429 + Retry-After;
+- drain (the SIGTERM path) finishes the in-flight stream before exit;
+- the whole outcome — including the fault schedule — is identical across
+  two runs with the same seed.
+
+The client pads every frame with a PING so the seeded drop schedule has
+loss-tolerant targets; the pinned seeds drop only pads (verified by the
+determinism assertion, not by luck at runtime).
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from p2p_llm_tunnel_tpu.testing.frame_client import FrameClient
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+from p2p_llm_tunnel_tpu.transport.chaos import (
+    ChaosChannel,
+    ChaosSpec,
+    ChaosSpecError,
+    maybe_chaos,
+)
+
+SEED = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+def test_spec_parse_full():
+    spec = ChaosSpec.parse(
+        "seed=42, drop=0.1, dup=0.2, reorder=0.3, corrupt=0.05,"
+        " stall=0.5:0.25, partition=20:5"
+    )
+    assert spec == ChaosSpec(
+        seed=42, drop=0.1, dup=0.2, reorder=0.3, corrupt=0.05,
+        stall_p=0.5, stall_s=0.25, partition_after=20, partition_len=5,
+    )
+
+
+def test_spec_parse_defaults_and_partials():
+    assert ChaosSpec.parse("") == ChaosSpec()
+    assert ChaosSpec.parse("drop=0.5").drop == 0.5
+    s = ChaosSpec.parse("stall=0.1")
+    assert s.stall_p == 0.1 and s.stall_s == 0.1  # default duration
+    p = ChaosSpec.parse("partition=7")
+    assert p.partition_after == 7 and p.partition_len == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "drop", "drop=x", "frobnicate=1", "drop=1.5", "stall=2:1",
+])
+def test_spec_parse_rejects_malformed(bad):
+    with pytest.raises(ChaosSpecError):
+        ChaosSpec.parse(bad)
+
+
+def test_maybe_chaos_passthrough_and_wrap(monkeypatch):
+    a, b = loopback_pair()
+    monkeypatch.delenv("TUNNEL_CHAOS", raising=False)
+    assert maybe_chaos(a) is a  # no spec → untouched
+    wrapped = maybe_chaos(a, "seed=1,drop=0.5")
+    assert isinstance(wrapped, ChaosChannel)
+    monkeypatch.setenv("TUNNEL_CHAOS", "drop=not-a-number")
+    with pytest.raises(ChaosSpecError):
+        maybe_chaos(b)
+
+
+# ---------------------------------------------------------------------------
+# fault semantics over loopback
+# ---------------------------------------------------------------------------
+
+def _chaos_pair(spec: str):
+    a, b = loopback_pair()
+    return ChaosChannel(a, ChaosSpec.parse(spec)), b
+
+
+async def _drain_rx(ch, n, timeout=2.0):
+    out = []
+    for _ in range(n):
+        try:
+            out.append(await asyncio.wait_for(ch.recv(), timeout))
+        except asyncio.TimeoutError:
+            break
+    return out
+
+
+def test_drop_all():
+    async def main():
+        c, rx = _chaos_pair("seed=1,drop=1.0")
+        for i in range(5):
+            await c.send(bytes([i]))
+        assert await _drain_rx(rx, 5, timeout=0.2) == []
+        assert [kind for _, kind in c.faults] == ["drop"] * 5
+
+    asyncio.run(main())
+
+
+def test_duplicate_all():
+    async def main():
+        c, rx = _chaos_pair("seed=1,dup=1.0")
+        await c.send(b"x")
+        assert await _drain_rx(rx, 2) == [b"x", b"x"]
+
+    asyncio.run(main())
+
+
+def test_reorder_swaps_neighbors():
+    async def main():
+        c, rx = _chaos_pair("seed=1,reorder=1.0")
+        for m in (b"a", b"b", b"c", b"d"):
+            await c.send(m)
+        # a held → flushed behind b; c held → flushed behind d.
+        assert await _drain_rx(rx, 4, timeout=0.2) == [b"b", b"a", b"d", b"c"]
+        assert c._held is None
+
+    asyncio.run(main())
+
+
+def test_corrupt_flips_one_byte():
+    async def main():
+        c, rx = _chaos_pair("seed=3,corrupt=1.0")
+        await c.send(bytes(8))
+        (got,) = await _drain_rx(rx, 1)
+        assert got != bytes(8)
+        assert sum(a != b for a, b in zip(got, bytes(8))) == 1
+
+    asyncio.run(main())
+
+
+def test_partition_drops_window_by_message_count():
+    async def main():
+        c, rx = _chaos_pair("seed=1,partition=2:2")
+        for i in range(6):
+            await c.send(bytes([i]))
+        assert await _drain_rx(rx, 6, timeout=0.2) == [
+            bytes([0]), bytes([1]), bytes([4]), bytes([5])
+        ]
+        assert [i for i, kind in c.faults if kind == "partition"] == [2, 3]
+
+    asyncio.run(main())
+
+
+def test_stall_delays_but_delivers():
+    async def main():
+        c, rx = _chaos_pair("seed=1,stall=1.0:0.05")
+        t0 = time.monotonic()
+        await c.send(b"m")
+        assert await _drain_rx(rx, 1) == [b"m"]
+        assert time.monotonic() - t0 >= 0.05
+        assert c.faults == [(0, "stall")]
+
+    asyncio.run(main())
+
+
+def test_same_seed_same_schedule():
+    """Two runs of the same send sequence draw identical fault schedules
+    and deliver identical bytes — the determinism contract."""
+    spec = "seed=11,drop=0.2,dup=0.2,reorder=0.2,corrupt=0.2,stall=0.2:0.001"
+    msgs = [bytes([i]) * 40 for i in range(30)]
+
+    async def run_once():
+        c, rx = _chaos_pair(spec)
+        for m in msgs:
+            await c.send(m)
+        got = await _drain_rx(rx, 100, timeout=0.2)
+        return c.faults, got
+
+    f1, g1 = asyncio.run(run_once())
+    f2, g2 = asyncio.run(run_once())
+    assert f1 == f2
+    assert g1 == g2
+    assert f1, "schedule fired no faults at these rates — spec broken"
+
+
+def test_close_delegates_to_inner():
+    async def main():
+        c, rx = _chaos_pair("seed=1")
+        assert not c.is_closed
+        c.close()
+        assert c.is_closed and rx.is_closed
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance scenario (engine + serve + chaos; slow)
+# ---------------------------------------------------------------------------
+
+CHAT = "/v1/chat/completions"
+
+
+async def _scenario(seed: int):
+    """One full lifecycle pass; returns the outcome tuple compared across
+    runs for determinism."""
+    from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    engine = InferenceEngine(engine_cfg=EngineConfig(
+        model="tiny", num_slots=1, max_seq=512, dtype="float32",
+        decode_steps=4, max_waiting=1,
+    ))
+    await engine.start()
+    serve_ch, client_ch = loopback_pair()
+    chaos = ChaosChannel(
+        client_ch, ChaosSpec.parse(f"seed={seed},drop=0.06,stall=0.25:0.04")
+    )
+    drain = asyncio.Event()
+    serve_task = asyncio.create_task(run_serve(
+        serve_ch, backend=engine_backend(engine, "tiny"), drain=drain,
+    ))
+    client = FrameClient(chaos, pad_pings=True, reply_pings=False)
+    try:
+        await client.handshake(timeout=30.0)
+
+        # -- deadline: 2 s budget against a cold-compile + 500-token run --
+        d = await client.request(
+            "POST", CHAT,
+            body={"messages": [{"role": "user", "content": "tell me"}],
+                  "stream": True, "max_tokens": 500, "ignore_eos": True},
+            headers={"x-tunnel-deadline-ms": "2000"},
+        )
+        await client.wait(d, timeout=120.0)
+        slots_reclaimed = False
+        for _ in range(400):  # compile may still be in flight; poll
+            if (all(s is None for s in engine.scheduler.slots)
+                    and engine.scheduler.queue_depth == 0):
+                slots_reclaimed = True
+                break
+            await asyncio.sleep(0.05)
+
+        # -- admission: burst past the 1-deep queue while a hog decodes --
+        h = await client.request(
+            "POST", CHAT,
+            body={"messages": [{"role": "user", "content": "hog"}],
+                  "stream": True, "max_tokens": 350, "ignore_eos": True},
+        )
+        for _ in range(1500):  # first streamed byte ⇒ hog owns the slot
+            if h.body:
+                break
+            await asyncio.sleep(0.02)
+        burst = [
+            await client.request(
+                "POST", "/v1/completions",
+                body={"prompt": "hi", "max_tokens": 2, "ignore_eos": True},
+            )
+            for _ in range(3)
+        ]
+        for r in burst:
+            await client.wait(r, timeout=120.0)
+        await client.wait(h, timeout=120.0)
+        burst_statuses = tuple(sorted(r.status for r in burst))
+        retry_after_ok = all(
+            r.headers.get("retry-after") == "1"
+            for r in burst if r.status == 429
+        )
+
+        # -- drain (the SIGTERM path) during an in-flight stream --
+        s = await client.request(
+            "POST", CHAT,
+            body={"messages": [{"role": "user", "content": "drain me"}],
+                  "stream": True, "max_tokens": 200, "ignore_eos": True},
+        )
+        for _ in range(1500):
+            if s.body:
+                break
+            await asyncio.sleep(0.02)
+        drain.set()
+        x = await client.request("GET", "/v1/models")
+        await client.wait(x, timeout=60.0)
+        await asyncio.sleep(0.3)  # typed frame follows x's RES_END
+        await client.wait(s, timeout=120.0)
+        s_events = [
+            json.loads(line[len("data: "):])
+            for line in s.text.split("\n\n")
+            if line.strip().startswith("data: ")
+            and line.strip() != "data: [DONE]"
+        ]
+        s_finished = any(
+            c.get("finish_reason") for e in s_events
+            for c in e.get("choices", [])
+        )
+        await asyncio.wait_for(serve_task, 60.0)
+        serve_clean = serve_task.exception() is None
+
+        return (
+            tuple(chaos.faults),
+            d.status, d.error_code,
+            slots_reclaimed,
+            burst_statuses, retry_after_ok,
+            x.status, x.error_code,
+            s_finished, s.error is None,
+            serve_clean,
+        )
+    finally:
+        client.close()
+        serve_task.cancel()
+        serve_ch.close()
+        await asyncio.gather(serve_task, return_exceptions=True)
+        await engine.stop()
+
+
+@pytest.mark.slow
+def test_lifecycle_under_chaos_deterministic():
+    out1 = asyncio.run(_scenario(SEED))
+    out2 = asyncio.run(_scenario(SEED))
+
+    (faults, d_status, d_code, slots_reclaimed, burst_statuses,
+     retry_after_ok, x_status, x_code, s_finished, s_clean,
+     serve_clean) = out1
+
+    # Injection actually fired.
+    kinds = {k for _, k in faults}
+    assert "drop" in kinds and "stall" in kinds, faults
+    # Deadline: streaming 200 opened, then a TYPED timeout error frame.
+    assert d_status == 200
+    assert d_code == "timeout"
+    # The evicted request's decode slot was reclaimed.
+    assert slots_reclaimed
+    # Burst beyond the admission queue: exactly one winner, two shed with
+    # 429 + Retry-After.
+    assert burst_statuses == (200, 429, 429)
+    assert retry_after_ok
+    # Drain: new work refused with a typed `draining` 503...
+    assert x_status == 503
+    assert x_code == "draining"
+    # ...while the in-flight stream ran to completion before exit.
+    assert s_finished and s_clean
+    assert serve_clean
+    # And the whole outcome is deterministic for this seed.
+    assert out1 == out2

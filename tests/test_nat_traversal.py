@@ -11,6 +11,9 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("cryptography")  # optional dep: skip (not fail) where absent
+pytest.importorskip("websockets")  # optional dep: skip (not fail) where absent
+
 import importlib
 
 # transport/__init__ re-exports the connect FUNCTION under the same name as

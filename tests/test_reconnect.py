@@ -10,6 +10,8 @@ import json
 
 import pytest
 
+pytest.importorskip("websockets")  # optional dep: skip (not fail) where absent
+
 from p2p_llm_tunnel_tpu import cli
 from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
 from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy

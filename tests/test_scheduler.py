@@ -89,6 +89,50 @@ def test_invalid_requests_rejected():
         GenRequest(1, [1], 0)
 
 
+def test_mixed_cancel_and_deadline_expiry_same_step():
+    """A cancel and deadline expiries landing in the same engine step must
+    resolve deterministically: the cancel applies first (consumer is gone),
+    then expire() evicts waiting requests in FIFO order, then running
+    slots by slot index — never dict/iteration-order dependent."""
+    def dreq(rid, deadline=None, prompt_len=4):
+        return GenRequest(
+            rid, list(range(1, prompt_len + 1)), 8, deadline=deadline
+        )
+
+    s = Scheduler(num_slots=2, max_seq=64)
+    s.submit(dreq(1, deadline=1.0))
+    s.submit(dreq(2))  # no deadline, will be cancelled
+    s.admit()  # 1 → slot 0, 2 → slot 1
+    s.submit(dreq(3, deadline=1.0))  # waiting, expired
+    s.submit(dreq(4))  # waiting, immune
+
+    # Same step: consumer of 2 cancels, then the step's expiry sweep runs.
+    assert s.cancel(2) is True
+    expired = s.expire(now=2.0)
+    assert [(slot, r.request_id) for slot, r in expired] == [
+        (None, 3),  # waiting first, FIFO order
+        (0, 1),     # then running slots by index
+    ]
+    # Cancelled and expired slots are both reclaimed; 4 survives untouched.
+    assert s.slots == [None, None]
+    assert [r.request_id for r in s.waiting] == [4]
+    # The freed slots readmit the survivor (FIFO → lowest free slot).
+    (run,) = s.admit()
+    assert run.request.request_id == 4 and run.slot == 0
+    # A second sweep is a no-op: expiry must be idempotent.
+    assert s.expire(now=3.0) == []
+
+
+def test_expired_request_never_admits():
+    """An expired waiting request must be evicted by the sweep, not handed
+    a slot afterwards."""
+    s = Scheduler(num_slots=1, max_seq=64)
+    s.submit(GenRequest(1, [1, 2], 8, deadline=1.0))
+    assert [r.request_id for _, r in s.expire(now=5.0)] == [1]
+    assert s.admit() == []
+    assert s.idle
+
+
 def test_many_requests_through_few_slots():
     """Simulated drain: 20 requests through 4 slots, random-ish lengths."""
     s = Scheduler(4, 64)

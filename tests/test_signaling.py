@@ -9,6 +9,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("websockets")  # optional dep: skip (not fail) where absent
+
 from p2p_llm_tunnel_tpu.signaling import SignalServer, SignalingClient
 from p2p_llm_tunnel_tpu.signaling.client import (
     Answer,

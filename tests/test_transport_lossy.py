@@ -18,6 +18,8 @@ import time
 
 import pytest
 
+pytest.importorskip("cryptography")  # optional dep: skip (not fail) where absent
+
 from p2p_llm_tunnel_tpu.transport.crypto import HandshakeKeys
 from p2p_llm_tunnel_tpu.transport.arq import CWND_INIT
 from p2p_llm_tunnel_tpu.transport.udp import WINDOW, UdpChannel

@@ -8,6 +8,8 @@ import asyncio
 
 import pytest
 
+pytest.importorskip("cryptography")  # optional dep: skip (not fail) where absent
+
 from p2p_llm_tunnel_tpu.signaling import SignalServer
 from p2p_llm_tunnel_tpu.transport import ChannelClosed, connect
 from p2p_llm_tunnel_tpu.transport.crypto import CryptoError, HandshakeKeys, SecureBox
