@@ -109,11 +109,17 @@ def build_parser() -> argparse.ArgumentParser:
                        help="tensor-parallel degree over the device mesh")
     serve.add_argument("--ckpt", default=_env("TUNNEL_CKPT"),
                        help="orbax checkpoint path (default: random init)")
-    serve.add_argument("--quant", choices=("none", "int8", "w8a8"),
+    serve.add_argument("--quant", choices=("none", "int8", "w8a8", "int4"),
                        default=_env("TUNNEL_QUANT", "none"),
                        help="weight quantization: int8 halves decode HBM "
                             "traffic; w8a8 also quantizes activations "
-                            "(int8 MXU dots)")
+                            "(int8 MXU dots); int4 packs two weights per "
+                            "byte with per-group scales, halving the "
+                            "weight stream again")
+    serve.add_argument("--quant-group-size", type=int,
+                       default=int(_env("TUNNEL_QUANT_GROUP_SIZE", "128")),
+                       help="int4 scale group size (contracted positions "
+                            "per f32 scale; must be even)")
     serve.add_argument("--kv-quant", choices=("none", "int8"),
                        default=_env("TUNNEL_KV_QUANT", "none"),
                        help="KV-cache quantization (halves the long-context "
@@ -393,6 +399,7 @@ async def _engine_backend(args):
                     ep=args.ep,
                     ckpt_path=args.ckpt,
                     quant=args.quant,
+                    quant_group_size=args.quant_group_size,
                     kv_quant=args.kv_quant,
                     prefill_act_quant=args.prefill_act_quant,
                     flash_decode=args.flash_decode,

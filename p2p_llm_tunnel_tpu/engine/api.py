@@ -819,10 +819,18 @@ class EngineAPI:
         if method == "POST" and path == "/api/show":
             # Minimal Ollama model-info surface (clients probe it before
             # chatting); architecture details come from the model config.
+            # quantization_level follows Ollama's naming (Q4_0/Q8_0/F16 for
+            # our int4/int8-family/bf16) so clients that branch on it —
+            # context sizing, capability probes — see the served reality.
             m = self.engine.mcfg
+            quant = self.engine.ecfg.quant
+            qlevel = {"int4": "Q4_0", "int8": "Q8_0", "w8a8": "Q8_0"}.get(
+                quant, "F16"
+            )
             return _json_response(200, {
                 "modelfile": "",
-                "details": {"family": m.name, "parameter_size": ""},
+                "details": {"family": m.name, "parameter_size": "",
+                            "quantization_level": qlevel},
                 "model_info": {
                     "general.architecture": m.name,
                     "num_layers": m.n_layers,

@@ -240,7 +240,29 @@ CONVERTERS = {
 
 
 def convert_hf(family: str, state: Mapping[str, Any], cfg: ModelConfig,
-               dtype=jnp.bfloat16) -> Params:
+               dtype=jnp.bfloat16, quant: str = "none",
+               group_size: int = 128) -> Params:
+    """HF state dict → stacked pytree, optionally quantized on the way in.
+
+    ``quant`` applies weight quantization to the converted tree before it
+    is returned: "int8" (per-channel, models/quant.py quantize_params) or
+    "int4" (packed two-per-byte with per-``group_size`` scales,
+    quantize_params_int4) — so callers loading a big checkpoint can drop
+    the bf16 tree immediately instead of holding both resident.  The
+    engine quantizes injected bf16 trees itself; this path exists for
+    loaders that want the quantized form as the artifact.
+    """
     if family not in CONVERTERS:
         raise KeyError(f"unknown family {family!r}; have {sorted(CONVERTERS)}")
-    return CONVERTERS[family](state, cfg, dtype)
+    params = CONVERTERS[family](state, cfg, dtype)
+    if quant == "int8":
+        from p2p_llm_tunnel_tpu.models.quant import quantize_params
+
+        return quantize_params(params)
+    if quant == "int4":
+        from p2p_llm_tunnel_tpu.models.quant import quantize_params_int4
+
+        return quantize_params_int4(params, group_size=group_size)
+    if quant not in ("none", ""):
+        raise ValueError(f"unknown quant mode {quant!r}")
+    return params

@@ -179,6 +179,9 @@ def _layer_window(cfg: ModelConfig, layer_idx, seq_len):
 
 def _embed(cfg: ModelConfig, params, tokens):
     embed = params["embed"]
+    # Quantized tables (QTensor int8, QTensor4 packed int4 — both expose
+    # .q as int8 storage) dequantize into bf16 activations; every
+    # projection/head matmul downstream follows x's dtype (quant.mm).
     dtype = embed.q.dtype if hasattr(embed, "q") else embed.dtype
     if dtype == jnp.int8:
         dtype = jnp.bfloat16

@@ -15,6 +15,11 @@ variants share the online-softmax math:
   skips the re-fetch of an unchanged block, so blocks past the frontier
   cost neither DMA nor compute (`pl.when`).  VMEM per program is
   ~2·BLOCK_S·D·4B regardless of view — no view cap, arbitrary max_seq.
+  The s-grid kernel serves THREE KV precisions through one body
+  (``kv_quant``): raw bf16/f32, int8 + per-(token, head) scales, and
+  packed int4 (two adjacent tokens per byte along the sequence axis) —
+  each quantized form dequantizes in VMEM right after its (halved /
+  quartered) DMA.
 
 Fuses score, mask, softmax, and value matmuls into one kernel where the
 einsum path (ops/attention.py cached_attention) lowers to several — fewer
@@ -173,24 +178,30 @@ def _decode_kernel_sgrid(
     pos_sref,  # scalar-prefetch [B] int32: per-slot query position
     win_sref,  # scalar-prefetch [1] int32: sliding window (S+1 = disabled)
     q_ref,  # [G, D] this (slot, kv-head)'s query group
-    k_ref,  # [BS, D] ONE s-block of this head's keys (bf16/f32 or int8)
-    v_ref,  # [BS, D]
-    *rest,  # quantized: (ks_ref [BS,1], vs_ref [BS,1], o, m, l, acc)
-    #         else:      (o, m, l, acc)
+    k_ref,  # [BS, D] ONE s-block of keys (bf16/f32 or int8), or [BS/2, D]
+    #         packed int4 bytes (kv_quant="int4": adjacent tokens share a
+    #         byte — low nibble = token 2i, high = 2i+1)
+    v_ref,  # same layout as k_ref
+    *rest,  # kv_quant: (ks_ref [BS,1], vs_ref [BS,1], o, m, l, acc)
+    #         else:     (o, m, l, acc)
     scale: float,
     softcap: Optional[float],
     block_s: int,
     n_sblocks: int,
     out_dtype,
-    quantized: bool,
+    kv_quant: Optional[str],
 ):
-    """ONE kernel for both the raw and int8-KV s-gridded variants — the
-    online-softmax/masking/frontier logic must never diverge between them.
-    ``quantized`` is a static python flag: the int8 path gets two extra
-    per-(token, head) scale refs and dequantizes in VMEM right after the
-    int8 DMA, composing kv_quant=int8's halved HBM traffic with the fused
-    kernel (pre-r5 the engine forced the einsum path for int8 KV)."""
-    if quantized:
+    """ONE kernel for the raw, int8-KV, and packed-int4-KV s-gridded
+    variants — the online-softmax/masking/frontier logic must never
+    diverge between them.  ``kv_quant`` is a static python flag
+    (None | "int8" | "int4"): quantized paths get two extra per-(token,
+    head) scale refs and dequantize in VMEM right after the DMA, composing
+    the cut HBM traffic with the fused kernel (pre-r5 the engine forced
+    the einsum path for int8 KV).  int4 additionally unpacks two nibbles
+    per byte along the SEQUENCE axis (the lane axis stays D-wide, so TPU
+    tiling is unaffected) — the weight-quant lesson applied to KV: packed
+    bytes cross HBM, the wide copy exists only in VMEM."""
+    if kv_quant is not None:
         ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
     else:
         o_ref, m_sc, l_sc, acc_sc = rest
@@ -209,12 +220,23 @@ def _decode_kernel_sgrid(
         l_sc[:] = jnp.zeros_like(l_sc[:])
         acc_sc[:] = jnp.zeros_like(acc_sc[:])
 
+    def _unpack_seq(p):
+        # [BS/2, D] bytes -> [BS, D] int8 values in [-8, 7]: token 2i from
+        # the sign-extended low nibble, 2i+1 from the arithmetic high shift.
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        return jnp.stack([lo, hi], axis=1).reshape(2 * p.shape[0], p.shape[1])
+
     @pl.when(sj <= frontier)
     def _compute():
         q = q_ref[:].astype(jnp.float32) * scale
-        k = k_ref[:].astype(jnp.float32)  # [BS, D]
-        v = v_ref[:].astype(jnp.float32)
-        if quantized:
+        if kv_quant == "int4":
+            k = _unpack_seq(k_ref[:]).astype(jnp.float32)  # [BS, D]
+            v = _unpack_seq(v_ref[:]).astype(jnp.float32)
+        else:
+            k = k_ref[:].astype(jnp.float32)  # [BS, D]
+            v = v_ref[:].astype(jnp.float32)
+        if kv_quant is not None:
             k = k * ks_ref[:]
             v = v * vs_ref[:]
         s = jax.lax.dot_general(
@@ -257,8 +279,9 @@ def flash_decode_attention_sgrid(
     v_cache: jnp.ndarray,  # [B, S, K, D]
     q_positions: jnp.ndarray,  # [B] int32
     *,
-    k_scale: Optional[jnp.ndarray] = None,  # [B, S, K] f32 (int8 cache)
+    k_scale: Optional[jnp.ndarray] = None,  # [B, S, K] f32 (quantized cache)
     v_scale: Optional[jnp.ndarray] = None,
+    kv_quant: Optional[str] = None,  # None | "int8" | "int4"
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window=None,  # None | int | traced int scalar
@@ -272,13 +295,21 @@ def flash_decode_attention_sgrid(
     past the slot's frontier resolve to the SAME block index as the
     frontier (scalar-prefetch clamp), so Pallas elides their fetch; their
     compute is skipped with `pl.when`.  With ``k_scale``/``v_scale`` the
-    cache is int8 and dequantized in VMEM.
+    cache is quantized and dequantized in VMEM: ``kv_quant="int8"`` reads
+    [B, S, K, D] int8 planes, ``"int4"`` reads [B, S/2, K, D] bytes with
+    two adjacent tokens packed per byte (pack with
+    models.quant.pack_int4(axis=1)).
     """
     b, t, h, d = q.shape
     assert t == 1, "decode step processes exactly one token per slot"
     quantized = k_scale is not None
     assert (v_scale is not None) == quantized
-    s = k_cache.shape[1]
+    if kv_quant is None and quantized:
+        kv_quant = "int8"
+    if (kv_quant is not None) != quantized:
+        raise ValueError("kv_quant requires k_scale/v_scale and vice versa")
+    # Logical sequence length: the int4 cache's s-axis is byte-packed.
+    s = k_cache.shape[1] * (2 if kv_quant == "int4" else 1)
     kh = k_cache.shape[2]
     g = h // kh
     if scale is None:
@@ -307,21 +338,24 @@ def flash_decode_attention_sgrid(
         block_s=bs,
         n_sblocks=n_sb,
         out_dtype=q.dtype,
-        quantized=quantized,
+        kv_quant=kv_quant,
     )
 
     def kv_index(bi, ki, sj, pos_r, win_r):
         # Clamp past-frontier steps to the frontier block: same index as
-        # the previous step -> Pallas skips the DMA.
+        # the previous step -> Pallas skips the DMA.  Block indices are in
+        # block units, so the same map serves the packed int4 axis (block
+        # bs/2 of a S/2-length axis) and the full-width layouts.
         return (bi, jnp.minimum(sj, pos_r[bi] // bs), ki, 0)
 
+    kv_rows = bs // 2 if kv_quant == "int4" else bs
     in_specs = [
         pl.BlockSpec(
             (None, None, g, d),
             lambda bi, ki, sj, pos_r, win_r: (bi, ki, 0, 0),
         ),
-        pl.BlockSpec((None, bs, None, d), kv_index),
-        pl.BlockSpec((None, bs, None, d), kv_index),
+        pl.BlockSpec((None, kv_rows, None, d), kv_index),
+        pl.BlockSpec((None, kv_rows, None, d), kv_index),
     ]
     operands = [pos, win, q_g, k_cache, v_cache]
     if quantized:
@@ -368,5 +402,26 @@ def flash_decode_attention_sgrid_int8(
     """int8-KV convenience entry: delegates to the shared s-grid kernel."""
     return flash_decode_attention_sgrid(
         q, k_cache, v_cache, q_positions,
-        k_scale=k_scale, v_scale=v_scale, **kwargs,
+        k_scale=k_scale, v_scale=v_scale, kv_quant="int8", **kwargs,
+    )
+
+
+def flash_decode_attention_sgrid_int4(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,  # [B, S/2, K, D] int8: two tokens packed per byte
+    v_cache: jnp.ndarray,
+    k_scale: jnp.ndarray,  # [B, S, K] f32 per-(token, head)
+    v_scale: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+    """Packed-int4-KV entry: delegates to the shared s-grid kernel, which
+    unpacks the sequence-axis byte pairs in VMEM (models.quant.pack_int4
+    with axis=1 produces the expected layout).  The int4 analog of the
+    int8 variant — the kernel family covers every weight/KV precision the
+    engine serves, dequantizing after the DMA so only packed bytes cross
+    HBM.  Oracle-pinned in interpret mode (tests/test_quant_int4.py)."""
+    return flash_decode_attention_sgrid(
+        q, k_cache, v_cache, q_positions,
+        k_scale=k_scale, v_scale=v_scale, kv_quant="int4", **kwargs,
     )

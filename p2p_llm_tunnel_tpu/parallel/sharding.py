@@ -22,7 +22,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_llm_tunnel_tpu.models.config import ModelConfig
-from p2p_llm_tunnel_tpu.models.quant import QTensor
+from p2p_llm_tunnel_tpu.models.quant import QTensor, QTensor4
 
 Pytree = Any
 
@@ -47,6 +47,17 @@ def _qspec(weight_spec: P, name: str) -> QTensor:
     return QTensor(q=weight_spec, scale=scale_spec)
 
 
+def _qspec4(weight_spec: P, leaf: "QTensor4") -> "QTensor4":
+    """Spec pair for a packed-int4 leaf: ``q`` keeps the weight's axis
+    layout (packing halves the contracted axis's LENGTH, not its position)
+    and ``scale`` has the SAME RANK as the weight (contracted axis ->
+    group axis), so both take the weight spec verbatim."""
+    return QTensor4(
+        q=weight_spec, scale=weight_spec,
+        in_dim=leaf.in_dim, group_size=leaf.group_size, axis=leaf.axis,
+    )
+
+
 def param_pspecs(
     cfg: ModelConfig, params: Optional[Pytree] = None
 ) -> Dict[str, Any]:
@@ -59,6 +70,8 @@ def param_pspecs(
     def maybe_q(name: str, spec: P, leaf) -> Any:
         if leaf is not None and isinstance(leaf, QTensor):
             return _qspec(spec, name)
+        if leaf is not None and isinstance(leaf, QTensor4):
+            return _qspec4(spec, leaf)
         return spec
 
     pblocks = params["blocks"] if params is not None else {}

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Golden-logits fixture generator: an INDEPENDENT numpy reference forward.
+
+VERDICT r5 asked for an external numerics anchor: every model-math oracle
+in the suite so far was written against the same JAX code it validates, so
+a conventions bug (rope layout, GQA grouping, norm epsilon placement)
+would pin itself green.  This script re-implements the llama-family
+forward pass from scratch in float64 numpy — no imports from
+p2p_llm_tunnel_tpu.models or ops — over the SAME synthetic weights
+tests/test_hf_synth.py serves (scripts/make_synth_hf_ckpt.fake_llama_state,
+seed 0), and commits the resulting logits as tests/golden/
+synth_llama_logits.npz.
+
+tests/test_golden_logits.py then pins the repo's bf16/int8/int4 forwards
+against this fixture with per-format tolerances.  Regenerate ONLY when the
+model conventions intentionally change:
+
+    python scripts/make_golden_logits.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from make_synth_hf_ckpt import fake_llama_state  # noqa: E402
+
+#: Model shape — matches scripts/make_synth_hf_ckpt.py except the vocab,
+#: which is pinned (the ckpt generator's vocab depends on tokenizer
+#: training; the fixture must not).
+VOCAB = 512
+DIM = 128
+LAYERS = 2
+HEADS = 4
+KV_HEADS = 2
+HEAD_DIM = 48
+FFN = 256
+ROPE_THETA = 10000.0
+NORM_EPS = 1e-5
+SEED = 0
+T = 24  # prompt length
+
+
+def rms_norm(x, w, eps):
+    rms = np.sqrt(np.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x / rms) * w
+
+
+def rope(x, positions, theta):
+    """Rotate-half convention: split head_dim in two contiguous halves."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    ang = positions[:, None] * freqs  # [T, d/2]
+    sin, cos = np.sin(ang), np.cos(ang)
+    sin = sin[:, None, :]  # broadcast over heads
+    cos = cos[:, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    return np.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def silu(x):
+    return x / (1.0 + np.exp(-x))
+
+
+def forward(state, tokens):
+    """Causal forward over one unpadded prompt; returns [T, V] logits."""
+    x = state["model.embed_tokens.weight"][tokens].astype(np.float64)
+    positions = np.arange(len(tokens), dtype=np.float64)
+    g = HEADS // KV_HEADS
+    for i in range(LAYERS):
+        p = f"model.layers.{i}"
+        h = rms_norm(x, state[f"{p}.input_layernorm.weight"], NORM_EPS)
+        # HF stores [out, in]; activations row-vectors -> h @ W.T
+        q = (h @ state[f"{p}.self_attn.q_proj.weight"].T).reshape(
+            T, HEADS, HEAD_DIM
+        )
+        k = (h @ state[f"{p}.self_attn.k_proj.weight"].T).reshape(
+            T, KV_HEADS, HEAD_DIM
+        )
+        v = (h @ state[f"{p}.self_attn.v_proj.weight"].T).reshape(
+            T, KV_HEADS, HEAD_DIM
+        )
+        q = rope(q, positions, ROPE_THETA)
+        k = rope(k, positions, ROPE_THETA)
+        # GQA: each kv head serves g query heads.
+        k = np.repeat(k, g, axis=1)  # [T, H, D]
+        v = np.repeat(v, g, axis=1)
+        scores = np.einsum("thd,shd->hts", q, k) * HEAD_DIM**-0.5
+        mask = np.tril(np.ones((T, T), bool))
+        scores = np.where(mask[None], scores, -1e30)
+        attn = np.einsum("hts,shd->thd", softmax(scores), v)
+        attn = attn.reshape(T, HEADS * HEAD_DIM)
+        x = x + attn @ state[f"{p}.self_attn.o_proj.weight"].T
+        h = rms_norm(
+            x, state[f"{p}.post_attention_layernorm.weight"], NORM_EPS
+        )
+        gate = silu(h @ state[f"{p}.mlp.gate_proj.weight"].T)
+        up = h @ state[f"{p}.mlp.up_proj.weight"].T
+        x = x + (gate * up) @ state[f"{p}.mlp.down_proj.weight"].T
+    x = rms_norm(x, state["model.norm.weight"], NORM_EPS)
+    return x @ state["lm_head.weight"].T
+
+
+def main(out_path: str) -> None:
+    import types
+
+    shape = types.SimpleNamespace(
+        vocab_size=VOCAB, dim=DIM, n_layers=LAYERS, n_heads=HEADS,
+        n_kv_heads=KV_HEADS, head_dim=HEAD_DIM, ffn_dim=FFN,
+    )
+    state = {
+        k: v.astype(np.float64)
+        for k, v in fake_llama_state(shape, SEED).items()
+    }
+    tokens = np.random.default_rng(123).integers(0, VOCAB, T)
+    logits = forward(state, tokens)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    np.savez(
+        out_path,
+        tokens=tokens.astype(np.int32),
+        logits=logits.astype(np.float32),
+        meta=np.array([VOCAB, DIM, LAYERS, HEADS, KV_HEADS, HEAD_DIM, FFN,
+                       SEED], np.int64),
+    )
+    print(
+        f"wrote {out_path}: logits {logits.shape}, "
+        f"|logits| mean {np.abs(logits).mean():.4f} "
+        f"max {np.abs(logits).max():.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main(
+        sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests", "golden", "synth_llama_logits.npz",
+        )
+    )

@@ -9,9 +9,15 @@ Measures, on the real chip and without the tunnel stack:
   compiled burst, to verify where HBM traffic goes (VERDICT r3 item 1:
   is the int8 dequant materializing a bf16 weight copy?)
 
-Env knobs: PP_MODEL, PP_QUANT (int8|w8a8|none), PP_SLOTS, PP_STEPS,
-PP_MAX_SEQ, PP_ITERS, PP_POS (starting cache position), PP_PIPELINE=1
-(dispatch burst n before fetching n-1, like the engine loop).
+Env knobs: PP_MODEL, PP_QUANT (int8|w8a8|int4|none), PP_GROUP (int4 scale
+group size, default 128), PP_SLOTS, PP_STEPS, PP_MAX_SEQ, PP_ITERS,
+PP_POS (starting cache position), PP_PIPELINE=1 (dispatch burst n before
+fetching n-1, like the engine loop).
+
+The int4 acceptance probe (ISSUE 2): with PP_QUANT=int4 on the 8B shape
+the cost analysis must report ≤ 4.5 GB HBM bytes-accessed/step (vs ~7.85
+GB for int8) — i.e. XLA reads PACKED bytes from HBM and never
+materializes the bf16 weight copy.
 """
 
 from __future__ import annotations
@@ -46,6 +52,7 @@ def main() -> None:
     pos0 = int(os.environ.get("PP_POS", "32"))
     pipeline = os.environ.get("PP_PIPELINE", "1") == "1"
     kv_view = int(os.environ.get("PP_VIEW", str(max_seq)))
+    group = int(os.environ.get("PP_GROUP", "128"))
 
     from p2p_llm_tunnel_tpu.engine import sampling
     from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
@@ -61,7 +68,7 @@ def main() -> None:
     eng = InferenceEngine(
         engine_cfg=EngineConfig(
             model=model, num_slots=slots, max_seq=max_seq,
-            decode_steps=steps, quant=quant,
+            decode_steps=steps, quant=quant, quant_group_size=group,
         ),
         tokenizer=ByteTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -70,6 +77,8 @@ def main() -> None:
     print(f"init: {t_init:.1f}s", file=sys.stderr, flush=True)
 
     rows = slots + 1
+    # Mirrors engine._warm_samp exactly (same dtypes incl. seed/bias_on)
+    # so the probed program hashes identically to the served one.
     samp = sampling.SamplingParams(
         temperature=jnp.zeros((rows,), jnp.float32),
         top_k=jnp.zeros((rows,), jnp.int32),
@@ -77,21 +86,38 @@ def main() -> None:
         freq_pen=jnp.zeros((rows,), jnp.float32),
         pres_pen=jnp.zeros((rows,), jnp.float32),
         logprobs=jnp.zeros((rows,), jnp.int32),
+        seed=jnp.zeros((rows,), jnp.uint32),
+        bias_on=jnp.zeros((rows,), bool),
     )
     tokens = jnp.full((rows,), 5, jnp.int32)
     positions = jnp.full((rows,), pos0, jnp.int32)
     counts = jnp.zeros((rows, eng.mcfg.vocab_size), jnp.int32)
+    bias = jnp.zeros((rows, eng.mcfg.vocab_size), jnp.float32)
     ovm = jnp.zeros((rows,), bool)
     ovt = jnp.full((rows,), 5, jnp.int32)
     ovp = jnp.full((rows,), pos0, jnp.int32)
     key = jax.random.PRNGKey(0)
 
+    # Expected weight stream per decode step (every leaf read once):
+    # packed q/scale bytes summed over the param tree.  The cost-analysis
+    # "bytes accessed" below must be in this ballpark × steps (+ KV terms);
+    # a ~3x overshoot means XLA materialized a dequantized weight copy
+    # (the r3 int8 suspicion — fusion must keep reads at the packed size).
+    weight_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(eng.params)
+    )
+    print(
+        f"param bytes (read once per step): {weight_bytes / 1e9:.2f} GB",
+        file=sys.stderr, flush=True,
+    )
+
     # Cost/memory analysis of the burst program (non-donating lower to keep
     # the analysis side-effect-free).
     try:
-        lowered = jax.jit(eng._decode_fn, static_argnums=(10, 11)).lower(
-            eng.params, eng.kv_cache, tokens, positions, counts, ovm, ovt,
-            ovp, samp, key, kv_view, steps,
+        lowered = jax.jit(eng._decode_fn, static_argnums=(11, 12)).lower(
+            eng.params, eng.kv_cache, tokens, positions, counts, bias, ovm,
+            ovt, ovp, samp, key, kv_view, steps,
         )
         compiled = lowered.compile()
         ca = compiled.cost_analysis()
@@ -120,8 +146,8 @@ def main() -> None:
 
     t0 = time.monotonic()
     out = eng._jit_decode(
-        eng.params, eng.kv_cache, tokens, positions, counts, ovm, ovt, ovp,
-        samp, key, kv_view, steps,
+        eng.params, eng.kv_cache, tokens, positions, counts, bias, ovm, ovt,
+        ovp, samp, key, kv_view, steps,
     )
     jax.block_until_ready(out)
     t_compile = time.monotonic() - t0
@@ -135,8 +161,9 @@ def main() -> None:
             t0 = time.monotonic()
             if i < iters:
                 cur = eng._jit_decode(
-                    eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
-                    samp, jax.random.fold_in(key, i), kv_view, steps,
+                    eng.params, kv, tokens, positions, counts, bias, ovm,
+                    ovt, ovp, samp, jax.random.fold_in(key, i), kv_view,
+                    steps,
                 )
                 sampled, _lp, tokens, positions, counts, kv = cur
             if in_flight is not None:
@@ -147,8 +174,8 @@ def main() -> None:
         for i in range(iters):
             t0 = time.monotonic()
             sampled, _lp, tokens, positions, counts, kv = eng._jit_decode(
-                eng.params, kv, tokens, positions, counts, ovm, ovt, ovp,
-                samp, jax.random.fold_in(key, i), kv_view, steps,
+                eng.params, kv, tokens, positions, counts, bias, ovm, ovt,
+                ovp, samp, jax.random.fold_in(key, i), kv_view, steps,
             )
             np.asarray(jax.device_get(sampled))
             times.append(time.monotonic() - t0)
@@ -159,6 +186,7 @@ def main() -> None:
     tok_s = slots * steps / med
     result = {
         "model": model, "quant": quant, "slots": slots, "steps": steps,
+        "param_gb": round(weight_bytes / 1e9, 2),
         "max_seq": max_seq, "kv_view": kv_view, "init_s": round(t_init, 1),
         "compile_s": round(t_compile, 1),
         "burst_ms_median": round(med * 1000.0, 1),

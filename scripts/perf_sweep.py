@@ -58,8 +58,18 @@ GRID = [
     # cached config banks the round's key datapoint before any compile
     # gamble, in ~2 min of a ~7 min window.
     ("base-32x16-v2", {}),
-    # pfx-off IMMEDIATELY after base: it needs ZERO fresh compiles beyond
-    # base's program set (same decode variants, plain prefill only — the
+    # int4 weights at the base shape: the dominant decode HBM term halved
+    # again (~8.05 -> ~4.2 GB/step of weights; decode floor ~9.6 -> ~5
+    # ms/step — PERF.md "int4 roofline").  Fresh DECODE programs only:
+    # prefill/chunk/copy widths are shared with base, so with base banked
+    # this is a handful of ~20 s compiles, all persisted for later rows.
+    ("int4", {"BENCH_QUANT": "int4"}),
+    # int4 weights + int8 KV + in-kernel dequant: every decode HBM lever
+    # composed in one program set — the projected-best per-step config.
+    ("int4-kv8-sgrid", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int8",
+                        "BENCH_FLASH_SGRID": "1"}),
+    # pfx-off right after: it needs ZERO fresh compiles beyond base's
+    # program set (same decode variants, plain prefill only — the
     # copy/chunk programs it skips are extra, not different), so with base
     # banked this row costs ~2 min and completes the r4-requested
     # prefix-cache ablation even in a short window.
@@ -68,6 +78,17 @@ GRID = [
     # levers, directly comparable to base-v2.  Fresh decode programs only
     # (prefill/chunk/copy shared with base).
     ("kv8-sgrid", {"BENCH_KV_QUANT": "int8", "BENCH_FLASH_SGRID": "1"}),
+    # 64-slot end-to-end (PERF.md next-lever #1): the probe's 3190 tok/s
+    # upper bound has never been benched through the tunnel, and the <400
+    # ms TTFT bar must be re-validated under a 64-client admission herd.
+    ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
+    # The composed throughput shot: int4 weights + int8 KV + s-grid at 64
+    # slots — if the weight stream really halves, this is where ≥1800
+    # tok/s should first appear.
+    ("int4-64x24", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int8",
+                    "BENCH_FLASH_SGRID": "1", "BENCH_SLOTS": "64",
+                    "BENCH_CLIENTS": "64", "BENCH_DECODE_STEPS": "24",
+                    "SWEEP_DEADLINE_S": "900"}),
     # Joint-target variant: 48 slots raise the decode ceiling without the
     # 64-wide admission herd that blows the <400 ms TTFT bar.  All-fresh
     # programs: compiles alone can eat the default 420 s on this 1-core
@@ -84,7 +105,6 @@ GRID = [
                     "BENCH_DECODE_STEPS": "32", "BENCH_KV_QUANT": "int8",
                     "BENCH_FLASH_SGRID": "1",
                     "SWEEP_DEADLINE_S": "900"}),
-    ("slots64", {"BENCH_SLOTS": "64", "BENCH_CLIENTS": "64"}),
     ("steps32", {"BENCH_DECODE_STEPS": "32"}),
     ("flash-sgrid", {"BENCH_FLASH_SGRID": "1"}),
     ("slots48", {"BENCH_SLOTS": "48", "BENCH_CLIENTS": "48"}),

@@ -523,6 +523,54 @@ def test_prefix_pool_survives_engine_restart(tmp_path):
     assert hits_c == 0
 
 
+def test_prefix_pool_snapshot_rejects_quant_format_mismatch(tmp_path):
+    """ISSUE 2 satellite: a snapshot taken under one weight/KV format or
+    int4 group size is REJECTED (not silently reloaded) by an engine
+    running another — the cached KV bytes were computed by differently-
+    quantized weights, so serving them would be another model's KV."""
+    prompt = list(b"You are a helpful assistant. Please answer: what?")
+    snap = str(tmp_path / "pfx")
+
+    def cfg(**over):
+        base = dict(
+            model="tiny", num_slots=4, max_seq=128, dtype="float32",
+            min_prefill_bucket=16, prefix_cache=True,
+            prefix_pool_blocks=16, prefix_cache_dir=snap,
+        )
+        base.update(over)
+        return EngineConfig(**base)
+
+    async def serve_once(ecfg):
+        eng = InferenceEngine(engine_cfg=ecfg)
+        meta = eng._prefix_snapshot_meta()
+        await eng.start()
+        out = []
+        async for ev in eng.generate(prompt, max_new_tokens=4, stop_ids=()):
+            out.append(ev.token_id)
+        hits = eng._prefix.hits
+        await eng.stop()  # saves the snapshot under this engine's meta
+        return hits, meta
+
+    hits, meta = asyncio.run(serve_once(cfg()))
+    assert hits == 0  # cold pool
+    # Every quantization pin must be in the manifest.
+    for key in ("quant", "kv_quant", "group_size"):
+        assert key in meta
+    # Same config -> snapshot accepted (the control).
+    hits, _ = asyncio.run(serve_once(cfg()))
+    assert hits >= 1
+    # A different int4 group size alone must reject the snapshot: weights
+    # identical here (quant=none), so a hit would prove it reloaded.
+    hits, meta2 = asyncio.run(serve_once(cfg(quant_group_size=64)))
+    assert meta2["group_size"] == 64
+    assert hits == 0
+    # A different KV format must reject it too (the bytes aren't even the
+    # same dtype); int8-KV engine vs the fp32 snapshot just saved.
+    hits, _ = asyncio.run(serve_once(cfg(quant_group_size=64,
+                                         kv_quant="int8")))
+    assert hits == 0
+
+
 def test_engine_prefix_shared_prefix_different_tails():
     """Distinct requests sharing a long prefix: every request's output must
     match its own no-cache run."""
