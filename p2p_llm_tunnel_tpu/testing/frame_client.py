@@ -24,6 +24,9 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     TunnelMessage,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 
 @dataclass
@@ -107,6 +110,10 @@ class FrameClient:
                 s.error_code = msg.error_code()
             elif msg.msg_type == MessageType.RES_END:
                 s.ended.set()
+            else:
+                # Request-direction and handshake frames are never addressed
+                # to a client; dropping them silently here is deliberate.
+                log.debug("frame client ignoring %s", msg.msg_type.name)
 
     async def request(
         self,

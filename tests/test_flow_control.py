@@ -123,6 +123,8 @@ async def _collect_body(peer_ch, deadline: float):
                 got += len(msg.payload)
             elif msg.msg_type == MessageType.RES_END and msg.stream_id == 1:
                 break
+            else:
+                continue  # headers/pings are irrelevant to the byte count
     return got
 
 
