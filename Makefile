@@ -1,17 +1,20 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native native-san test test-unit test-fast test-local test-race chaos bench serve proxy signal multichip
+.PHONY: native native-san lint test test-unit test-fast test-local test-race chaos bench serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
+
+lint:              ## tunnelcheck: static invariants (async-blocking, jit drift, ...)
+	python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests bench.py __graft_entry__.py
 
 native-san:        ## ASan+UBSan self-tests of the C++ codec + ARQ core
 	scripts/build-native.sh sanitize
 	native/build/tunnel_frames_test
 	native/build/tunnel_arq_test
 
-test: test-unit test-local
+test: lint test-unit test-local
 
 test-unit:         ## full pytest suite on the virtual CPU mesh
 	python -m pytest tests/ -q
@@ -24,7 +27,9 @@ test-local:        ## hermetic 4-process end-to-end over real sockets
 
 # A2's TSan-equivalent CI job: asyncio debug mode surfaces never-awaited
 # coroutines, non-threadsafe loop calls, and >100ms callback stalls; the -W
-# flag turns the resulting RuntimeWarnings into test failures.
+# flag turns the resulting RuntimeWarnings into test failures.  `make lint`
+# (tunnelcheck TC01) is the static counterpart: it rejects blocking calls
+# inside async def before they ever reach this runtime job.
 test-race:         ## concurrency suites under asyncio debug mode + native sanitizers
 	-$(MAKE) native-san  # best-effort: no C++ toolchain must not block the Python suites
 	PYTHONASYNCIODEBUG=1 python -W error::RuntimeWarning -m pytest \

@@ -51,6 +51,19 @@ INITIAL_CREDIT = 256 * 1024
 #: Proxy grants more credit once it has relayed this many bytes.
 CREDIT_BATCH = 64 * 1024
 
+#: Registry of machine-readable ``[code]`` prefixes for typed ERROR frames
+#: (:meth:`TunnelMessage.typed_error` / :meth:`TunnelMessage.error_code`).
+#: Peers dispatch on these strings, so the vocabulary is a wire contract:
+#: new codes must be added here, never minted inline — enforced statically
+#: by tunnelcheck rule TC05 (typed_error literals and ``tunnel_code`` class
+#: attributes both).
+#:
+#:   timeout  — the request blew its x-tunnel-deadline-ms budget
+#:   busy     — shed by admission control (scheduler queue or max_inflight)
+#:   draining — server is draining; retry against another peer
+#:   upstream — the backend failed mid-stream
+ERROR_CODES = frozenset({"timeout", "busy", "draining", "upstream"})
+
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE
 
 

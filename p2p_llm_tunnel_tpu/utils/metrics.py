@@ -13,6 +13,47 @@ import time
 from collections import defaultdict
 from typing import Dict, List
 
+#: The one catalogue of legal metric names.  Every literal string handed to
+#: ``Metrics.inc``/``set_gauge``/``observe`` (and the read-side ``counter``/
+#: ``gauge``/``percentile``/``rate``, which /healthz and bench.py use) must
+#: appear here — enforced statically by tunnelcheck rule TC06, so a typo'd
+#: name can't silently split a time series.  ``snapshot()`` derives
+#: ``<hist>_p50``/``_p95``/``_count`` suffixes from histogram names; those
+#: derived keys are intentionally not catalogued.
+METRICS_CATALOG: Dict[str, str] = {
+    # -- engine ----------------------------------------------------------
+    "engine_tokens_total": "decode tokens emitted to streams (counter)",
+    "engine_prefill_tokens_total": "prompt tokens prefilled (counter)",
+    "engine_prefill_segments_total": "chunked-prefill segments executed (counter)",
+    "engine_spec_tokens_total": "tokens emitted via speculative decode (counter)",
+    "engine_spec_accepted_tokens_total": "draft tokens accepted by verify (counter)",
+    "engine_prefix_hit_tokens_total": "prompt tokens served from prefix cache (counter)",
+    "engine_prefix_saved_blocks_total": "KV blocks saved into prefix cache (counter)",
+    "engine_deadline_timeouts_total": "requests evicted at their deadline (counter)",
+    "engine_watchdog_stalls_total": "decode-stall watchdog trips (counter)",
+    "engine_queue_depth": "requests waiting for a slot (gauge)",
+    "engine_batch_occupancy": "fraction of decode slots occupied (gauge)",
+    "engine_degraded": "1 while the decode watchdog deems the engine stalled (gauge)",
+    "engine_ttft_ms": "time to first token per request (histogram, ms)",
+    "engine_prefill_ms": "prefill step latency (histogram, ms)",
+    "engine_decode_fetch_ms": "device->host fetch of a sampled block (histogram, ms)",
+    # -- serve endpoint --------------------------------------------------
+    "serve_requests_total": "tunneled requests dispatched to the backend (counter)",
+    "serve_timeouts_total": "requests cut by x-tunnel-deadline-ms (counter)",
+    "serve_upstream_errors_total": "backend failures before headers (counter)",
+    "serve_shed_total": "requests shed by admission control or drain (counter)",
+    # -- proxy endpoint --------------------------------------------------
+    "proxy_requests_total": "HTTP requests entering the tunnel (counter)",
+    "proxy_body_bytes_total": "response body bytes relayed to clients (counter)",
+    "proxy_streams_in_flight": "open tunnel streams (gauge)",
+    "proxy_ttfb_ms": "first response byte per proxied request (histogram, ms)",
+    # -- transport -------------------------------------------------------
+    "transport_cwnd": "ARQ congestion window, packets (gauge)",
+    "transport_in_flight": "unacked ARQ packets (gauge)",
+    "transport_srtt_ms": "smoothed RTT of the ARQ path (gauge, ms)",
+    "transport_retransmits_total": "ARQ retransmissions (counter)",
+}
+
 
 class _Percentiles:
     """Bounded reservoir of observations with percentile queries."""

@@ -1,0 +1,928 @@
+"""tunnelcheck rule suite: positive + negative fixtures per rule, waiver
+parsing, and the self-run invariant that the shipped tree stays clean.
+
+Fast and jax-free: the checker is pure ``ast``, so these tests are plain
+tier-1 members with no accelerator or optional-dep requirements.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.tunnelcheck import run_paths
+from tools.tunnelcheck.__main__ import main as tunnelcheck_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def check(tmp_path: Path, code: str, filename: str = "snippet.py", rules=None):
+    """Write one fixture file and return (active, waived) violations."""
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    return run_paths([f], rules=rules)
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# TC00 — parse errors are findings, not crashes
+# ---------------------------------------------------------------------------
+
+
+def test_tc00_syntax_error_is_reported(tmp_path):
+    active, _ = check(tmp_path, "def broken(:\n")
+    assert rules_of(active) == ["TC00"]
+
+
+# ---------------------------------------------------------------------------
+# TC01 — blocking calls inside async def
+# ---------------------------------------------------------------------------
+
+
+def test_tc01_flags_time_sleep_in_async(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """,
+    )
+    assert rules_of(active) == ["TC01"]
+    assert "asyncio.sleep" in active[0].message
+
+
+def test_tc01_resolves_from_import_alias(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from time import sleep
+        import subprocess as sp
+
+        async def handler():
+            sleep(1)
+            sp.check_output(["ls"])
+        """,
+    )
+    assert rules_of(active) == ["TC01", "TC01"]
+
+
+def test_tc01_local_import_does_not_pollute_module_scope(tmp_path):
+    # A sync helper's local `from time import sleep` must not make the
+    # async function's asyncio `sleep` resolve to time.sleep...
+    active, _ = check(
+        tmp_path,
+        """
+        from asyncio import sleep
+
+        def helper():
+            from time import sleep
+            sleep(1)
+
+        async def handler():
+            await sleep(0.1)
+        """,
+    )
+    assert active == []
+
+
+def test_tc01_local_import_inside_async_def_still_resolves(tmp_path):
+    # ...while a local import inside the async def itself still counts.
+    active, _ = check(
+        tmp_path,
+        """
+        async def handler():
+            from time import sleep
+            sleep(1)
+        """,
+    )
+    assert rules_of(active) == ["TC01"]
+
+
+def test_tc01_rebound_import_resolves_to_last_binding(tmp_path):
+    # Python binding semantics: the LAST import of a rebound name wins.
+    active, _ = check(
+        tmp_path,
+        """
+        from time import sleep
+        from asyncio import sleep
+
+        async def handler():
+            await sleep(0.1)
+        """,
+    )
+    assert active == []
+    active, _ = check(
+        tmp_path,
+        """
+        from asyncio import sleep
+        from time import sleep
+
+        async def handler():
+            sleep(0.1)
+        """,
+    )
+    assert rules_of(active) == ["TC01"]
+
+
+def test_tc01_flags_blocking_file_io(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def handler(path):
+            with open(path) as f:
+                return f.read()
+        """,
+    )
+    assert rules_of(active) == ["TC01"]
+
+
+def test_tc01_allows_sync_and_awaited_equivalents(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        import time
+
+        def sync_helper():
+            time.sleep(0.1)  # fine: not on the event loop
+
+        async def handler():
+            await asyncio.sleep(0.1)
+
+            def executor_job():
+                time.sleep(1)  # fine: nearest enclosing function is sync
+
+            return executor_job
+        """,
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# TC02 — jit signature drift
+# ---------------------------------------------------------------------------
+
+
+def test_tc02_static_argnums_out_of_range(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(params, tokens, steps):
+            return tokens
+
+        fn = jax.jit(step, static_argnums=(2, 7))
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+    assert "index 7" in active[0].message
+
+
+def test_tc02_static_argnames_unknown_name(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(params, tokens, steps):
+            return tokens
+
+        fn = jax.jit(step, static_argnames=("step_count",))
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+    assert "step_count" in active[0].message
+
+
+def test_tc02_direct_call_arity(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(params, tokens, steps):
+            return tokens
+
+        out = jax.jit(step, static_argnums=(2,))(p, t)
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+    assert "missing: steps" in active[0].message
+
+
+def test_tc02_keyword_fun_spelling_is_checked(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(params, tokens):
+            return tokens
+
+        fn = jax.jit(fun=step, static_argnums=(5,))
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+
+
+def test_tc02_partial_decorator_checked(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+        def step(params, tokens):
+            return tokens
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+
+
+def test_tc02_regression_old_perf_probe_shape(tmp_path):
+    """The PR 2 incident, verbatim in shape: ``_decode_fn`` grew a ``bias``
+    parameter (13 total), but the probe still jitted it with the stale
+    ``static_argnums=(10, 11)`` and lowered with the old 12-argument call.
+    The indices are in range — only the arity check catches it, exactly the
+    class of drift tests never see because scripts/ is never imported."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def _decode_fn(self, params, kv_cache, tokens, positions, counts,
+                           bias, ov_mask, ov_tok, ov_pos, samp, key, kv_view,
+                           steps):
+                return tokens
+
+        def probe(eng, params, kv_cache, tokens, positions, counts, ovm, ovt,
+                  ovp, samp, key, kv_view, steps):
+            return jax.jit(eng._decode_fn, static_argnums=(10, 11)).lower(
+                params, kv_cache, tokens, positions, counts, ovm, ovt,
+                ovp, samp, key, kv_view, steps,
+            )
+        """,
+    )
+    assert rules_of(active) == ["TC02"]
+    assert "missing" in active[0].message
+
+
+def test_tc02_clean_on_valid_shapes(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def _decode_fn(self, params, tokens, steps):
+                return tokens
+
+        def probe(eng, params, tokens, steps):
+            return jax.jit(eng._decode_fn, static_argnums=(2,)).lower(
+                params, tokens, steps
+            )
+
+        variadic = jax.jit(lambda *a: a, static_argnums=(5,))
+        unresolvable = jax.jit(some_imported_fn, static_argnums=(99,))
+        """,
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# TC03 — host sync inside traced functions
+# ---------------------------------------------------------------------------
+
+
+def test_tc03_item_in_jitted_function(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(carry, x):
+            n = carry.item()
+            return carry, x
+
+        fn = jax.jit(step)
+        """,
+    )
+    assert rules_of(active) == ["TC03"]
+    assert ".item()" in active[0].message
+
+
+def test_tc03_scan_body_and_np_asarray(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import numpy as np
+        from jax import lax
+
+        def body(carry, x):
+            host = np.asarray(x)
+            return carry, host
+
+        ys = lax.scan(body, 0, xs)
+        """,
+    )
+    assert rules_of(active) == ["TC03"]
+    assert "numpy.asarray" in active[0].message
+
+
+def test_tc03_python_if_on_traced_comparison(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if jnp.max(x) > 0:
+                return x
+            return -x
+        """,
+    )
+    assert rules_of(active) == ["TC03"]
+    assert "lax.cond" in active[0].message
+
+
+def test_tc03_float_of_jax_expression(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            return float(jnp.sum(x))
+
+        fn = jax.jit(step)
+        """,
+    )
+    assert rules_of(active) == ["TC03"]
+
+
+def test_tc03_static_shape_and_dtype_branches_are_legal(tmp_path):
+    # shape/ndim/dtype are plain Python values under trace; branching on
+    # them is legal and must not be pushed toward lax.cond.
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if jnp.ndim(x) == 2:
+                return x
+            if x.shape[0] > 1 and x.dtype == jnp.int8:
+                return x
+            n = int(jnp.shape(x)[0])
+            return -x
+        """,
+    )
+    assert active == []
+
+
+def test_tc03_traced_parameter_concretisation(tmp_path):
+    # float()/if on a traced *parameter* must be caught even with no
+    # jnp call in the expression.
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(x, steps):
+            if x > 0:
+                return float(x)
+            return 0.0
+
+        fn = jax.jit(step, static_argnums=(1,))
+        """,
+    )
+    assert rules_of(active) == ["TC03", "TC03"]
+
+
+def test_tc03_static_argnums_params_are_exempt(tmp_path):
+    # Params marked static at the jit site are Python values: branching
+    # and float() on them is legal, as is `is None` on traced args.
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def step(x, mask, steps):
+            if steps > 4:
+                return x * float(steps)
+            if mask is not None:
+                return x + mask
+            return x
+
+        fn = jax.jit(step, static_argnums=(2,))
+        """,
+    )
+    assert active == []
+
+
+def test_tc03_scan_carry_name_collision_not_traced(tmp_path):
+    # Only the function positions of scan/fori/while are traced; a carry
+    # arg sharing its name with a host-side def must not drag it in.
+    active, _ = check(
+        tmp_path,
+        """
+        import numpy as np
+        from jax import lax
+
+        def helper(x):
+            return float(np.asarray(x))
+
+        def body(carry, x):
+            return carry, x
+
+        ys = lax.scan(body, helper, xs)
+        out = lax.fori_loop(lower, helper, body, init)
+        """,
+    )
+    assert active == []
+
+
+def test_tc03_untraced_functions_are_free(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import numpy as np
+
+        def host_side(x):
+            return float(np.asarray(x).item())
+
+        def static_config(x, use_bias):
+            if use_bias:  # static python control flow is fine under trace
+                return x
+            return -x
+
+        import jax
+        fn = jax.jit(static_config, static_argnums=(1,))
+        """,
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# TC04 — optional-dep hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_tc04_module_level_optional_import(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import websockets
+        """,
+    )
+    assert rules_of(active) == ["TC04"]
+
+
+def test_tc04_gating_try_except_is_still_module_level(tmp_path):
+    # Only the three wrapper modules may gate; anyone else must import them.
+    active, _ = check(
+        tmp_path,
+        """
+        try:
+            from cryptography.hazmat.primitives import hashes
+        except ImportError:
+            hashes = None
+        """,
+    )
+    assert rules_of(active) == ["TC04"]
+
+
+def test_tc04_type_checking_block_is_exempt(tmp_path):
+    # `if TYPE_CHECKING:` never executes, so a type-only import cannot
+    # cause the PR 1 collection-error incident.
+    active, _ = check(
+        tmp_path,
+        """
+        from typing import TYPE_CHECKING
+
+        if TYPE_CHECKING:
+            import websockets
+        """,
+    )
+    assert active == []
+
+
+def test_tc04_function_local_import_ok(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def connect():
+            import websockets
+            return websockets
+        """,
+    )
+    assert active == []
+
+
+def test_tc04_gated_wrappers_are_exempt(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        try:
+            import websockets
+        except ImportError:
+            websockets = None
+        """,
+        filename="p2p_llm_tunnel_tpu/signaling/client.py",
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# TC05 — MessageType dispatch exhaustiveness + error-code registry
+# ---------------------------------------------------------------------------
+
+DISPATCH_PREAMBLE = """
+from p2p_llm_tunnel_tpu.protocol.frames import MessageType, TunnelMessage
+
+def dispatch(msg):
+"""
+
+
+def test_tc05_dispatch_without_default(tmp_path):
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.msg_type == MessageType.RES_BODY:
+        return "body"
+    elif msg.msg_type == MessageType.RES_END:
+        return "end"
+        """,
+    )
+    assert rules_of(active) == ["TC05"]
+    assert "unhandled" in active[0].message
+
+
+def test_tc05_dispatch_with_default_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.msg_type == MessageType.RES_BODY:
+        return "body"
+    elif msg.msg_type == MessageType.RES_END:
+        return "end"
+    else:
+        return "ignored"
+        """,
+    )
+    assert active == []
+
+
+def test_tc05_else_containing_an_if_is_a_default(tmp_path):
+    # An `else:` whose body starts with an `if` must not be mistaken for
+    # another elif link — it IS the explicit default.
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.msg_type == MessageType.RES_BODY:
+        return "body"
+    elif msg.msg_type == MessageType.RES_END:
+        return "end"
+    else:
+        if msg.stream_id == 0:
+            return "control"
+        return "ignored"
+        """,
+    )
+    assert active == []
+
+
+def test_tc05_sees_through_import_aliases(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import MessageType as MT
+
+        def dispatch(msg):
+            if msg.msg_type == MT.RES_BODY:
+                return "body"
+            elif msg.msg_type == MT.RES_END:
+                return "end"
+        """,
+    )
+    assert rules_of(active) == ["TC05"]
+
+
+def test_tc05_different_subjects_are_not_one_dispatch(tmp_path):
+    # Comparing two DIFFERENT expressions against members is not a
+    # dispatch over one frame's type.
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.first.msg_type == MessageType.RES_BODY:
+        return "a"
+    elif msg.second.msg_type == MessageType.RES_END:
+        return "b"
+        """,
+    )
+    assert active == []
+
+
+def test_tc05_single_guard_is_not_a_dispatch(tmp_path):
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.msg_type != MessageType.HELLO:
+        raise RuntimeError("expected HELLO")
+        """,
+    )
+    assert active == []
+
+
+def test_tc05_unregistered_typed_error_code(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import TunnelMessage
+
+        frame = TunnelMessage.typed_error(1, "overloadedd", "shed")
+        """,
+    )
+    assert rules_of(active) == ["TC05"]
+    assert "overloadedd" in active[0].message
+
+
+def test_tc05_registered_code_and_tunnel_code_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import TunnelMessage
+
+        class DeadlineExceeded(Exception):
+            tunnel_code = "timeout"
+
+        frame = TunnelMessage.typed_error(1, "busy", "shed")
+        """,
+    )
+    assert active == []
+
+
+def test_tc05_unregistered_tunnel_code(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        class Oops(Exception):
+            tunnel_code = "exploded"
+        """,
+    )
+    assert rules_of(active) == ["TC05"]
+
+
+def test_tc05_annotated_tunnel_code_and_keyword_code(tmp_path):
+    # The typed variants must not slip past the registry check.
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import TunnelMessage
+
+        class Oops(Exception):
+            tunnel_code: str = "exploded"
+
+        frame = TunnelMessage.typed_error(1, code="overloadedd", msg="x")
+        """,
+    )
+    assert rules_of(active) == ["TC05", "TC05"]
+
+
+# ---------------------------------------------------------------------------
+# TC06 — metrics-name registry
+# ---------------------------------------------------------------------------
+
+
+def test_tc06_typod_write_is_flagged(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+        global_metrics.inc("engine_tokens_totl")
+        """,
+    )
+    assert rules_of(active) == ["TC06"]
+    assert "engine_tokens_totl" in active[0].message
+
+
+def test_tc06_typod_read_is_flagged(tmp_path):
+    # /healthz-style reads are held to the catalogue too.
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+        depth = global_metrics.gauge("engine_queue_dept")
+        """,
+    )
+    assert rules_of(active) == ["TC06"]
+
+
+def test_tc06_catalogued_names_are_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+        global_metrics.inc("engine_tokens_total")
+        global_metrics.set_gauge("engine_queue_depth", 3)
+        global_metrics.observe("engine_ttft_ms", 12.5)
+        depth = global_metrics.gauge("engine_queue_depth")
+        dynamic = "engine_" + "tokens_total"
+        global_metrics.inc(dynamic)  # non-literal names are out of scope
+        """,
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# Waivers
+# ---------------------------------------------------------------------------
+
+
+def test_line_waiver_suppresses_and_is_reported_as_waived(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.01)  # tunnelcheck: disable=TC01  startup-only path
+        """,
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC01"]
+
+
+def test_line_waiver_is_rule_specific(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.01)  # tunnelcheck: disable=TC02
+        """,
+    )
+    assert rules_of(active) == ["TC01"]
+
+
+def test_waiver_inside_a_string_literal_is_inert(tmp_path):
+    # Only real comment tokens waive — a fixture string that *contains*
+    # waiver syntax (like this test file itself) must not gag the checker.
+    active, _ = check(
+        tmp_path,
+        '''
+        import time
+
+        FIXTURE = """
+        # tunnelcheck: disable-file=TC01
+        x = 1  # tunnelcheck: disable=all
+        """
+
+        async def handler():
+            time.sleep(1)
+        ''',
+    )
+    assert rules_of(active) == ["TC01"]
+
+
+def test_waiver_on_a_continuation_line_suppresses(tmp_path):
+    # The natural placement — next to the offending argument of a
+    # multi-line call — must work, not just the statement's first line.
+    active, waived = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+        global_metrics.observe(
+            "bench_only_series",  # tunnelcheck: disable=TC06  ad-hoc probe
+            1.0,
+        )
+        """,
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC06"]
+
+
+def test_file_waiver_and_disable_all(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        # tunnelcheck: disable-file=TC01
+        import time
+        import subprocess
+
+        async def a():
+            time.sleep(1)
+
+        async def b():
+            subprocess.run(["ls"])  # tunnelcheck: disable=all
+        """,
+    )
+    assert active == []
+    assert len(waived) == 2
+
+
+# ---------------------------------------------------------------------------
+# Self-run + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_self_run_shipped_tree_is_clean():
+    """The repo must always pass its own checker (the `make lint` gate) —
+    including the repo-root entry points bench.py and __graft_entry__.py,
+    which read catalogued metrics and jit model functions respectively."""
+    active, _ = run_paths(
+        [
+            REPO_ROOT / "p2p_llm_tunnel_tpu",
+            REPO_ROOT / "scripts",
+            REPO_ROOT / "tests",
+            REPO_ROOT / "bench.py",
+            REPO_ROOT / "__graft_entry__.py",
+        ]
+    )
+    assert active == [], "\n".join(v.render(REPO_ROOT) for v in active)
+
+
+def test_overlapping_paths_scan_each_file_once(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    active, _ = run_paths([tmp_path, f])
+    assert rules_of(active) == ["TC01"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+
+    assert tunnelcheck_main([str(good)]) == 0
+    assert tunnelcheck_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "TC01" in out
+    assert tunnelcheck_main([]) == 2
+    assert tunnelcheck_main([str(tmp_path / "missing.py")]) == 2
+    assert tunnelcheck_main(["--list-rules"]) == 0
+    assert "TC06" in capsys.readouterr().out
+
+
+def test_cli_rule_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    assert tunnelcheck_main([str(bad), "--rules", "TC02"]) == 0
+    assert tunnelcheck_main([str(bad), "--rules", "TC01"]) == 1
+    assert tunnelcheck_main([str(bad), "--rules", "TC99"]) == 2
+    # TC00 appears in --list-rules, so the filter accepts it (parse errors
+    # are unfilterable and reported regardless of --rules).
+    assert tunnelcheck_main([str(bad), "--rules", "TC00"]) == 0
+    unparseable = tmp_path / "unparseable.py"
+    unparseable.write_text("def broken(:\n")
+    assert tunnelcheck_main([str(unparseable), "--rules", "TC06"]) == 1
+
+
+def test_run_paths_rejects_unknown_rule_ids(tmp_path):
+    f = tmp_path / "x.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="TC1"):
+        run_paths([f], rules=["TC1"])
+    # TC00 is accepted (always-on, unfilterable).
+    active, _ = run_paths([f], rules=["TC00"])
+    assert active == []
+
+
+def test_registries_match_runtime():
+    """The statically-parsed registries agree with the live modules, so the
+    checker can't drift from what the code actually enforces."""
+    from p2p_llm_tunnel_tpu.protocol.frames import ERROR_CODES, MessageType
+    from p2p_llm_tunnel_tpu.utils.metrics import METRICS_CATALOG
+    from tools.tunnelcheck.core import ProjectContext
+
+    ctx = ProjectContext([])
+    assert set(ctx.message_types) == {m.name for m in MessageType}
+    assert ctx.error_codes == set(ERROR_CODES)
+    assert ctx.metrics_names == set(METRICS_CATALOG)

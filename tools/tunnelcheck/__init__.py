@@ -1,0 +1,26 @@
+"""tunnelcheck: project-native static analysis for the tunnel codebase.
+
+Stdlib-only (``ast``-based) rules that make this repo's recurring runtime
+bug classes statically detectable.  See README.md "Static analysis &
+invariants" for the rule table and the incidents each rule guards against.
+
+Usage::
+
+    python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests
+
+Waive a single finding on its line::
+
+    time.sleep(0.1)  # tunnelcheck: disable=TC01  <why this one is fine>
+
+or a whole file (fixture trees, generated code)::
+
+    # tunnelcheck: disable-file=TC03
+"""
+
+from tools.tunnelcheck.core import (  # noqa: F401
+    ProjectContext,
+    SourceFile,
+    Violation,
+    all_rules,
+    run_paths,
+)

@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests``.
+
+Exit status: 0 = clean, 1 = violations, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules, run_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tunnelcheck",
+        description="Project-native static analysis for the tunnel codebase.",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to scan")
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    parser.add_argument(
+        "--show-waived",
+        action="store_true",
+        help="also print findings silenced by `# tunnelcheck: disable=` waivers",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULE_SUMMARIES):
+            print(f"{rule_id}  {RULE_SUMMARIES[rule_id]}")
+        return 0
+
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("tunnelcheck: error: no paths given", file=sys.stderr)
+        return 2
+
+    paths = [Path(p) for p in args.paths]
+    for p in paths:
+        if not p.exists():
+            print(f"tunnelcheck: error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    selected = None
+    if args.rules:
+        selected = [r.strip() for r in args.rules.split(",") if r.strip()]
+        # TC00 (parse errors) is always on and unfilterable; accept it in
+        # the filter so every id shown by --list-rules is valid here.
+        unknown = set(selected) - set(all_rules()) - {"TC00"}
+        if unknown:
+            print(
+                f"tunnelcheck: error: unknown rule(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+
+    root = Path.cwd()
+    stats: dict = {}
+    active, waived = run_paths(paths, rules=selected, stats=stats)
+    for v in active:
+        print(v.render(root))
+    if args.show_waived:
+        for v in waived:
+            print(f"{v.render(root)} [waived]")
+    summary = (
+        f"tunnelcheck: {len(active)} violation(s), {len(waived)} waived, "
+        f"{stats.get('files', 0)} file(s) scanned"
+    )
+    print(summary, file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,481 @@
+"""Rule framework: source loading, project context, waivers, the runner.
+
+Everything here is stdlib-only on purpose (ISSUE 3): the checker must run
+in any environment that can run the repo's tests — including ones without
+jax, websockets, or cryptography installed — so rules work on the ``ast``
+of the code, never by importing it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: Repo root, derived from this file's location (tools/tunnelcheck/core.py),
+#: so registry files (protocol/frames.py, utils/metrics.py) resolve even when
+#: the scan targets are test fixtures outside the tree.
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# The id list stops at the first space so a waiver can carry a justification:
+#   time.sleep(1)  # tunnelcheck: disable=TC01  startup-only, loop not running
+_RULE_LIST = r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+_WAIVER_RE = re.compile(r"#\s*tunnelcheck:\s*disable=" + _RULE_LIST)
+_FILE_WAIVER_RE = re.compile(r"#\s*tunnelcheck:\s*disable-file=" + _RULE_LIST)
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: Path
+    line: int
+    message: str
+    #: Last line of the offending statement: a waiver comment anywhere on
+    #: the statement (e.g. next to one argument of a multi-line call)
+    #: suppresses, not just one on the anchor line.
+    end_line: Optional[int] = None
+
+    def render(self, root: Optional[Path] = None) -> str:
+        p = self.path
+        if root is not None:
+            try:
+                p = p.relative_to(root)
+            except ValueError:
+                pass
+        return f"{p}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    text: str
+    tree: ast.Module
+    lines: List[str]
+    #: local name -> canonical dotted path ("jnp" -> "jax.numpy").
+    aliases: Dict[str, str]
+    #: line number -> set of waived rule ids ("all" waives everything).
+    line_waivers: Dict[int, Set[str]] = field(default_factory=dict)
+    file_waivers: Set[str] = field(default_factory=set)
+
+    def waived(self, rule: str, line: int, end_line: Optional[int] = None) -> bool:
+        if "all" in self.file_waivers or rule in self.file_waivers:
+            return True
+        for ln in range(line, (end_line or line) + 1):
+            w = self.line_waivers.get(ln, ())
+            if "all" in w or rule in w:
+                return True
+        return False
+
+
+@dataclass
+class FuncInfo:
+    """Statically-extracted signature of one def/lambda."""
+
+    name: str
+    pos: List[str]  # positional-only + positional-or-keyword, in order
+    n_pos_defaults: int
+    kwonly: List[str]
+    kwonly_required: List[str]
+    has_vararg: bool
+    has_kwarg: bool
+    is_method: bool  # defined directly inside a class, not static/classmethod
+    path: Path
+    line: int
+
+    @classmethod
+    def from_node(
+        cls,
+        node: "ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda",
+        path: Path,
+        is_method: bool = False,
+    ) -> "FuncInfo":
+        a = node.args
+        kw_required = [
+            arg.arg
+            for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+            if d is None
+        ]
+        return cls(
+            name=getattr(node, "name", "<lambda>"),
+            pos=[x.arg for x in a.posonlyargs + a.args],
+            n_pos_defaults=len(a.defaults),
+            kwonly=[x.arg for x in a.kwonlyargs],
+            kwonly_required=kw_required,
+            has_vararg=a.vararg is not None,
+            has_kwarg=a.kwarg is not None,
+            is_method=is_method,
+            path=path,
+            line=getattr(node, "lineno", 0),
+        )
+
+    def effective_pos(self, drop_self: bool) -> List[str]:
+        return self.pos[1:] if (drop_self and self.is_method and self.pos) else self.pos
+
+
+def _is_type_checking_test(test: ast.AST) -> bool:
+    d = dotted_name(test)
+    return d is not None and d.split(".")[-1] == "TYPE_CHECKING"
+
+
+def iter_scope_statements(body: Iterable[ast.stmt]) -> Iterator[ast.AST]:
+    """Statements executed AT RUNTIME in the scope owning ``body`` —
+    descends into try/if/with/loop (and class) blocks but never into nested
+    functions (bindings local to them) nor ``if TYPE_CHECKING:`` bodies
+    (which never execute).  SOURCE ORDER is preserved so a rebound import
+    name resolves to its last binding, like Python does."""
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.If) and _is_type_checking_test(node.test):
+            yield from iter_scope_statements(node.orelse)
+            continue
+        yield node
+        yield from iter_scope_statements(ast.iter_child_nodes(node))
+
+
+def collect_import_aliases(
+    nodes: Iterable[ast.AST], out: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    out = {} if out is None else out
+    for node in nodes:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    root = a.name.split(".")[0]
+                    out[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue  # relative imports stay project-local
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def module_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map each MODULE-LEVEL import name to its canonical dotted origin.
+
+    Function-local imports are deliberately excluded: a helper's
+    ``from time import sleep`` must not make every other function's
+    ``sleep`` resolve to ``time.sleep``.  Rules that care about local
+    imports (TC01) overlay them per function scope.
+    """
+    return collect_import_aliases(iter_scope_statements(tree.body))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """"a.b.c" for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted path of an expression ("jnp.abs" -> "jax.numpy.abs")."""
+    dotted = dotted_name(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    origin = aliases.get(head)
+    if origin is None:
+        return dotted
+    return f"{origin}.{rest}" if rest else origin
+
+
+def _collect_waivers(text: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Waivers from actual COMMENT tokens, never from string literals —
+    a fixture string containing ``# tunnelcheck: disable-file=...`` must not
+    waive anything in the file that carries it."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, whole_file
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT or "tunnelcheck" not in tok.string:
+            continue
+        m = _WAIVER_RE.search(tok.string)
+        if m:
+            per_line.setdefault(tok.start[0], set()).update(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+        m = _FILE_WAIVER_RE.search(tok.string)
+        if m:
+            whole_file |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return per_line, whole_file
+
+
+def load_source(path: Path) -> Tuple[Optional[SourceFile], Optional[Violation]]:
+    try:
+        text = path.read_text(encoding="utf-8")
+        tree = ast.parse(text, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return None, Violation("TC00", path, line, f"unparseable: {e}")
+    lines = text.splitlines()
+    per_line, whole_file = _collect_waivers(text)
+    return (
+        SourceFile(
+            path=path,
+            text=text,
+            tree=tree,
+            lines=lines,
+            aliases=module_aliases(tree),
+            line_waivers=per_line,
+            file_waivers=whole_file,
+        ),
+        None,
+    )
+
+
+def _parse_registry_file(rel: str, scanned: Sequence[SourceFile]) -> Optional[ast.Module]:
+    """AST of a registry module: prefer a scanned copy, else the repo's own."""
+    for sf in scanned:
+        if sf.path.as_posix().endswith(rel):
+            return sf.tree
+    candidate = REPO_ROOT / rel
+    if candidate.is_file():
+        try:
+            return ast.parse(candidate.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            return None
+    return None
+
+
+def _enum_members(tree: ast.Module, class_name: str) -> List[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            out = []
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, int)
+                ):
+                    out.append(stmt.targets[0].id)
+            return out
+    return []
+
+
+def _str_collection(tree: ast.Module, var_name: str) -> Set[str]:
+    """String literals in a module-level ``NAME = {...}`` / frozenset / dict."""
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+            value = node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == var_name):
+            continue
+        if isinstance(value, ast.Call):  # frozenset({...})
+            if value.args:
+                value = value.args[0]
+        if isinstance(value, ast.Dict):
+            return {
+                k.value
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+        if isinstance(value, (ast.Set, ast.List, ast.Tuple)):
+            return {
+                e.value
+                for e in value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return set()
+
+
+class ProjectContext:
+    """Cross-file knowledge shared by all rules for one run."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.func_index: Dict[str, List[FuncInfo]] = {}
+        for sf in self.files:
+            self._index_defs(sf)
+
+        frames = _parse_registry_file(
+            "p2p_llm_tunnel_tpu/protocol/frames.py", self.files
+        )
+        self.message_types: List[str] = (
+            _enum_members(frames, "MessageType") if frames else []
+        )
+        self.error_codes: Set[str] = (
+            _str_collection(frames, "ERROR_CODES") if frames else set()
+        )
+        metrics = _parse_registry_file(
+            "p2p_llm_tunnel_tpu/utils/metrics.py", self.files
+        )
+        self.metrics_names: Set[str] = (
+            _str_collection(metrics, "METRICS_CATALOG") if metrics else set()
+        )
+
+    def _index_defs(self, sf: SourceFile) -> None:
+        class Indexer(ast.NodeVisitor):
+            def __init__(self, outer: "ProjectContext"):
+                self.outer = outer
+                self.class_depth = 0
+
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                self.class_depth += 1
+                for stmt in node.body:
+                    self.visit(stmt)
+                self.class_depth -= 1
+
+            def _visit_def(self, node) -> None:
+                deco = {
+                    resolve_dotted(d, sf.aliases) for d in node.decorator_list
+                }
+                is_method = self.class_depth > 0 and not (
+                    deco & {"staticmethod", "classmethod"}
+                )
+                info = FuncInfo.from_node(node, sf.path, is_method=is_method)
+                self.outer.func_index.setdefault(node.name, []).append(info)
+                saved, self.class_depth = self.class_depth, 0
+                for stmt in node.body:
+                    self.visit(stmt)
+                self.class_depth = saved
+
+            visit_FunctionDef = _visit_def
+            visit_AsyncFunctionDef = _visit_def
+
+        Indexer(self).visit(sf.tree)
+
+    def lookup_function(
+        self, name: str, prefer_path: Optional[Path] = None
+    ) -> Optional[FuncInfo]:
+        """The unique signature for ``name``, or None when absent/ambiguous.
+
+        Same-file defs win; otherwise all project-wide defs must agree on
+        shape (so a common helper name with divergent signatures is skipped
+        rather than guessed at).
+        """
+        infos = self.func_index.get(name)
+        if not infos:
+            return None
+        if prefer_path is not None:
+            local = [i for i in infos if i.path == prefer_path]
+            if len(local) == 1:
+                return local[0]
+            if len(local) > 1:
+                infos = local
+        shapes = {
+            (tuple(i.pos), i.n_pos_defaults, tuple(i.kwonly), i.has_vararg,
+             i.has_kwarg, i.is_method)
+            for i in infos
+        }
+        return infos[0] if len(shapes) == 1 else None
+
+
+def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
+    seen: Set[Path] = set()
+
+    def emit(f: Path) -> Iterator[Path]:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            yield f
+
+    for p in paths:
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" not in f.parts:
+                    yield from emit(f)
+        elif p.suffix == ".py":
+            yield from emit(p)
+
+
+def all_rules() -> Dict[str, "object"]:
+    """rule id -> check function ``(SourceFile, ProjectContext) -> Iterator``."""
+    from tools.tunnelcheck import (
+        rules_async,
+        rules_deps,
+        rules_jax,
+        rules_metrics,
+        rules_protocol,
+    )
+
+    return {
+        "TC01": rules_async.check_tc01,
+        "TC02": rules_jax.check_tc02,
+        "TC03": rules_jax.check_tc03,
+        "TC04": rules_deps.check_tc04,
+        "TC05": rules_protocol.check_tc05,
+        "TC06": rules_metrics.check_tc06,
+    }
+
+
+RULE_SUMMARIES = {
+    "TC00": "file fails to parse (always on)",
+    "TC01": "blocking call (sleep/subprocess/socket/file IO) inside async def",
+    "TC02": "jax.jit static/donate argnums+argnames or call arity vs wrapped signature",
+    "TC03": "host sync (.item()/np.asarray/device_get/if-on-array) inside traced fns",
+    "TC04": "module-level optional-dep import (websockets/cryptography) outside gated wrappers",
+    "TC05": "non-exhaustive MessageType dispatch / typed_error code not in ERROR_CODES",
+    "TC06": "metric name not declared in utils.metrics.METRICS_CATALOG",
+}
+
+
+def run_paths(
+    paths: Sequence[Path],
+    rules: Optional[Sequence[str]] = None,
+    stats: Optional[Dict[str, int]] = None,
+) -> Tuple[List[Violation], List[Violation]]:
+    """Run the suite. Returns (active_violations, waived_violations).
+
+    ``stats``, when given, receives ``{"files": <count scanned>}`` so the
+    CLI summary doesn't re-walk the tree.
+    """
+    files: List[SourceFile] = []
+    active: List[Violation] = []
+    waived: List[Violation] = []
+    n_files = 0
+    for path in iter_python_files(paths):
+        n_files += 1
+        sf, err = load_source(path)
+        if err is not None:
+            active.append(err)
+        if sf is not None:
+            files.append(sf)
+    if stats is not None:
+        stats["files"] = n_files
+
+    ctx = ProjectContext(files)
+    checks = all_rules()
+    if rules is None:
+        selected = list(checks)
+    else:
+        # TC00 (parse errors) is always on; anything else unknown is a
+        # caller bug — silently running zero rules would read as "clean".
+        unknown = set(rules) - set(checks) - {"TC00"}
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}"
+            )
+        selected = [r for r in rules if r in checks]
+    for sf in files:
+        for rule_id in selected:
+            for v in checks[rule_id](sf, ctx):
+                (waived if sf.waived(v.rule, v.line, v.end_line) else active).append(v)
+    active.sort(key=lambda v: (str(v.path), v.line, v.rule))
+    waived.sort(key=lambda v: (str(v.path), v.line, v.rule))
+    return active, waived

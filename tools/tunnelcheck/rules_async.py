@@ -1,0 +1,154 @@
+"""TC01: no blocking calls inside ``async def``.
+
+transport/, endpoints/, and engine/api are asyncio-heavy; one stray
+``time.sleep`` or sync socket call stalls every stream sharing the loop.
+Today only ``PYTHONASYNCIODEBUG=1`` (make test-race) catches these, at
+runtime, and only on paths the suites happen to exercise.  This rule makes
+the invariant static: a call from the blocklist whose *nearest enclosing
+function* is ``async def`` is a violation.  Nested sync defs are not
+flagged — they may be destined for ``run_in_executor``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.tunnelcheck.core import (
+    ProjectContext,
+    SourceFile,
+    Violation,
+    collect_import_aliases,
+    iter_scope_statements,
+    resolve_dotted,
+)
+
+#: Canonical dotted names that block the event loop when awaited nowhere.
+BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.getoutput": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.getstatusoutput": "use `await asyncio.create_subprocess_exec(...)`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.popen": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.wait": "use `await proc.wait()`",
+    "os.waitpid": "use `await proc.wait()`",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "socket.getaddrinfo": "use `await loop.getaddrinfo(...)`",
+    "socket.gethostbyname": "use `await loop.getaddrinfo(...)`",
+    "urllib.request.urlopen": "use the async http11 client",
+    "requests.get": "use the async http11 client",
+    "requests.post": "use the async http11 client",
+    "requests.put": "use the async http11 client",
+    "requests.patch": "use the async http11 client",
+    "requests.delete": "use the async http11 client",
+    "requests.head": "use the async http11 client",
+    "requests.request": "use the async http11 client",
+}
+
+#: Builtin / method-attr calls that are blocking file IO or loop re-entry.
+BLOCKING_BUILTINS = {
+    "open": "blocking file IO; use `await loop.run_in_executor(...)`",
+}
+BLOCKING_METHOD_ATTRS = {
+    "read_text": "blocking file IO (pathlib); run it in an executor",
+    "read_bytes": "blocking file IO (pathlib); run it in an executor",
+    "write_text": "blocking file IO (pathlib); run it in an executor",
+    "write_bytes": "blocking file IO (pathlib); run it in an executor",
+    "run_until_complete": "re-enters the event loop from a coroutine",
+}
+
+
+def check_tc01(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    out = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.func_stack: list = []  # True for async frames, False for sync
+            #: per-frame import overlays: function-local `from time import
+            #: sleep` must resolve inside that function (and its nested
+            #: scopes) without polluting the rest of the module.
+            self.alias_stack: list = []
+
+        def _aliases(self) -> dict:
+            merged = dict(sf.aliases)
+            for overlay in self.alias_stack:
+                merged.update(overlay)
+            return merged
+
+        def _visit_func(self, node, is_async: bool) -> None:
+            self.func_stack.append(is_async)
+            self.alias_stack.append(
+                collect_import_aliases(iter_scope_statements(node.body))
+                if isinstance(node.body, list)  # lambdas can't import
+                else {}
+            )
+            self.generic_visit(node)
+            self.alias_stack.pop()
+            self.func_stack.pop()
+
+        def visit_AsyncFunctionDef(self, node) -> None:
+            self._visit_func(node, True)
+
+        def visit_FunctionDef(self, node) -> None:
+            self._visit_func(node, False)
+
+        def visit_Lambda(self, node) -> None:
+            self._visit_func(node, False)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.func_stack and self.func_stack[-1]:
+                self._check_call(node)
+            self.generic_visit(node)
+
+        def _check_call(self, node: ast.Call) -> None:
+            resolved = resolve_dotted(node.func, self._aliases())
+            if resolved in BLOCKING_CALLS:
+                out.append(
+                    Violation(
+                        "TC01",
+                        sf.path,
+                        node.lineno,
+                        f"blocking `{resolved}(...)` inside async def; "
+                        f"{BLOCKING_CALLS[resolved]}",
+                        end_line=node.end_lineno,
+                    )
+                )
+                return
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in BLOCKING_BUILTINS
+                and node.func.id not in self._aliases()
+            ):
+                out.append(
+                    Violation(
+                        "TC01",
+                        sf.path,
+                        node.lineno,
+                        f"`{node.func.id}(...)` inside async def: "
+                        f"{BLOCKING_BUILTINS[node.func.id]}",
+                        end_line=node.end_lineno,
+                    )
+                )
+                return
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in BLOCKING_METHOD_ATTRS
+            ):
+                out.append(
+                    Violation(
+                        "TC01",
+                        sf.path,
+                        node.lineno,
+                        f"`.{node.func.attr}(...)` inside async def: "
+                        f"{BLOCKING_METHOD_ATTRS[node.func.attr]}",
+                        end_line=node.end_lineno,
+                    )
+                )
+
+    Visitor().visit(sf.tree)
+    return iter(out)

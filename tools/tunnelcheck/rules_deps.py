@@ -1,0 +1,72 @@
+"""TC04: optional-dependency hygiene for ``websockets`` / ``cryptography``.
+
+PR 1 had to retroactively gate these imports after 12 tier-1 collection
+errors: any module-level import of an optional dependency breaks *import*
+of the whole package on machines without it — which is every CI machine
+the TPU toolchain image doesn't cover.  The fix was to confine the imports
+to three gated wrapper modules (try/except at import, hard error only at
+first use).  This rule makes that fix permanent: a module-level import of
+an optional dep anywhere else is a violation; function-local imports and
+``pytest.importorskip`` remain fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.tunnelcheck.core import (
+    ProjectContext,
+    SourceFile,
+    Violation,
+    iter_scope_statements,
+)
+
+#: Distributions whose absence must never break ``import p2p_llm_tunnel_tpu``.
+OPTIONAL_DEPS = {"websockets", "cryptography"}
+
+#: The gated wrappers PR 1 introduced — the only modules allowed to import
+#: the optional deps at module level (inside their try/except gates).
+GATED_WRAPPERS = (
+    "p2p_llm_tunnel_tpu/transport/crypto.py",
+    "p2p_llm_tunnel_tpu/signaling/client.py",
+    "p2p_llm_tunnel_tpu/signaling/server.py",
+)
+
+
+def _module_level_imports(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Imports that execute at module import time (incl. try/if/class bodies),
+    excluding anything inside a function or lambda."""
+    for node in iter_scope_statements(tree.body):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+
+
+def check_tc04(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    posix = sf.path.as_posix()
+    if any(posix.endswith(w) for w in GATED_WRAPPERS):
+        return iter(())
+    out = []
+    for node in _module_level_imports(sf.tree):
+        roots = []
+        if isinstance(node, ast.Import):
+            roots = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            roots = [node.module.split(".")[0]]
+        for root in roots:
+            if root in OPTIONAL_DEPS:
+                out.append(
+                    Violation(
+                        "TC04",
+                        sf.path,
+                        node.lineno,
+                        f"module-level import of optional dep `{root}`; only "
+                        "the gated wrappers (transport/crypto.py, signaling/"
+                        "client.py, signaling/server.py) may import it — go "
+                        "through them, or import inside the function that "
+                        "needs it (the PR 1 collection-error incident)",
+                        end_line=node.end_lineno,
+                    )
+                )
+    return iter(out)

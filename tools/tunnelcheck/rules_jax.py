@@ -1,0 +1,504 @@
+"""TC02 + TC03: jit-boundary signature drift and host syncs inside traces.
+
+TC02 is the PR 2 incident made permanent: ``scripts/perf_probe.py`` carried
+``jax.jit(eng._decode_fn, static_argnums=(10, 11)).lower(<12 args>)`` after
+``_decode_fn`` grew a ``bias`` parameter (13 args) — broken for every quant
+mode, unnoticed because tests never import scripts/.  The rule cross-checks
+``static_argnums``/``static_argnames``/``donate_argnums``/``donate_argnames``
+against the wrapped function's statically-resolved signature, and checks the
+arity of an immediately-invoked (or ``.lower()``-ed) jitted callable.
+
+TC03 flags host synchronisation inside functions that this module jits or
+feeds to ``lax.scan``: ``.item()``, ``np.asarray``/``np.array``,
+``jax.device_get``, ``float()``/``int()``/``bool()`` on jax expressions, and
+Python ``if`` over a traced comparison — each is either a tracer error at
+best or a silent every-step device sync at worst.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.tunnelcheck.core import (
+    FuncInfo,
+    ProjectContext,
+    SourceFile,
+    Violation,
+    resolve_dotted,
+)
+
+JIT_NAMES = {"jax.jit"}
+#: lax control-flow entries -> which positional args are traced functions
+#: (scan(f, init, xs); while_loop(cond, body, init); fori_loop(lo, hi, body, init)).
+TRACE_ENTRY_FN_ARGS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+}
+PARTIAL_NAMES = {"functools.partial"}
+ARGNUM_KWARGS = ("static_argnums", "donate_argnums")
+ARGNAME_KWARGS = ("static_argnames", "donate_argnames")
+
+
+def _is_jit_call(node: ast.AST, sf: SourceFile) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and resolve_dotted(node.func, sf.aliases) in JIT_NAMES
+    )
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """The wrapped function of a jit call — positional or ``fun=``."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "fun":
+            return kw.value
+    return None
+
+
+def _literal_ints(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _resolve_target(
+    target: ast.AST, sf: SourceFile, ctx: ProjectContext
+) -> Tuple[Optional[FuncInfo], bool]:
+    """(signature, drop_self) for a jitted expression, or (None, False).
+
+    ``obj.meth`` drops ``self`` (attribute access binds it); a bare name
+    that resolves to a method project-wide is skipped as ambiguous.
+    """
+    if isinstance(target, ast.Lambda):
+        return FuncInfo.from_node(target, sf.path), False
+    if isinstance(target, ast.Name):
+        info = ctx.lookup_function(target.id, prefer_path=sf.path)
+        if info is not None and info.is_method:
+            return None, False
+        return info, False
+    if isinstance(target, ast.Attribute):
+        info = ctx.lookup_function(target.attr, prefer_path=sf.path)
+        if info is None:
+            return None, False
+        return info, info.is_method
+    return None, False
+
+
+def _check_static_kwargs(
+    keywords: List[ast.keyword],
+    info: FuncInfo,
+    drop_self: bool,
+    lineno: int,
+    sf: SourceFile,
+) -> Iterator[Violation]:
+    pos = info.effective_pos(drop_self)
+    for kw in keywords:
+        if kw.arg in ARGNUM_KWARGS:
+            idxs = _literal_ints(kw.value)
+            if idxs is None:
+                continue
+            for i in idxs:
+                if info.has_vararg:
+                    continue
+                if i >= len(pos) or i < -len(pos):
+                    yield Violation(
+                        "TC02",
+                        sf.path,
+                        lineno,
+                        f"{kw.arg} index {i} is out of range for "
+                        f"`{info.name}` ({len(pos)} positional parameters: "
+                        f"{', '.join(pos) or 'none'})",
+                        end_line=kw.value.end_lineno,
+                    )
+        elif kw.arg in ARGNAME_KWARGS:
+            names = _literal_strs(kw.value)
+            if names is None or info.has_kwarg:
+                continue
+            valid = set(pos) | set(info.kwonly)
+            for n in names:
+                if n not in valid:
+                    yield Violation(
+                        "TC02",
+                        sf.path,
+                        lineno,
+                        f"{kw.arg} names `{n}`, which is not a parameter of "
+                        f"`{info.name}` (has: {', '.join(pos + info.kwonly)})",
+                        end_line=kw.value.end_lineno,
+                    )
+
+
+def _check_call_binding(
+    outer: ast.Call,
+    info: FuncInfo,
+    drop_self: bool,
+    label: str,
+    sf: SourceFile,
+) -> Iterator[Violation]:
+    if any(isinstance(a, ast.Starred) for a in outer.args):
+        return
+    if any(kw.arg is None for kw in outer.keywords):
+        return
+    pos = info.effective_pos(drop_self)
+    n_given = len(outer.args)
+    if n_given > len(pos) and not info.has_vararg:
+        yield Violation(
+            "TC02",
+            sf.path,
+            outer.lineno,
+            f"{label} `{info.name}` passes {n_given} positional args but the "
+            f"wrapped function takes only {len(pos)}",
+            end_line=outer.end_lineno,
+        )
+        return
+    bound = set(pos[: min(n_given, len(pos))])
+    for kw in outer.keywords:
+        if kw.arg in pos or kw.arg in info.kwonly:
+            bound.add(kw.arg)
+        elif not info.has_kwarg:
+            yield Violation(
+                "TC02",
+                sf.path,
+                outer.lineno,
+                f"{label} `{info.name}` passes unknown keyword `{kw.arg}`",
+                end_line=outer.end_lineno,
+            )
+    required = pos[: len(pos) - info.n_pos_defaults] if info.n_pos_defaults else pos
+    missing = [p for p in required if p not in bound]
+    missing += [k for k in info.kwonly_required if k not in bound]
+    if missing:
+        yield Violation(
+            "TC02",
+            sf.path,
+            outer.lineno,
+            f"{label} `{info.name}` binds {len(bound)} of "
+            f"{len(required) + len(info.kwonly_required)} required parameters "
+            f"— missing: {', '.join(missing)} (the PR 2 perf_probe bug class)",
+            end_line=outer.end_lineno,
+        )
+
+
+def check_tc02(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    out: List[Violation] = []
+
+    for node in ast.walk(sf.tree):
+        # jax.jit(target, static_argnums=..., ...) expression sites.
+        if _is_jit_call(node, sf):
+            target = _jit_target(node)
+            info, drop_self = (
+                _resolve_target(target, sf, ctx) if target is not None
+                else (None, False)
+            )
+            if info is not None:
+                out.extend(
+                    _check_static_kwargs(
+                        node.keywords, info, drop_self, node.lineno, sf
+                    )
+                )
+        # Immediate invocation / .lower() of a jit expression: arity check.
+        if isinstance(node, ast.Call):
+            inner: Optional[ast.Call] = None
+            label = "call to jitted"
+            if _is_jit_call(node.func, sf):
+                inner = node.func  # jax.jit(f, ...)(args)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "lower"
+                and _is_jit_call(node.func.value, sf)
+            ):
+                inner = node.func.value  # jax.jit(f, ...).lower(args)
+                label = "`.lower()` of jitted"
+            if inner is not None:
+                target = _jit_target(inner)
+                if target is not None:
+                    info, drop_self = _resolve_target(target, sf, ctx)
+                    if info is not None:
+                        out.extend(
+                            _check_call_binding(node, info, drop_self, label, sf)
+                        )
+        # Decorator sites: @jax.jit(...) / @functools.partial(jax.jit, ...).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if not isinstance(deco, ast.Call):
+                    continue
+                resolved = resolve_dotted(deco.func, sf.aliases)
+                keywords = None
+                if resolved in JIT_NAMES and not deco.args:
+                    keywords = deco.keywords
+                elif (
+                    resolved in PARTIAL_NAMES
+                    and deco.args
+                    and resolve_dotted(deco.args[0], sf.aliases) in JIT_NAMES
+                ):
+                    keywords = deco.keywords
+                if keywords:
+                    info = FuncInfo.from_node(node, sf.path)
+                    out.extend(
+                        _check_static_kwargs(
+                            keywords, info, False, deco.lineno, sf
+                        )
+                    )
+    return iter(out)
+
+
+# ---------------------------------------------------------------------------
+# TC03
+# ---------------------------------------------------------------------------
+
+HOST_SYNC_CALLS = {
+    "jax.device_get": "copies the array to host, blocking the trace",
+    "numpy.asarray": "materialises the traced array on host",
+    "numpy.array": "materialises the traced array on host",
+}
+
+
+def _module_defs(sf: SourceFile) -> Dict[str, List[ast.AST]]:
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _fn_param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    out = [x.arg for x in a.posonlyargs + a.args]
+    if a.vararg:
+        out.append(a.vararg.arg)
+    out += [x.arg for x in a.kwonlyargs]
+    if a.kwarg:
+        out.append(a.kwarg.arg)
+    return out
+
+
+def _static_param_names(
+    fn: ast.AST, drop_self: bool, keywords: "Optional[List[ast.keyword]]"
+) -> "set[str]":
+    """Params marked static at the jit site — Python values under trace,
+    so concretising/branching on them is legal."""
+    pos = [x.arg for x in fn.args.posonlyargs + fn.args.args]
+    if drop_self and pos and pos[0] in ("self", "cls"):
+        pos = pos[1:]
+    out: set = set()
+    for kw in keywords or []:
+        if kw.arg == "static_argnums":
+            for i in _literal_ints(kw.value) or []:
+                if -len(pos) <= i < len(pos):
+                    out.add(pos[i])
+        elif kw.arg == "static_argnames":
+            out.update(_literal_strs(kw.value) or [])
+    return out
+
+
+#: Array properties that are static (plain Python values) under trace:
+#: branching or concretising on these is legal and common.
+STATIC_ACCESSOR_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+STATIC_ACCESSOR_CALLS = {
+    "jax.numpy.ndim",
+    "jax.numpy.shape",
+    "jax.numpy.size",
+    "jax.numpy.result_type",
+    "jax.eval_shape",
+}
+
+
+def _is_static_accessor(sub: ast.AST, sf: SourceFile) -> bool:
+    if isinstance(sub, ast.Attribute) and sub.attr in STATIC_ACCESSOR_ATTRS:
+        return True
+    if isinstance(sub, ast.Subscript):  # x.shape[0]
+        return _is_static_accessor(sub.value, sf)
+    return (
+        isinstance(sub, ast.Call)
+        and resolve_dotted(sub.func, sf.aliases) in STATIC_ACCESSOR_CALLS
+    )
+
+
+def _static_subtree_ids(node: ast.AST, sf: SourceFile) -> set:
+    """ids of every AST node under a static accessor (x.shape, jnp.ndim(x)).
+
+    A comparison with a static accessor on either side is static as a whole
+    (``x.dtype == jnp.int8`` compares two plain Python values), so the full
+    Compare subtree is exempted in that case.
+    """
+    exempt: set = set()
+    for sub in ast.walk(node):
+        if _is_static_accessor(sub, sf):
+            exempt.update(id(n) for n in ast.walk(sub))
+        elif isinstance(sub, ast.Compare) and any(
+            _is_static_accessor(s, sf) for s in [sub.left] + sub.comparators
+        ):
+            exempt.update(id(n) for n in ast.walk(sub))
+    return exempt
+
+
+def _traced_functions(sf: SourceFile) -> List[Tuple[ast.AST, "set[str]"]]:
+    """(node, static_param_names) for every function/lambda this module jits
+    or hands to lax control flow."""
+    defs = _module_defs(sf)
+    traced: Dict[int, list] = {}  # id(node) -> [node, static names]
+
+    def mark(node: ast.AST, statics: "set[str]") -> None:
+        entry = traced.setdefault(id(node), [node, set(statics)])
+        # Jitted at several sites: only params static at EVERY site are
+        # safely static.
+        entry[1] &= statics
+
+    def mark_target(target: ast.AST, keywords=None) -> None:
+        # Same-name defs in sibling scopes (factory functions) are all
+        # marked: a name jitted anywhere in the module is traced in every
+        # incarnation for our purposes.
+        if isinstance(target, ast.Lambda):
+            mark(target, _static_param_names(target, False, keywords))
+        elif isinstance(target, ast.Name):
+            for d in defs.get(target.id, []):
+                mark(d, _static_param_names(d, False, keywords))
+        elif isinstance(target, ast.Attribute):
+            for d in defs.get(target.attr, []):
+                mark(d, _static_param_names(d, True, keywords))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            resolved = resolve_dotted(node.func, sf.aliases)
+            if resolved in JIT_NAMES:
+                target = _jit_target(node)
+                if target is not None:
+                    mark_target(target, node.keywords)
+            elif resolved in TRACE_ENTRY_FN_ARGS:
+                # Only the function positions are traced — the carry/init
+                # args may share a name with a host-side def and must not
+                # drag it into the traced set.
+                for i in TRACE_ENTRY_FN_ARGS[resolved]:
+                    if i < len(node.args):
+                        mark_target(node.args[i])
+            elif (
+                resolved in PARTIAL_NAMES
+                and node.args
+                and resolve_dotted(node.args[0], sf.aliases) in JIT_NAMES
+                and len(node.args) > 1
+            ):
+                mark_target(node.args[1], node.keywords)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                resolved = resolve_dotted(deco, sf.aliases)
+                if resolved in JIT_NAMES:
+                    mark(node, set())
+                elif isinstance(deco, ast.Call):
+                    dres = resolve_dotted(deco.func, sf.aliases)
+                    if dres in JIT_NAMES or (
+                        dres in PARTIAL_NAMES
+                        and deco.args
+                        and resolve_dotted(deco.args[0], sf.aliases) in JIT_NAMES
+                    ):
+                        mark(node, _static_param_names(node, False, deco.keywords))
+    return [(entry[0], entry[1]) for entry in traced.values()]
+
+
+def check_tc03(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    reported: set = set()
+    out: List[Violation] = []
+
+    def report(line: int, msg: str, end_line=None) -> None:
+        if (line, msg) not in reported:
+            reported.add((line, msg))
+            out.append(Violation("TC03", sf.path, line, msg, end_line=end_line))
+
+    for fn, statics in _traced_functions(sf):
+        fn_name = getattr(fn, "name", "<lambda>")
+        traced_params = set(_fn_param_names(fn)) - statics
+
+        def _traced_mention(expr: ast.AST) -> bool:
+            """A jax value in a non-static position: either a jax-aliased
+            name or a traced parameter of this function."""
+            exempt = _static_subtree_ids(expr, sf)
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name) and id(sub) not in exempt:
+                    if sub.id in traced_params:
+                        return True
+                    origin = sf.aliases.get(
+                        sub.id, sub.id if sub.id == "jax" else ""
+                    )
+                    if origin.split(".")[0] == "jax":
+                        return True
+            return False
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    report(
+                        node.lineno,
+                        f"`.item()` inside traced `{fn_name}` forces a host "
+                        "round-trip every step",
+                        node.end_lineno,
+                    )
+                    continue
+                resolved = resolve_dotted(node.func, sf.aliases)
+                if resolved in HOST_SYNC_CALLS:
+                    report(
+                        node.lineno,
+                        f"`{resolved}` inside traced `{fn_name}` "
+                        f"{HOST_SYNC_CALLS[resolved]}",
+                        node.end_lineno,
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and node.func.id not in sf.aliases
+                    and len(node.args) == 1
+                    and _traced_mention(node.args[0])
+                ):
+                    report(
+                        node.lineno,
+                        f"`{node.func.id}(...)` on a traced value inside "
+                        f"`{fn_name}` is a concretisation error under "
+                        "jit (or a silent sync outside it)",
+                        node.end_lineno,
+                    )
+            elif isinstance(node, ast.If):
+                # `is`/`is not` never concretise (tracer identity is a
+                # host-side check, e.g. `if mask is not None`), so only
+                # value comparisons count.
+                for cmp_node in ast.walk(node.test):
+                    if (
+                        isinstance(cmp_node, ast.Compare)
+                        and any(
+                            not isinstance(op, (ast.Is, ast.IsNot))
+                            for op in cmp_node.ops
+                        )
+                        and _traced_mention(cmp_node)
+                    ):
+                        report(
+                            node.lineno,
+                            f"Python `if` over a traced comparison inside "
+                            f"`{fn_name}`; use `jnp.where`/`lax.cond`",
+                            node.test.end_lineno,
+                        )
+                        break
+    return iter(out)

@@ -1,0 +1,53 @@
+"""TC06: every literal metric name must be declared in METRICS_CATALOG.
+
+A typo'd gauge name (``engine_queue_dept``) doesn't fail anything — it
+silently splits the time series and every dashboard keyed on the real name
+reads zero.  ``utils/metrics.py`` carries the one catalogue of legal names;
+this rule checks each literal string handed to the registry's write
+(``inc``/``set_gauge``/``observe``) *and* read (``counter``/``gauge``/
+``percentile``/``rate``) methods against it — reads too, so the
+``/healthz`` payload can only report catalogued gauges.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+
+WRITE_METHODS = {"inc", "set_gauge", "observe"}
+READ_METHODS = {"counter", "gauge", "percentile", "rate"}
+
+
+def check_tc06(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    catalogue = ctx.metrics_names
+    if not catalogue:
+        return iter(())
+    out: List[Violation] = []
+    for node in ast.walk(sf.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in (WRITE_METHODS | READ_METHODS)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        name = node.args[0].value
+        if name not in catalogue:
+            kind = "write" if node.func.attr in WRITE_METHODS else "read"
+            out.append(
+                Violation(
+                    "TC06",
+                    sf.path,
+                    node.lineno,
+                    f"metric {kind} `{node.func.attr}(\"{name}\", ...)` uses "
+                    "a name not declared in utils.metrics.METRICS_CATALOG — "
+                    "a typo here silently splits the time series; declare it "
+                    "or fix the spelling",
+                    end_line=node.end_lineno,
+                )
+            )
+    return iter(out)

@@ -1,0 +1,204 @@
+"""TC05: MessageType dispatch exhaustiveness + typed-error code registry.
+
+A new frame type (FLOW was the last) lands by editing the enum; every
+``if msg.msg_type == MessageType.X`` ladder that silently drops unknown
+frames then mis-handles the new type with no trace.  The rule requires
+each dispatch ladder to either compare against every enum member or carry
+an explicit ``else`` acknowledging the remainder.
+
+The second half guards the typed ERROR vocabulary: ``typed_error`` codes
+and ``tunnel_code`` class attributes must come from
+``protocol.frames.ERROR_CODES`` — a free-string code would fail every
+peer's ``error_code()`` dispatch while looking fine locally.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from tools.tunnelcheck.core import (
+    ProjectContext,
+    SourceFile,
+    Violation,
+    resolve_dotted,
+)
+
+
+def _member_of(node: ast.AST, members: Set[str], sf: SourceFile) -> Optional[str]:
+    """"X" when node is ``<...>.MessageType.X`` — through import aliases too
+    (``from ...frames import MessageType as MT`` → ``MT.X``)."""
+    if not isinstance(node, ast.Attribute) or node.attr not in members:
+        return None
+    base = resolve_dotted(node.value, sf.aliases)
+    if base and base.split(".")[-1] == "MessageType":
+        return node.attr
+    return None
+
+
+def _members_in_test(
+    test: ast.AST, members: Set[str], sf: SourceFile
+) -> Tuple[Set[str], Set[str]]:
+    """(member names compared, dump of each subject expression).
+
+    The subject is the non-MessageType side (``msg.msg_type`` in
+    ``msg.msg_type == MessageType.X``): a ladder is one dispatch only when
+    every link tests the SAME subject.
+    """
+    found: Set[str] = set()
+    subjects: Set[str] = set()
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, rhs in zip(node.ops, node.comparators):
+            if isinstance(op, ast.Eq):
+                for side, other in ((node.left, rhs), (rhs, node.left)):
+                    m = _member_of(side, members, sf)
+                    if m:
+                        found.add(m)
+                        subjects.add(ast.dump(other))
+            elif isinstance(op, ast.In) and isinstance(rhs, (ast.Tuple, ast.List, ast.Set)):
+                for e in rhs.elts:
+                    m = _member_of(e, members, sf)
+                    if m:
+                        found.add(m)
+                        subjects.add(ast.dump(node.left))
+    return found, subjects
+
+
+def _elif_of(outer: ast.If) -> Optional[ast.If]:
+    """The ``elif`` continuing ``outer``, or None.
+
+    An ``elif`` is stored as a lone If in ``orelse`` at the SAME column as
+    its parent; an ``else:`` whose body happens to start with an ``if`` is
+    indented deeper and must count as an explicit default, not a link.
+    """
+    if (
+        len(outer.orelse) == 1
+        and isinstance(outer.orelse[0], ast.If)
+        and outer.orelse[0].col_offset == outer.col_offset
+    ):
+        return outer.orelse[0]
+    return None
+
+
+def _chain(head: ast.If) -> Tuple[List[ast.If], List[ast.stmt]]:
+    """All If links of an if/elif ladder plus the final ``else`` body."""
+    links = [head]
+    cur = head
+    while True:
+        nxt = _elif_of(cur)
+        if nxt is None:
+            return links, cur.orelse
+        cur = nxt
+        links.append(cur)
+
+
+def check_tc05(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    out: List[Violation] = []
+    members = set(ctx.message_types)
+
+    if members:
+        elif_links: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.If):
+                nxt = _elif_of(node)
+                if nxt is not None:
+                    elif_links.add(id(nxt))
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.If) or id(node) in elif_links:
+                continue
+            links, final_else = _chain(node)
+            handled: Set[str] = set()
+            subjects: Set[str] = set()
+            dispatch_links = 0
+            for link in links:
+                in_test, link_subjects = _members_in_test(
+                    link.test, members, sf
+                )
+                if in_test:
+                    dispatch_links += 1
+                    handled |= in_test
+                    subjects |= link_subjects
+            if dispatch_links < 2:
+                continue  # a lone guard (e.g. `!= HELLO` handshake check)
+            if len(subjects) > 1:
+                # Links compare DIFFERENT expressions against members —
+                # not one dispatch over a single frame's type.
+                continue
+            if final_else:
+                continue
+            missing = sorted(members - handled)
+            if missing:
+                out.append(
+                    Violation(
+                        "TC05",
+                        sf.path,
+                        node.lineno,
+                        "MessageType dispatch handles "
+                        f"{len(handled)}/{len(members)} members with no "
+                        f"`else` — unhandled: {', '.join(missing)}; add an "
+                        "explicit default branch or handle every member",
+                        end_line=node.test.end_lineno,
+                    )
+                )
+
+    codes = ctx.error_codes
+    if codes:
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "typed_error"
+            ):
+                code_node = None
+                if len(node.args) >= 2:
+                    code_node = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "code":
+                            code_node = kw.value
+                if (
+                    isinstance(code_node, ast.Constant)
+                    and isinstance(code_node.value, str)
+                    and code_node.value not in codes
+                ):
+                    out.append(
+                        Violation(
+                            "TC05",
+                            sf.path,
+                            node.lineno,
+                            f"typed_error code `{code_node.value}` is not in "
+                            "protocol.frames.ERROR_CODES "
+                            f"({', '.join(sorted(codes))}); register it "
+                            "there or reuse an existing code",
+                            end_line=node.end_lineno,
+                        )
+                    )
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            for target in targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "tunnel_code"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                    and value.value not in codes
+                ):
+                    out.append(
+                        Violation(
+                            "TC05",
+                            sf.path,
+                            node.lineno,
+                            f"tunnel_code `{value.value}` is not in "
+                            "protocol.frames.ERROR_CODES "
+                            f"({', '.join(sorted(codes))})",
+                            end_line=node.end_lineno,
+                        )
+                    )
+    return iter(out)
