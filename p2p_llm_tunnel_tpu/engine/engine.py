@@ -287,6 +287,17 @@ class InferenceEngine:
                 params = quantize_params_int4(
                     params, self.ecfg.quant_group_size
                 )
+            elif params["blocks"]["wq"].group_size != self.ecfg.quant_group_size:
+                # Pre-quantized injected tree wins: the config must reflect
+                # the weights actually served, or _prefix_snapshot_meta pins
+                # a group_size the KV bytes were never computed with.
+                actual = params["blocks"]["wq"].group_size
+                log.warning(
+                    "injected int4 tree uses group_size=%d; overriding "
+                    "configured quant_group_size=%d",
+                    actual, self.ecfg.quant_group_size,
+                )
+                self.ecfg = dc_replace(self.ecfg, quant_group_size=actual)
         elif self.ecfg.quant not in ("none", ""):
             raise ValueError(f"unknown quant mode {self.ecfg.quant!r}")
         if mesh is None and (

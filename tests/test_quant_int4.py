@@ -275,6 +275,29 @@ def test_engine_config_rejects_odd_group_size():
         _quantize4(jnp.ones((8, 8)), axis=-2, group_size=3)
 
 
+def test_engine_adopts_injected_tree_group_size(cpu_devices):
+    """An injected pre-quantized tree wins over the configured group size:
+    otherwise _prefix_snapshot_meta would pin a group_size the served
+    weights were never dequantized with, and a snapshot saved here would
+    be accepted by a genuinely different engine."""
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = quantize_params_int4(params, group_size=32)
+    eng = InferenceEngine(
+        model_cfg=cfg,
+        engine_cfg=EngineConfig(model="tiny", num_slots=2, max_seq=64,
+                                dtype="float32", quant="int4",
+                                quant_group_size=64),
+        params=qparams,
+    )
+    assert eng.params["blocks"]["wq"].group_size == 32
+    # _prefix_snapshot_meta reads ecfg.quant_group_size; the adopted value
+    # is what any snapshot pin will now record.
+    assert eng.ecfg.quant_group_size == 32
+
+
 def test_qtensor4_logical_shape():
     qt = _quantize4(jnp.ones((33, 5)), axis=-2, group_size=16)
     assert qt.shape == (33, 5)
