@@ -252,31 +252,30 @@ async def _handle_request_inner(
         else:
             status, headers, chunks = await backend(req, body)
     except asyncio.TimeoutError:
+        if deadline is None:
+            # No client budget was set, so this TimeoutError is the
+            # backend's own (e.g. http11's connect/read timeout) — an
+            # upstream failure, not a deadline expiry: 502, not 504, and
+            # the upstream-errors counter, not the timeouts one.
+            log.error("upstream request timed out for stream %d", stream_id)
+            global_metrics.inc("serve_upstream_errors_total")
+            await _send_simple(
+                channel, stream_id, 502, b"Bad Gateway: upstream timeout"
+            )
+            return
         log.warning("stream %d hit its %.0fms deadline before headers",
                     stream_id, dl_ms)
         global_metrics.inc("serve_timeouts_total")
-        await channel.send(
-            TunnelMessage.res_headers(
-                ResponseHeaders(stream_id, 504, {"content-type": "text/plain"})
-            ).encode()
+        await _send_simple(
+            channel, stream_id, 504, b"Gateway Timeout: deadline exceeded"
         )
-        await channel.send(
-            TunnelMessage.res_body(stream_id, b"Gateway Timeout: deadline exceeded").encode()
-        )
-        await channel.send(TunnelMessage.res_end(stream_id).encode())
         return
     except Exception as e:
         log.error("upstream request failed for stream %d: %s", stream_id, e)
         global_metrics.inc("serve_upstream_errors_total")
-        await channel.send(
-            TunnelMessage.res_headers(
-                ResponseHeaders(stream_id, 502, {"content-type": "text/plain"})
-            ).encode()
+        await _send_simple(
+            channel, stream_id, 502, f"Bad Gateway: {e}".encode()
         )
-        await channel.send(
-            TunnelMessage.res_body(stream_id, f"Bad Gateway: {e}".encode()).encode()
-        )
-        await channel.send(TunnelMessage.res_end(stream_id).encode())
         return
 
     await channel.send(
@@ -305,17 +304,28 @@ async def _handle_request_inner(
             for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
                 await channel.send(frame)
     except asyncio.TimeoutError:
-        # Deadline blown mid-stream: truncate with a TYPED error frame so
-        # protocol-aware peers can distinguish a timeout from an upstream
-        # crash (the reference's ERROR payload is free text).
-        log.warning("stream %d hit its %.0fms deadline mid-stream",
-                    stream_id, dl_ms)
-        global_metrics.inc("serve_timeouts_total")
-        await channel.send(
-            TunnelMessage.typed_error(
-                stream_id, "timeout", "deadline exceeded"
-            ).encode()
-        )
+        if deadline is None:
+            # A backend-internal timeout mid-stream (no client budget set):
+            # report it as the upstream failure it is.
+            log.error("upstream stream timed out for stream %d", stream_id)
+            global_metrics.inc("serve_upstream_errors_total")
+            await channel.send(
+                TunnelMessage.error(
+                    stream_id, "upstream error: timeout"
+                ).encode()
+            )
+        else:
+            # Deadline blown mid-stream: truncate with a TYPED error frame
+            # so protocol-aware peers can distinguish a timeout from an
+            # upstream crash (the reference's ERROR payload is free text).
+            log.warning("stream %d hit its %.0fms deadline mid-stream",
+                        stream_id, dl_ms)
+            global_metrics.inc("serve_timeouts_total")
+            await channel.send(
+                TunnelMessage.typed_error(
+                    stream_id, "timeout", "deadline exceeded"
+                ).encode()
+            )
     except Exception as e:
         # Upstream dropped mid-stream — truncate with an ERROR frame
         # (serve.rs:278-284); the proxy ends the HTTP body without an error.

@@ -167,6 +167,51 @@ def test_deadline_before_headers_sends_504():
     asyncio.run(main())
 
 
+def test_upstream_timeout_without_deadline_is_a_502_not_504():
+    """A backend-internal asyncio.TimeoutError (http11 connect/read
+    timeout) with NO client budget set is an upstream failure: 502 +
+    serve_upstream_errors_total — not a 504 deadline expiry, which would
+    skew both counters and log `%.0f` of a None dl_ms."""
+    async def main():
+        async def backend(req, body):
+            raise asyncio.TimeoutError
+
+        before_up = global_metrics.counter("serve_upstream_errors_total")
+        before_to = global_metrics.counter("serve_timeouts_total")
+        serve_task, ch, client = await _stack(backend)
+        try:
+            r = await client.wait(await client.request("GET", "/gen"), 10.0)
+            assert r.status == 502
+            assert b"timeout" in bytes(r.body)
+            assert global_metrics.counter("serve_upstream_errors_total") == before_up + 1
+            assert global_metrics.counter("serve_timeouts_total") == before_to
+        finally:
+            await _teardown(serve_task, ch, client)
+
+    asyncio.run(main())
+
+
+def test_upstream_timeout_mid_stream_without_deadline_is_untyped():
+    async def main():
+        async def chunks():
+            yield b"tok0 "
+            raise asyncio.TimeoutError
+
+        async def backend(req, body):
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        serve_task, ch, client = await _stack(backend)
+        try:
+            r = await client.wait(await client.request("GET", "/gen"), 10.0)
+            assert r.status == 200
+            assert r.error is not None and "upstream" in r.error
+            assert r.error_code is None  # not the typed [timeout] frame
+        finally:
+            await _teardown(serve_task, ch, client)
+
+    asyncio.run(main())
+
+
 def test_no_deadline_stream_completes():
     async def main():
         serve_task, ch, client = await _stack(_slow_stream_backend(0.0, 5))
