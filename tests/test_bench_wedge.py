@@ -94,19 +94,30 @@ class TestFinalize:
         )
         assert "best_banked_tpu" not in parent["secondary"]
 
-    def test_banked_row_accepts_legacy_rows_and_bad_values(
+    def test_banked_row_excludes_legacy_rows_and_bad_values(
             self, bench_mod, tmp_path):
+        """Rows without an explicit platform=="tpu" must never be surfaced
+        as the best on-chip datapoint (the CPU-as-TPU misreporting VERDICT
+        r4 item 3 forbids), and null values must not crash selection."""
         log = tmp_path / "sweep.jsonl"
         log.write_text("\n".join([
-            # Pre-platform-field row (r4 on-chip): must count.
+            # Pre-platform-field row (r4 on-chip): provenance unknown, so
+            # it must NOT count even though its value is the largest.
             json.dumps({"value": 1684.78, "sweep_label": "legacy",
                         "unit": "tok/s", "vs_baseline": 0.936}),
             # Error-free row with null value: must not crash selection.
             json.dumps({"platform": "tpu", "value": None,
                         "sweep_label": "nullval"}),
+            json.dumps({"platform": "tpu", "value": 1500.0,
+                        "sweep_label": "attested"}),
         ]))
         best = bench_mod._best_banked_tpu_row(str(log))
-        assert best["sweep_label"] == "legacy"
+        assert best["sweep_label"] == "attested"
+        # Legacy + bad rows alone: no attested on-chip row exists.
+        log.write_text(json.dumps(
+            {"value": 1684.78, "sweep_label": "legacy"}
+        ))
+        assert bench_mod._best_banked_tpu_row(str(log)) is None
 
     def test_secondary_finalized_recursively(self, bench_mod):
         row = {
