@@ -6,8 +6,13 @@
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
 
-lint:              ## tunnelcheck: static invariants (async-blocking, jit drift, ...)
+lint:              ## tunnelcheck static invariants + test-collection guard
 	python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests bench.py __graft_entry__.py
+	@# Collection guard (ISSUE 4): collect ALL of tests/ — slow marks
+	@# included — so a slow-tier test file that stops importing fails HERE
+	@# instead of rotting uncollected (test_bench_wedge sat broken for two
+	@# PRs because tier-1 deselects slow and ignores what it never collects).
+	JAX_PLATFORMS=cpu python -m pytest tests/ -qq --collect-only -p no:cacheprovider
 
 native-san:        ## ASan+UBSan self-tests of the C++ codec + ARQ core
 	scripts/build-native.sh sanitize

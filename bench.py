@@ -165,15 +165,19 @@ async def _run_attempt(model: str) -> dict:
     pf8 = (os.environ.get("BENCH_PREFILL_ACT_QUANT", "1") == "1"
            and quant == "int8")
     kv_quant = os.environ.get("BENCH_KV_QUANT", "none")
-    # BENCH_FLASH_SGRID implies flash decode (the S-gridded variant), and
-    # COMPOSES with an int8 KV cache (the kernel dequantizes in VMEM); the
-    # plane kernel still requires raw bf16 K/V, so an int8 cache forces
-    # the einsum path when only BENCH_FLASH_DECODE is set.
+    # BENCH_FLASH_SGRID implies flash decode; as of ISSUE 4 BOTH flags
+    # route to the s-grid kernel family, which composes with every
+    # kv_quant mode (in-VMEM dequant) — the legacy plane kernel is no
+    # longer reachable, so the old "int8 cache forces the einsum path
+    # under bare BENCH_FLASH_DECODE" carve-out is gone.
     flash_sgrid = os.environ.get("BENCH_FLASH_SGRID", "0") == "1"
-    flash_decode = flash_sgrid or (
-        os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
-        and kv_quant != "int8"
+    flash_decode = (
+        flash_sgrid or os.environ.get("BENCH_FLASH_DECODE", "0") == "1"
     )
+    # The fused decode-layer kernel (ISSUE 4): supersedes the flash
+    # selection when set — rope + KV quant + cache append + attention in
+    # one program per layer.
+    fused_decode = os.environ.get("BENCH_FUSED_DECODE", "0") == "1"
     # Automatic prefix caching — on by default here AND in the serve CLI
     # (TUNNEL_PREFIX_CACHE), so the benched config is the deployed default.
     # The bench prompts share a prefix the way real traffic shares system
@@ -218,7 +222,7 @@ async def _run_attempt(model: str) -> dict:
             prefill_rows=prefill_rows, quant=quant,
             quant_group_size=quant_group,
             prefill_act_quant=pf8, flash_decode=flash_decode,
-            flash_sgrid=flash_sgrid,
+            flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
         ),
@@ -380,8 +384,15 @@ async def _run_attempt(model: str) -> dict:
         "kv_quant": kv_quant,
         "flash_decode": flash_decode,
         "flash_sgrid": flash_sgrid,
-        "prefix_cache": prefix_cache,
-        "spec_ngram": spec_ngram,
+        "fused_decode_layer": fused_decode,
+        "decode_kernels_per_step": global_metrics.gauge(
+            "engine_decode_kernels_per_step"
+        ),
+        # EFFECTIVE knobs, read back from the engine: kv_quant=int4
+        # disables prefix cache / spec decode internally, and a row that
+        # claims the requested value would misattribute the number.
+        "prefix_cache": engine._prefix is not None,
+        "spec_ngram": engine.ecfg.spec_ngram,
         "prefix_hit_tokens": global_metrics.counter(
             "engine_prefix_hit_tokens_total"
         ),

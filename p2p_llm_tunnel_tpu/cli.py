@@ -120,10 +120,12 @@ def build_parser() -> argparse.ArgumentParser:
                        default=int(_env("TUNNEL_QUANT_GROUP_SIZE", "128")),
                        help="int4 scale group size (contracted positions "
                             "per f32 scale; must be even)")
-    serve.add_argument("--kv-quant", choices=("none", "int8"),
+    serve.add_argument("--kv-quant", choices=("none", "int8", "int4"),
                        default=_env("TUNNEL_KV_QUANT", "none"),
-                       help="KV-cache quantization (halves the long-context "
-                            "KV read term)")
+                       help="KV-cache quantization (int8 halves, int4 "
+                            "quarters the long-context KV read term; int4 "
+                            "disables prefix cache / chunked prefill / "
+                            "spec decode)")
     serve.add_argument("--prefill-act-quant",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFILL_ACT_QUANT", "") == "1",
@@ -143,6 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="with --flash-decode: the S-gridded kernel "
                             "variant (per-block DMA, frontier-clamped "
                             "fetches, no view cap)")
+    serve.add_argument("--fused-decode-layer",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_FUSED_DECODE", "") == "1",
+                       help="fused decode-layer Pallas kernel: rope + "
+                            "new-row KV quant + in-place cache append + "
+                            "attention in ONE program per layer (collapses "
+                            "the per-step launch storm; composes with "
+                            "every --quant/--kv-quant)")
     serve.add_argument("--prefill-chunk", type=int,
                        default=int(_env("TUNNEL_PREFILL_CHUNK", "0")),
                        help="chunked prefill: prompts longer than this many "
@@ -404,6 +414,7 @@ async def _engine_backend(args):
                     prefill_act_quant=args.prefill_act_quant,
                     flash_decode=args.flash_decode,
                     flash_sgrid=args.flash_sgrid,
+                    fused_decode_layer=args.fused_decode_layer,
                     prefix_cache=args.prefix_cache,
                     prefix_cache_dir=pfx_dir,
                     spec_ngram=args.spec_ngram,

@@ -375,6 +375,15 @@ async def _send_healthz(
         "queue_depth": int(global_metrics.gauge("engine_queue_depth")),
         "slot_occupancy": global_metrics.gauge("engine_batch_occupancy"),
         "inflight_requests": inflight,
+        # ISSUE 4 observability: the decode program's launch profile and
+        # the warmup compile bill — fused-path regressions show up here
+        # without a chip window (0 = probe unavailable on this host).
+        "decode_kernels_per_step": int(
+            global_metrics.gauge("engine_decode_kernels_per_step")
+        ),
+        "warmup_compile_s": round(
+            global_metrics.gauge("engine_warmup_compile_s"), 1
+        ),
     }
     await _send_simple(
         channel, stream_id, 200 if state == "ok" else 503,

@@ -107,9 +107,15 @@ class EngineConfig:
     # channel.  Smaller = more accurate, more scale traffic; 128 matches
     # the GPTQ/AWQ convention and keeps scale overhead at 1/32 of packed q.
     quant_group_size: int = 128
-    # KV-cache quantization: "none" | "int8" (per-token-per-head scales).
-    # Halves the KV read term that dominates long-context decode HBM
-    # traffic; attention dequant fuses into the einsum operand read.
+    # KV-cache quantization: "none" | "int8" (per-token-per-head scales) |
+    # "int4" (two adjacent tokens packed per byte along the sequence axis,
+    # per-token-per-head scales — quarters the KV stream).  Halves (or
+    # quarters) the KV read term that dominates long-context decode HBM
+    # traffic; dequant fuses into the einsum operand read or runs in VMEM
+    # inside the Pallas kernels.  int4 scope limits: the packed sequence
+    # axis cannot take byte-misaligned chunk writes, so prefix_cache,
+    # prefill_chunk, and spec_ngram are disabled under it (warned at
+    # startup).
     kv_quant: str = "none"
     # Use the Pallas decode-attention kernel on TPU-tileable shapes
     # (models/config.py flash_decode).  Off by default pending on-hardware
@@ -117,8 +123,17 @@ class EngineConfig:
     flash_decode: bool = False
     # S-gridded flash decode (models/config.py flash_sgrid): per-block DMA
     # with frontier-clamped fetches; the variant to measure when the plane
-    # kernel's whole-view DMA loses on chip (VERDICT r4 item 2).
+    # kernel's whole-view DMA loses on chip (VERDICT r4 item 2).  As of
+    # ISSUE 4, flash_decode and flash_sgrid both select the s-grid family
+    # (the plane kernel is an interpret-mode cross-check only).
     flash_sgrid: bool = False
+    # Fused decode-layer Pallas kernel (ISSUE 4): one program per layer
+    # fuses rope + new-row KV quantization + the cache append + the
+    # frontier-clamped attention, collapsing the per-step launch storm
+    # (~4k launches per 32-layer × 16-step burst).  Composes with every
+    # kv_quant mode and weight quant in one program.  Off by default
+    # until chip-measured; oracle-pinned in tests/test_fused_decode_layer.
+    fused_decode_layer: bool = False
     # With quant="int8": ALSO run activations int8 during PREFILL only.
     # Prefill is MXU-compute-bound (hundreds of tokens per row) where int8
     # doubles throughput; decode stays weight-only (it is HBM-bound, w8a8
@@ -224,6 +239,9 @@ class InferenceEngine:
             self.mcfg = dc_replace(self.mcfg, flash_decode=True)
         if self.ecfg.flash_sgrid and not self.mcfg.flash_sgrid:
             self.mcfg = dc_replace(self.mcfg, flash_sgrid=True)
+        # Same one-directional promotion for the fused decode-layer kernel.
+        if self.ecfg.fused_decode_layer and not self.mcfg.fused_decode_layer:
+            self.mcfg = dc_replace(self.mcfg, fused_decode_layer=True)
         if self.ecfg.sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown sp_mode {self.ecfg.sp_mode!r}")
         if self.ecfg.sp_mode != "ring" and self.mcfg.sp_mode != self.ecfg.sp_mode:
@@ -335,10 +353,23 @@ class InferenceEngine:
         # scatter into, so batched prefill never corrupts a live slot.
         rows = b + 1
         self._scratch_slot = b
-        if self.ecfg.kv_quant not in ("none", "", "int8"):
+        if self.ecfg.kv_quant not in ("none", "", "int8", "int4"):
             raise ValueError(f"unknown kv_quant mode {self.ecfg.kv_quant!r}")
+        if self.ecfg.kv_quant == "int4":
+            # The packed sequence axis cannot take byte-misaligned partial
+            # writes, and every chunk-prefill consumer writes at arbitrary
+            # starts (transformer.chunk_prefill_into_cache rejects int4
+            # caches outright).  Disable them rather than silently corrupt.
+            for knob, off in (("prefix_cache", False), ("prefill_chunk", 0),
+                              ("spec_ngram", 0)):
+                if getattr(self.ecfg, knob):
+                    log.warning(
+                        "%s disabled: not supported with kv_quant='int4'",
+                        knob,
+                    )
+                    self.ecfg = dc_replace(self.ecfg, **{knob: off})
         self.kv_cache = init_kv_cache(
-            self.mcfg, rows, s, dtype, quant=self.ecfg.kv_quant == "int8"
+            self.mcfg, rows, s, dtype, quant=self.ecfg.kv_quant
         )
         if self.mesh is not None:
             from p2p_llm_tunnel_tpu.parallel.sharding import shard_kv_cache
@@ -808,6 +839,7 @@ class InferenceEngine:
         steps = {self.ecfg.decode_steps}
         if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
             steps.add(self.ecfg.decode_steps_eager)
+        t_warm0 = time.monotonic()
         await self._warm_aot_parallel(loop, views, sorted(steps))
         t0 = time.monotonic()
         self._warming = True
@@ -857,6 +889,68 @@ class InferenceEngine:
                         self._executor, self._warm_chunk_program,
                         self.ecfg.prefill_chunk, view,
                     )
+        # Observability (ISSUE 4): total warmup compile wall time — with
+        # the fused path's extra variants this is the number a ~minutes
+        # chip window has to fit before serving — and the launch-count
+        # gauge, both surfaced by serve's /healthz.
+        global_metrics.set_gauge(
+            "engine_warmup_compile_s", time.monotonic() - t_warm0
+        )
+        await loop.run_in_executor(self._executor, self._set_kernel_gauge)
+
+    def decode_launch_report(self, view: Optional[int] = None,
+                             steps: Optional[int] = None):
+        """Launch-proxy counts of the decode-burst program, counted on the
+        REAL TPU lowering (cross-lowered from any host — utils/hlo.py), or
+        None when this host cannot lower it.
+
+        Host-side lowering only, nothing executes.  The engine's mcfg is
+        momentarily swapped for a lowering-only variant (interpret off,
+        flash_force on) so the counted program is the one a TPU backend
+        would run even when this process serves the CPU/interpret path;
+        callers are single-threaded by construction (warmup before
+        serving; perf_probe before its measurement loop).  The ONE home of
+        the jit-signature + warm-args recipe, shared with
+        scripts/perf_probe.py — a second hand-rolled copy there is the
+        TC02 stale-signature incident class.
+        """
+        self._ensure_decode_carry()
+        old = self.mcfg
+        self.mcfg = dc_replace(
+            self.mcfg, flash_interpret=False, flash_force=True
+        )
+        try:
+            from p2p_llm_tunnel_tpu.utils.hlo import (
+                decode_launch_report as _report,
+            )
+
+            return _report(
+                jax.jit(self._decode_fn, static_argnums=(11, 12)),
+                *self._decode_warm_args(
+                    self._warmup_views()[0] if view is None else view,
+                    self.ecfg.decode_steps if steps is None else steps,
+                ),
+            )
+        finally:
+            self.mcfg = old
+
+    def _set_kernel_gauge(self) -> None:
+        """Publish ``engine_decode_kernels_per_step``: launch-proxy major
+        kernels in the layer-scan body of the decode burst
+        (:meth:`decode_launch_report`)."""
+        report = self.decode_launch_report()
+        if report is None or not report["layer_body_major"]:
+            log.info("decode launch-count probe unavailable on this host")
+            return
+        global_metrics.set_gauge(
+            "engine_decode_kernels_per_step", report["layer_body_major"]
+        )
+        log.info(
+            "decode burst launch profile: %d major kernels per layer-step "
+            "(%d ops; %d pallas calls)",
+            report["layer_body_major"], report["layer_body_ops"],
+            report["layer_body_pallas"],
+        )
 
     def _warmup_views(self) -> List[int]:
         """View buckets warmup precompiles.  ``TUNNEL_WARMUP_VIEW_CAP=<n>``
@@ -1174,12 +1268,12 @@ class InferenceEngine:
                 valid[i, : len(p)] = True
 
             def run(tokens=tokens, valid=valid):
-                out = self._jit_embed(
+                out = self._jit_embed(  # tunnelcheck: disable=TC07  one dispatch per prefill_rows-wide sub-batch, not per prompt
                     self.params, jnp.asarray(tokens), jnp.asarray(valid)
                 )
                 return np.asarray(out)
 
-            out = await loop.run_in_executor(self._executor, run)
+            out = await loop.run_in_executor(self._executor, run)  # tunnelcheck: disable=TC07  sub-batch granularity as above
             outs.append(out[: len(chunk)])
         return np.concatenate(outs, axis=0)
 
@@ -1745,7 +1839,7 @@ class InferenceEngine:
             for j, (t, v) in enumerate(lb[: self.BIAS_CAP]):
                 ids[j] = t
                 vals[j] = v
-            self._bias = self._jit_set_bias(
+            self._bias = self._jit_set_bias(  # tunnelcheck: disable=TC07  one tiny scatter per BIASED slot only; bias-free admissions skip the body
                 self._bias, i, jnp.asarray(ids), jnp.asarray(vals)
             )
             self._slot_bias_on[i] = bool(lb)
@@ -1946,7 +2040,7 @@ class InferenceEngine:
             slots, pids, bnos = pad_rows(
                 entries, pr, self._prefix_max_blocks, scratch=None
             )
-            self.kv_cache = self._copy_in(
+            self.kv_cache = self._copy_in(  # tunnelcheck: disable=TC07  ONE dispatch per prefill_rows-wide sub-batch: this batching IS the r5 fix
                 self.kv_cache, self._pool, slots, pids, bnos
             )
 
@@ -1971,7 +2065,7 @@ class InferenceEngine:
                 entries[lo : lo + pr], pr, self._prefix_max_blocks,
                 scratch=0,
             )
-            self._pool = self._copy_out(
+            self._pool = self._copy_out(  # tunnelcheck: disable=TC07  ONE dispatch per prefill_rows-wide sub-batch, off the TTFT-critical path
                 self._pool, self.kv_cache, slots, pids, bnos
             )
         if total:
@@ -2030,7 +2124,7 @@ class InferenceEngine:
                     self._segmented[run.slot] = (run, hist)
                     admitted.remove(run)
             if seg_hits:
-                await loop.run_in_executor(
+                await loop.run_in_executor(  # tunnelcheck: disable=TC07  one call for the WHOLE wave's segment hits; batches internally by prefill_rows
                     self._executor, self._prefix_copy_in, seg_hits
                 )
         # Group by (tail bucket, cached?): cached runs use the chunk-prefill
@@ -2058,12 +2152,12 @@ class InferenceEngine:
         for t, cached, echo, runs in chunked:
             t0 = time.monotonic()
             if cached:
-                await loop.run_in_executor(
+                await loop.run_in_executor(  # tunnelcheck: disable=TC07  one copy call per prefill_rows-wide chunk, dispatched before that chunk's prefill (same executor, same device order)
                     self._executor, self._prefix_copy_in,
                     [(run.slot, pool_ids_of[run.slot]) for run in runs],
                 )
             hists = [hist_of[r.slot] for r in runs] if cached else None
-            first_dev = await loop.run_in_executor(
+            first_dev = await loop.run_in_executor(  # tunnelcheck: disable=TC07  one dispatch per prefill_rows-wide bucket chunk, back-to-back so chunk n+1 computes under chunk n's RTT
                 self._executor, self._dispatch_prefill_batch, runs, t, hists,
                 echo,
             )
@@ -2073,7 +2167,7 @@ class InferenceEngine:
             firsts, lp, plp = await loop.run_in_executor(
                 self._executor,
                 lambda fd=first_dev: jax.tree.map(np.asarray,
-                                                  jax.device_get(fd)),
+                                                  jax.device_get(fd)),  # tunnelcheck: disable=TC07  one FETCH per already-dispatched chunk, in dispatch order: the pipelining that overlaps the RTT with compute
             )
             # Wall time of this chunk's dispatch → result-on-host span, the
             # per-phase timing SURVEY §5 asks for (overlaps siblings').

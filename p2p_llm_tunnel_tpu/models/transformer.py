@@ -91,15 +91,39 @@ def init_params(
 
 def init_kv_cache(
     cfg: ModelConfig, num_slots: int, max_seq: int, dtype=jnp.bfloat16,
-    quant: bool = False,
+    quant=False,
 ) -> KVCache:
-    """Slot cache; ``quant=True`` stores int8 values + per-(token, head)
-    fp32 scales — halves the KV read term that dominates decode HBM traffic
-    at long context (the KV analog of weight-only int8; scales add 1/D of
-    the saving back)."""
+    """Slot cache; ``quant`` selects the storage precision.
+
+    ``True``/``"int8"`` stores int8 values + per-(token, head) fp32 scales
+    — halves the KV read term that dominates decode HBM traffic at long
+    context (the KV analog of weight-only int8; scales add 1/D of the
+    saving back).  ``"int4"`` (ISSUE 4) packs TWO ADJACENT TOKENS per int8
+    byte along the sequence axis (token 2i low nibble, 2i+1 high — the
+    models.quant.pack_int4(axis) layout), quartering the KV stream; the
+    scale planes stay per-token full resolution.  ``max_seq`` must be even
+    for int4 (every serving bucket is)."""
     shape = (cfg.n_layers, num_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
-    if not quant:
+    _modes = {False: None, True: "int8", None: None, "none": None, "": None,
+              "int8": "int8", "int4": "int4"}
+    if quant not in _modes:
+        raise ValueError(
+            f"unknown KV quant mode {quant!r}; expected one of "
+            "False/True/None/'none'/'int8'/'int4'"
+        )
+    mode = _modes[quant]
+    if mode is None:
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if mode == "int4":
+        if max_seq % 2:
+            raise ValueError(f"int4 KV cache needs an even max_seq, got {max_seq}")
+        packed = shape[:2] + (max_seq // 2,) + shape[3:]
+        return {
+            "k": jnp.zeros(packed, jnp.int8),
+            "v": jnp.zeros(packed, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1], jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, jnp.int8),
         "v": jnp.zeros(shape, jnp.int8),
@@ -112,6 +136,16 @@ def kv_cache_is_quantized(kv_cache: KVCache) -> bool:
     return "k_scale" in kv_cache
 
 
+def kv_cache_quant_mode(kv_cache: KVCache) -> Optional[str]:
+    """None | "int8" | "int4" — int4 is recognized by its byte-packed
+    sequence axis (half the scale plane's)."""
+    if "k_scale" not in kv_cache:
+        return None
+    if kv_cache["k"].shape[2] * 2 == kv_cache["k_scale"].shape[2]:
+        return "int4"
+    return "int8"
+
+
 def _quant_kv(x: jnp.ndarray):
     """Symmetric int8 over the trailing head_dim axis → (q, scale).
 
@@ -121,6 +155,17 @@ def _quant_kv(x: jnp.ndarray):
 
     q, scale = _quantize_act(x)
     return q, scale[..., 0]
+
+
+def _quant_kv4(x: jnp.ndarray):
+    """Symmetric int4 over the trailing head_dim axis → (q in [-7, 7] as
+    int8 VALUES — caller packs — and per-(token, head) scale).  The same
+    formula the fused decode kernel applies in VMEM: any drift between
+    the two breaks fused/unfused token identity."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -7, 7)
+    return q.astype(jnp.int8), scale[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -147,7 +192,10 @@ def _mlp(cfg: ModelConfig, blk, h):
     return mm(gate, blk["w_down"], aq)
 
 
-def _qkv(cfg: ModelConfig, blk, h, positions):
+def _qkv_proj(cfg: ModelConfig, blk, h):
+    """QKV projections + bias + head split, NO rope — the fused decode
+    kernel applies rope in VMEM at each slot's position, so the decode
+    fused path consumes these directly."""
     b, t, _ = h.shape
     aq = cfg.act_quant
     q = mm(h, blk["wq"], aq)
@@ -160,6 +208,11 @@ def _qkv(cfg: ModelConfig, blk, h, positions):
     q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
     k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _qkv(cfg: ModelConfig, blk, h, positions):
+    q, k, v = _qkv_proj(cfg, blk, h)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
@@ -443,12 +496,34 @@ def prefill_into_cache(
         )
 
     # [L,Bp,T,K,D] → scatter over slot axis of [L,Slots,S,K,D]
-    s_max = kv_cache["k"].shape[2]
+    quant_mode = kv_cache_quant_mode(kv_cache)
+    s_max = kv_cache["k"].shape[2] * (2 if quant_mode == "int4" else 1)
     ks = ks[:, :, :s_max]
     vs = vs[:, :, :s_max]
     t_w = ks.shape[2]
     out = dict(kv_cache)
-    if kv_cache_is_quantized(kv_cache):
+    if quant_mode == "int4":
+        from p2p_llm_tunnel_tpu.models.quant import pack_int4
+
+        kq, k_s = _quant_kv4(ks)
+        vq, v_s = _quant_kv4(vs)
+        if t_w % 2:
+            # Packing needs an even token count: pad one junk position.
+            # It sits at index >= the prompt length, so decode overwrites
+            # it (whole byte when that position is even-aligned) before it
+            # is ever attendable — the standard prefill-pad argument.
+            pad = ((0, 0), (0, 0), (0, 1), (0, 0), (0, 0))
+            kq = jnp.pad(kq, pad)
+            vq = jnp.pad(vq, pad)
+        out["k"] = kv_cache["k"].at[:, slots, : (t_w + 1) // 2].set(
+            pack_int4(kq, axis=2)
+        )
+        out["v"] = kv_cache["v"].at[:, slots, : (t_w + 1) // 2].set(
+            pack_int4(vq, axis=2)
+        )
+        out["k_scale"] = kv_cache["k_scale"].at[:, slots, :t_w].set(k_s)
+        out["v_scale"] = kv_cache["v_scale"].at[:, slots, :t_w].set(v_s)
+    elif quant_mode == "int8":
         kq, k_s = _quant_kv(ks)
         vq, v_s = _quant_kv(vs)
         out["k"] = kv_cache["k"].at[:, slots, :t_w].set(kq)
@@ -505,6 +580,15 @@ def chunk_prefill_into_cache(
 
     Returns last-real-tail-token logits [Bp, V] and the updated cache.
     """
+    if kv_cache_quant_mode(kv_cache) == "int4":
+        # Tail starts are arbitrary positions: a packed write would need
+        # byte-aligned read-modify-writes per token.  The engine disables
+        # every chunk-prefill consumer (prefix cache, segments, spec)
+        # under kv_quant="int4" — whole-prompt prefill + decode cover it.
+        raise NotImplementedError(
+            "chunk_prefill_into_cache does not support the packed int4 "
+            "KV cache; the engine gates its callers off under kv_quant='int4'"
+        )
     b, t = tokens.shape
     s = kv_cache["k"].shape[2]
     if kv_view is None or kv_view > s:
@@ -616,7 +700,10 @@ def decode_step(
     later reads exactly what was written.
     """
     b = tokens.shape[0]
-    s = kv_cache["k"].shape[2]
+    quant_mode = kv_cache_quant_mode(kv_cache)
+    quant = quant_mode is not None
+    # Logical sequence length: the int4 cache's sequence axis is byte-packed.
+    s = kv_cache["k"].shape[2] * (2 if quant_mode == "int4" else 1)
     if kv_view is None or kv_view > s:
         kv_view = s
     x = _embed(cfg, params, tokens[:, None])  # [B,1,Dm]
@@ -624,49 +711,77 @@ def decode_step(
     layer_idx = jnp.arange(cfg.n_layers)
     slot_ids = jnp.arange(b)
 
-    # Flash-decode gating beyond the config flag:
+    # Pallas gating beyond the config flags:
     # - tp>1 falls back to the einsum path: pallas_call is not GSPMD-
     #   partitioned, so under a tp mesh XLA would all-gather the sharded
     #   q/KV onto every chip (the hazard prefill's flash_tp shard_map
     #   wrapper exists for — apply the same wrapper here before enabling);
-    # - bound the staged K/V planes to the VMEM budget: this kernel stages
-    #   the full [view, D] K and V per (slot, kv-head) program, so the
-    #   per-slot frontier skips COMPUTE but not the HBM→VMEM DMA; very
-    #   large views must use the einsum path (or a future S-gridded kernel).
-    quant = kv_cache_is_quantized(kv_cache)
+    # - shapes must tile (view and head_dim % 128) unless interpreting.
     tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-    flash_base = (
-        cfg.flash_decode
-        and (jax.default_backend() == "tpu" or cfg.flash_interpret)
+    kernel_ok = (
+        (jax.default_backend() == "tpu" or cfg.flash_interpret
+         or cfg.flash_force)
         and tp == 1
         and kv_view % 128 == 0
         and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
     )
-    # int8 KV composes ONLY with the s-gridded kernel (it dequantizes in
-    # VMEM); the plane kernel and the legacy path read raw bf16.
-    use_sgrid_q = flash_base and cfg.flash_sgrid and quant
-    # The S-gridded kernel has no view cap (per-block DMA); the plane
-    # kernel must bound its whole-view staging to the VMEM budget.
-    use_sgrid = flash_base and cfg.flash_sgrid and not quant
-    use_flash = (
-        flash_base and not cfg.flash_sgrid and not quant
-        and kv_view * cfg.head_dim <= 8192 * 128
-    )
-    if use_sgrid_q:
+    # The FUSED decode-layer kernel (ISSUE 4): rope + new-row quant +
+    # cache append + frontier-clamped attention in one program per layer.
+    # Supersedes the flash selection further below when enabled.
+    use_fused = cfg.fused_decode_layer and kernel_ok
+    if use_fused:
         from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
-            flash_decode_attention_sgrid_int8,
+            fused_decode_layer,
         )
 
-        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
-            win = _layer_window(cfg, idx, s)
-            return flash_decode_attention_sgrid_int8(
-                q, k_l, v_l, k_s, v_s, positions,
+        def step(carry, xs):
+            x, cache = carry
+            blk, idx = xs
+            h = _norm(cfg, x, blk["attn_norm"])
+            q, k, v = _qkv_proj(cfg, blk, h)  # PRE-rope: kernel ropes
+            attn, ck, cv, k_s, v_s = fused_decode_layer(
+                q[:, 0], k[:, 0], v[:, 0],
+                cache["k"], cache["v"],
+                cache.get("k_scale"), cache.get("v_scale"),
+                positions, idx,
+                kv_view=kv_view,
+                rope_theta=cfg.rope_theta,
+                kv_quant=quant_mode,
                 scale=cfg.query_scale,
                 softcap=cfg.attn_softcap,
-                window=win,
+                window=_layer_window(cfg, idx, s),
                 interpret=cfg.flash_interpret,
             )
-    elif use_sgrid:
+            cache = dict(cache)
+            cache["k"], cache["v"] = ck, cv
+            if quant:
+                cache["k_scale"], cache["v_scale"] = k_s, v_s
+            attn = mm(attn.reshape(b, 1, -1), blk["wo"], cfg.act_quant)
+            if cfg.post_norms:
+                attn = _norm(cfg, attn, blk["post_attn_norm"])
+            x = x + attn
+            h = _norm(cfg, x, blk["mlp_norm"])
+            mlp = _mlp(cfg, blk, h)
+            if cfg.post_norms:
+                mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+            x = x + mlp
+            return (x, cache), None
+
+        (x, new_cache), _ = jax.lax.scan(
+            step,
+            (x, dict(kv_cache)),
+            (params["blocks"], layer_idx),
+        )
+        x = _norm(cfg, x, params["final_norm"])
+        logits = _logits(cfg, params, x)[:, 0]  # [B,V]
+        return logits, new_cache
+
+    # flash_decode / flash_sgrid both route to the S-GRID family now: the
+    # legacy plane kernel's whole-view DMA is its docstring'd weakness, so
+    # it is no longer reachable from the model layer (it survives as
+    # flash_decode_attention_plane for interpret-mode cross-checks).
+    use_sgrid = (cfg.flash_decode or cfg.flash_sgrid) and kernel_ok
+    if use_sgrid:
         from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
             flash_decode_attention_sgrid,
         )
@@ -675,20 +790,7 @@ def decode_step(
             win = _layer_window(cfg, idx, s)
             return flash_decode_attention_sgrid(
                 q, k_l, v_l, positions,
-                scale=cfg.query_scale,
-                softcap=cfg.attn_softcap,
-                window=win,
-                interpret=cfg.flash_interpret,
-            )
-    elif use_flash:
-        from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
-            flash_decode_attention,
-        )
-
-        def attention(q, k_l, v_l, idx, k_s=None, v_s=None):
-            win = _layer_window(cfg, idx, s)
-            return flash_decode_attention(
-                q, k_l, v_l, positions,
+                k_scale=k_s, v_scale=v_s, kv_quant=quant_mode,
                 scale=cfg.query_scale,
                 softcap=cfg.attn_softcap,
                 window=win,
@@ -703,13 +805,47 @@ def decode_step(
                 window=_layer_window(cfg, idx, s),
             )
 
+    if quant_mode == "int4":
+        from p2p_llm_tunnel_tpu.models.quant import unpack_int4
+
     def step(carry, xs):
         x, cache = carry
         blk, idx = xs
         h = _norm(cfg, x, blk["attn_norm"])
         q, k, v = _qkv(cfg, blk, h, pos2d)  # q [B,1,H,D], k/v [B,1,K,D]
         cache = dict(cache)
-        if quant:
+        if quant_mode == "int4":
+            kq, k_s = _quant_kv4(k[:, 0])
+            vq, v_s = _quant_kv4(v[:, 0])
+            # Packed nibble read-modify-write: the new token shares a byte
+            # with its sequence neighbour, whose nibble must survive (for
+            # odd positions it holds the PREVIOUS token's real value).
+            # Parked rows (pos >= s) rely on the same OOB semantics as the
+            # int8 path: the gather clamps (value unused) and the scatter
+            # drops the write.
+            bidx = positions // 2
+            even = (positions % 2 == 0)[:, None, None]
+            old_k = cache["k"][idx, slot_ids, bidx]
+            old_v = cache["v"][idx, slot_ids, bidx]
+
+            def pack_row(new, old):
+                lo = jnp.where(even, new, old) & 0x0F
+                hi = jnp.where(even, jnp.right_shift(old, 4), new)
+                return (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
+
+            cache["k"] = cache["k"].at[idx, slot_ids, bidx].set(
+                pack_row(kq, old_k)
+            )
+            cache["v"] = cache["v"].at[idx, slot_ids, bidx].set(
+                pack_row(vq, old_v)
+            )
+            cache["k_scale"] = (
+                cache["k_scale"].at[idx, slot_ids, positions].set(k_s)
+            )
+            cache["v_scale"] = (
+                cache["v_scale"].at[idx, slot_ids, positions].set(v_s)
+            )
+        elif quant:
             kq, k_s = _quant_kv(k[:, 0])
             vq, v_s = _quant_kv(v[:, 0])
             cache["k"] = cache["k"].at[idx, slot_ids, positions].set(kq)
@@ -726,7 +862,8 @@ def decode_step(
         # ONE dynamic_slice for (layer, view-prefix): slicing the layer out
         # first and sub-slicing after makes XLA materialize the full-length
         # layer before the view cut — the fused form reads only view bytes.
-        view_shape = (1, b, kv_view, cfg.n_kv_heads, cfg.head_dim)
+        view_rows = kv_view // 2 if quant_mode == "int4" else kv_view
+        view_shape = (1, b, view_rows, cfg.n_kv_heads, cfg.head_dim)
         zero = jnp.zeros((), idx.dtype)
         start = (idx, zero, zero, zero, zero)
         k_l = jax.lax.dynamic_slice(cache["k"], start, view_shape)[0]
@@ -740,12 +877,15 @@ def decode_step(
                 cache["k_scale"], start[:4], sc_shape)[0]
             v_s = jax.lax.dynamic_slice(
                 cache["v_scale"], start[:4], sc_shape)[0]
-            if use_sgrid_q:
-                # Raw int8 K/V + scales go straight into the kernel, which
-                # dequantizes in VMEM — the bf16 plane never materializes
-                # in HBM (that was the whole einsum-path cost).
+            if use_sgrid:
+                # Packed/int8 K/V + scales go straight into the kernel,
+                # which dequantizes in VMEM — the bf16 plane never
+                # materializes in HBM (that was the whole einsum-path cost).
                 attn = attention(q, k_l, v_l, idx, k_s, v_s)
             else:
+                if quant_mode == "int4":
+                    k_l = unpack_int4(k_l, axis=1)
+                    v_l = unpack_int4(v_l, axis=1)
                 k_l = (k_l.astype(jnp.float32)
                        * k_s[..., None]).astype(x.dtype)
                 v_l = (v_l.astype(jnp.float32)

@@ -1,13 +1,16 @@
 """Pallas decode-attention kernels: one token per slot vs the KV cache.
 
-The decode analog of ops/pallas_attention.py (VERDICT r3 item 4).  TWO
-variants share the online-softmax math:
+The decode analog of ops/pallas_attention.py (VERDICT r3 item 4).  THREE
+bodies share the online-softmax math:
 
-- ``flash_decode_attention`` (plane variant): each grid program owns one
+- ``flash_decode_attention_plane`` (legacy): each grid program owns one
   (slot, kv-head) pair and stages that head's full [view, D] K/V planes,
   skipping COMPUTE for K blocks past the slot's frontier but not their
   HBM→VMEM DMA — callers must bound view (the model layer caps
-  view·head_dim at 1M elements ≈ 4 MB of K+V per program).
+  view·head_dim at 1M elements ≈ 4 MB of K+V per program).  Kept ONLY as
+  an interpret-mode cross-check of the s-grid family; the public
+  ``flash_decode_attention`` entry routes to the s-grid kernel (ISSUE 4:
+  the plane kernel's whole-view DMA is a documented weakness).
 - ``flash_decode_attention_sgrid`` (r5, VERDICT r4 item 2): the sequence
   axis joins the grid — program (slot, kv-head, s-block) stages ONE
   [BLOCK_S, D] block.  The slot's position rides scalar prefetch, and the
@@ -20,16 +23,34 @@ variants share the online-softmax math:
   packed int4 (two adjacent tokens per byte along the sequence axis) —
   each quantized form dequantizes in VMEM right after its (halved /
   quartered) DMA.
+- ``fused_decode_layer`` (ISSUE 4 tentpole): one program per (slot,
+  s-block) covering ALL kv-heads, which additionally performs the
+  per-layer decode plumbing that used to be 6-8 separate XLA kernels:
+  RoPE at the slot's position (q and the new k row), in-VMEM
+  quantization of the new KV row to the cache's precision, the cache
+  APPEND (an aliased in-place row write into the full [L, B, S, K, D]
+  cache — no XLA scatter, no dynamic-slice read), and the
+  frontier-clamped flash attention.  Weight matmuls stay in XLA where
+  MXU fusion already works; pre-attention RMSNorm also stays in XLA —
+  it precedes the QKV projections, and XLA fuses it into their operand
+  reads, so there is nothing left to fold into this kernel for the
+  supported model families (a post-projection q/k-norm would be the
+  case that folds here, and none of our presets uses one).
 
 Fuses score, mask, softmax, and value matmuls into one kernel where the
 einsum path (ops/attention.py cached_attention) lowers to several — fewer
-kernel launches per decode step matters at 32 layers × 16 steps per burst.
+kernel launches per decode step matters at 32 layers × 16 steps per burst
+(≈4k launches per dispatch; PERF.md "fused decode layer").
 
-Reads the cache in its native [B, S, K, D] layout via squeezed middle-axis
-BlockSpecs — no per-step transpose of a GB-scale cache.
+Reads the cache in its native [.., S, K, D] layout — no per-step
+transpose of a GB-scale cache.  The fused kernel's blocks span all
+kv-heads ([BLOCK_S, K, D]) so the trailing block dims match the array
+and the kernel cross-lowers for TPU from any host (the launch-count
+probe in scripts/perf_probe.py depends on that).
 
-The einsum path remains the numerics oracle (tests/test_pallas_decode.py
-validates against it) and the fallback for non-tileable shapes.
+The einsum path remains the numerics oracle (tests/test_pallas_decode.py,
+tests/test_fused_decode_layer.py validate against it) and the fallback
+for non-tileable shapes.
 """
 
 from __future__ import annotations
@@ -106,13 +127,33 @@ def flash_decode_attention(
     k_cache: jnp.ndarray,  # [B, S, K, D]
     v_cache: jnp.ndarray,  # [B, S, K, D]
     q_positions: jnp.ndarray,  # [B] int32
+    **kwargs,
+) -> jnp.ndarray:
+    """Drop-in for ops.attention.cached_attention on TPU-tileable shapes.
+
+    Routed to the S-GRIDDED kernel (ISSUE 4 satellite): the legacy plane
+    body stages the slot's whole [view, D] K/V planes per program — a
+    docstring'd VMEM/DMA weakness — while the s-grid variant fetches one
+    block, skips past-frontier DMA, and has no view cap.  The plane body
+    survives as ``flash_decode_attention_plane`` strictly for
+    interpret-mode cross-checks of the shared online-softmax math.
+    """
+    return flash_decode_attention_sgrid(q, k_cache, v_cache, q_positions,
+                                        **kwargs)
+
+
+def flash_decode_attention_plane(
+    q: jnp.ndarray,  # [B, 1, H, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D]
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    q_positions: jnp.ndarray,  # [B] int32
     *,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
     window=None,  # None | int | traced int scalar
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Drop-in for ops.attention.cached_attention on TPU-tileable shapes.
+    """Legacy whole-plane variant — interpret-mode cross-check ONLY.
 
     Requires S % 128 == 0 (the engine's kv-view buckets guarantee this).
     ``window`` may be a traced scalar (gemma-2 alternates windows across
@@ -425,3 +466,395 @@ def flash_decode_attention_sgrid_int4(
         q, k_cache, v_cache, q_positions,
         k_scale=k_scale, v_scale=v_scale, kv_quant="int4", **kwargs,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused decode-layer kernel (ISSUE 4 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _fused_decode_layer_kernel(
+    idx_sref,  # scalar-prefetch [1] int32: layer index into the [L,...] cache
+    pos_sref,  # scalar-prefetch [B] int32: per-slot query position
+    win_sref,  # scalar-prefetch [1] int32: sliding window (view+1 = disabled)
+    q_ref,  # [H, D] this slot's query heads, PRE-rope
+    kn_ref,  # [K, D] new key row, PRE-rope
+    vn_ref,  # [K, D] new value row
+    k_ref,  # [BS, K, D] cache block (raw/int8) | [BS/2, K, D] packed int4
+    v_ref,  # same layout as k_ref
+    *rest,  # kv_quant: ks_ref/vs_ref [BS, K, 1], then outputs+scratch
+    scale: float,
+    softcap: Optional[float],
+    block_s: int,
+    n_sblocks: int,
+    kh: int,
+    g: int,
+    view: int,
+    rope_theta: float,
+    out_dtype,
+    kv_quant: Optional[str],
+):
+    """ONE kernel for the whole per-layer decode attention sub-block.
+
+    Per (slot, s-block) program, all kv-heads:
+    - sj == 0: RoPE q and the new k row at the slot's position (the
+      rotate-half convention of ops/rope.py, same freq formula so the
+      interpret-mode oracle agrees bit-for-bit on CPU), quantize the new
+      row to the cache precision in VMEM, stash everything in scratch.
+    - sj <= frontier: online-softmax flash attention over the staged
+      cache block, dequantized in VMEM (the s-grid kernel's math; cache
+      keys mask STRICTLY below pos — position pos itself is stale until
+      this kernel's own append lands).
+    - sj == frontier: the APPEND — write the quantized new row (packed
+      read-modify-write of the shared byte for int4) into the aliased
+      cache row output.  Parked rows (pos >= view) write their old row
+      back unchanged, the Pallas analog of XLA's OOB-scatter drop.
+    - sj == n_sblocks-1: fold in the new row's own attention term (it is
+      attendable at its own position) and emit the normalized output.
+    """
+    if kv_quant is not None:
+        (ks_ref, vs_ref,
+         o_ref, ok_ref, ov_ref, oks_ref, ovs_ref,
+         q_sc, kq_sc, vq_sc, ksc_sc, vsc_sc, m_sc, l_sc, acc_sc) = rest
+    else:
+        (o_ref, ok_ref, ov_ref,
+         q_sc, kq_sc, vq_sc, m_sc, l_sc, acc_sc) = rest
+    bi = pl.program_id(0)
+    sj = pl.program_id(1)
+    pos = pos_sref[bi]
+    window = win_sref[0]
+    d = q_ref.shape[-1]
+    frontier = jnp.minimum(pos // block_s, n_sblocks - 1)
+    parked = pos >= view
+    cpos = jnp.minimum(pos, view - 1)
+    qmax = 7.0 if kv_quant == "int4" else 127.0
+
+    @pl.when(sj == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc[:], _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc[:])
+        acc_sc[:] = jnp.zeros_like(acc_sc[:])
+        # RoPE tables at this slot's position, rotate-half layout: lane i
+        # and lane i + D/2 share angle pos / theta^(2i/D) — the exact
+        # expression of ops.rope.rope_table so interpret mode reproduces
+        # the unfused reference to the ulp.
+        half = d // 2
+        lane = jax.lax.broadcasted_iota(jnp.float32, (1, d), 1)
+        pair = jnp.where(lane < half, lane, lane - half)
+        freqs = 1.0 / (rope_theta ** (2.0 * pair / d))
+        ang = pos.astype(jnp.float32) * freqs
+        sin = jnp.sin(ang)
+        cos = jnp.cos(ang)
+
+        def rope(x):  # x [rows, D] f32
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            return x * cos + rot * sin
+
+        q_sc[:] = rope(q_ref[:].astype(jnp.float32)) * scale
+        kn = rope(kn_ref[:].astype(jnp.float32))
+        vn = vn_ref[:].astype(jnp.float32)
+        if kv_quant is not None:
+            # Same formula as models.transformer's _quant_kv/_quant_kv4:
+            # symmetric over D, per-(token, head) scale, 1e-8 floor.
+            k_s = jnp.maximum(jnp.abs(kn).max(-1, keepdims=True), 1e-8) / qmax
+            v_s = jnp.maximum(jnp.abs(vn).max(-1, keepdims=True), 1e-8) / qmax
+            kq_sc[:] = jnp.clip(jnp.round(kn / k_s), -qmax, qmax)
+            vq_sc[:] = jnp.clip(jnp.round(vn / v_s), -qmax, qmax)
+            ksc_sc[:] = jnp.broadcast_to(k_s, ksc_sc.shape)
+            vsc_sc[:] = jnp.broadcast_to(v_s, vsc_sc.shape)
+        else:
+            kq_sc[:] = kn
+            vq_sc[:] = vn
+
+    def _unpack_seq(p):  # [BS/2, K, D] bytes -> [BS, K, D] int8 in [-8, 7]
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        return jnp.stack([lo, hi], axis=1).reshape(
+            2 * p.shape[0], p.shape[1], p.shape[2]
+        )
+
+    @pl.when(sj <= frontier)
+    def _compute():
+        if kv_quant == "int4":
+            k_blk = _unpack_seq(k_ref[:]).astype(jnp.float32)
+            v_blk = _unpack_seq(v_ref[:]).astype(jnp.float32)
+        else:
+            k_blk = k_ref[:].astype(jnp.float32)  # [BS, K, D]
+            v_blk = v_ref[:].astype(jnp.float32)
+        if kv_quant is not None:
+            k_blk = k_blk * ks_ref[:]
+            v_blk = v_blk * vs_ref[:]
+        k_pos = sj * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1
+        )
+        # STRICT < pos: the row at pos is stale until this kernel's own
+        # append; the new token's term is folded separately at emit.
+        mask = (k_pos < pos) & ((pos - k_pos) < window)
+        for h in range(kh):
+            qh = q_sc[h * g:(h + 1) * g, :]  # [G, D], pre-scaled
+            s = jax.lax.dot_general(
+                qh, k_blk[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, BS]
+            if softcap is not None:
+                s = softcap * jnp.tanh(s / softcap)
+            s = jnp.where(mask, s, _NEG_INF)
+            m_prev = m_sc[h * g:(h + 1) * g, :1]
+            l_prev = l_sc[h * g:(h + 1) * g, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            corr = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(s == _NEG_INF, 0.0, p)
+            acc_sc[h * g:(h + 1) * g, :] = (
+                acc_sc[h * g:(h + 1) * g, :] * corr
+                + jax.lax.dot_general(
+                    p, v_blk[:, h, :], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+            m_sc[h * g:(h + 1) * g, :] = jnp.broadcast_to(
+                m_new, (g, m_sc.shape[-1])
+            )
+            l_sc[h * g:(h + 1) * g, :] = jnp.broadcast_to(
+                l_new, (g, l_sc.shape[-1])
+            )
+
+    @pl.when(sj == frontier)
+    def _append():
+        # The staged block is the frontier block here, so the old row (for
+        # parked write-back and the int4 shared-nibble RMW) is in VMEM.
+        if kv_quant == "int4":
+            rb = cpos // 2 - frontier * (block_s // 2)
+            old = k_ref[pl.ds(rb, 1), :, :]  # [1, K, D] bytes
+            old_v = v_ref[pl.ds(rb, 1), :, :]
+            even = (cpos % 2) == 0
+            kq = jnp.round(kq_sc[:]).astype(jnp.int8)[None]
+            vq = jnp.round(vq_sc[:]).astype(jnp.int8)[None]
+
+            def pack_row(new, old_b):
+                lo = jnp.where(even, new, old_b) & 0x0F
+                hi = jnp.where(even, jnp.right_shift(old_b, 4), new)
+                return (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
+
+            ok_ref[:] = jnp.where(parked, old, pack_row(kq, old))
+            ov_ref[:] = jnp.where(parked, old_v, pack_row(vq, old_v))
+        else:
+            row = cpos - frontier * block_s
+            old_k = k_ref[pl.ds(row, 1), :, :]
+            old_v = v_ref[pl.ds(row, 1), :, :]
+            if kv_quant == "int8":
+                kq = jnp.round(kq_sc[:]).astype(jnp.int8)[None]
+                vq = jnp.round(vq_sc[:]).astype(jnp.int8)[None]
+            else:
+                kq = kq_sc[:].astype(ok_ref.dtype)[None]
+                vq = vq_sc[:].astype(ov_ref.dtype)[None]
+            ok_ref[:] = jnp.where(parked, old_k, kq)
+            ov_ref[:] = jnp.where(parked, old_v, vq)
+        if kv_quant is not None:
+            srow = cpos - frontier * block_s
+            old_ks = ks_ref[pl.ds(srow, 1), :, :]  # [1, K, 1]
+            old_vs = vs_ref[pl.ds(srow, 1), :, :]
+            oks_ref[:] = jnp.where(parked, old_ks, ksc_sc[:, :1][None])
+            ovs_ref[:] = jnp.where(parked, old_vs, vsc_sc[:, :1][None])
+
+    @pl.when(sj == n_sblocks - 1)
+    def _emit():
+        # Fold the new token's own (k, v) — attendable at its position,
+        # always inside any window — using the DEQUANTIZED values future
+        # steps will read back, so fused and unfused stay token-identical.
+        if kv_quant is not None:
+            kd = kq_sc[:] * ksc_sc[:, :1]
+            vd = vq_sc[:] * vsc_sc[:, :1]
+        else:
+            kd = kq_sc[:]
+            vd = vq_sc[:]
+        for h in range(kh):
+            qh = q_sc[h * g:(h + 1) * g, :]
+            s = jax.lax.dot_general(
+                qh, kd[h:h + 1, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, 1]
+            if softcap is not None:
+                s = softcap * jnp.tanh(s / softcap)
+            m_prev = m_sc[h * g:(h + 1) * g, :1]
+            l_prev = l_sc[h * g:(h + 1) * g, :1]
+            m_new = jnp.maximum(m_prev, s)
+            corr = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+            p = jnp.exp(s - m_new)
+            acc = acc_sc[h * g:(h + 1) * g, :] * corr + p * vd[h:h + 1, :]
+            l_new = l_prev * corr + p
+            o_ref[h * g:(h + 1) * g, :] = (
+                acc / jnp.maximum(l_new, 1e-30)
+            ).astype(out_dtype)
+
+
+def fused_decode_layer(
+    q: jnp.ndarray,  # [B, H, D] post-projection, PRE-rope
+    k_new: jnp.ndarray,  # [B, K, D] post-projection, PRE-rope
+    v_new: jnp.ndarray,  # [B, K, D]
+    k_cache: jnp.ndarray,  # [L, B, S, K, D] raw/int8 | [L, B, S/2, K, D] int4
+    v_cache: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray],  # [L, B, S, K] f32, or None
+    v_scale: Optional[jnp.ndarray],
+    positions: jnp.ndarray,  # [B] int32
+    layer_idx,  # int32 scalar (traced: the lax.scan layer index)
+    *,
+    kv_view: int,  # static: attention reads cache[..., :kv_view, :, :]
+    rope_theta: float,
+    kv_quant: Optional[str] = None,  # None | "int8" | "int4"
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window=None,  # None | int | traced int scalar
+    interpret: bool = False,
+):
+    """Fused per-layer decode attention sub-block (ISSUE 4 tentpole).
+
+    Replaces, in ONE pallas_call per layer, what the unfused decode path
+    issues as separate XLA kernels: rope(q), rope(k), the new-row KV
+    quantization, 2-4 cache scatters, 2-4 view dynamic-slices, and the
+    attention itself.  Takes the FULL stacked cache and the traced layer
+    index (scalar prefetch drives the block index maps), so neither a
+    per-layer dynamic-slice read nor a scatter write ever materializes;
+    the updated cache leaves come back via in-place input/output aliasing
+    with only the appended row's bytes actually written to HBM.
+
+    Requirements (the decode_step gate enforces them):
+    - ``kv_view`` % 128 == 0, and every ACTIVE slot's position < kv_view
+      (the engine's bucket selection guarantees it; positions >= kv_view
+      are treated as parked rows — junk output, cache row preserved).
+    - head_dim tiles (% 128 == 0) unless running in interpret mode.
+
+    Returns ``(attn [B, H, D], k_cache', v_cache', k_scale', v_scale')``
+    (scale entries None when ``kv_quant`` is None).
+    """
+    l, b = k_cache.shape[0], k_cache.shape[1]
+    h, d = q.shape[1], q.shape[2]
+    kh = k_new.shape[1]
+    g = h // kh
+    quantized = k_scale is not None
+    if (kv_quant is not None) != quantized:
+        raise ValueError("kv_quant requires k_scale/v_scale and vice versa")
+    s_tokens = k_cache.shape[2] * (2 if kv_quant == "int4" else 1)
+    view = min(kv_view, s_tokens)
+    if view % BLOCK_S == 0:
+        bs = BLOCK_S
+    elif view % 128 == 0:
+        bs = 128
+    else:
+        raise ValueError(f"fused decode layer needs view % 128 == 0, got {view}")
+    n_sb = view // bs
+    if scale is None:
+        scale = d**-0.5
+    pos = positions.astype(jnp.int32)
+    win = (
+        jnp.full((1,), view + 1, jnp.int32) if window is None
+        else jnp.reshape(window, (1,)).astype(jnp.int32)
+    )
+    idx = jnp.reshape(layer_idx, (1,)).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _fused_decode_layer_kernel,
+        scale=scale,
+        softcap=softcap,
+        block_s=bs,
+        n_sblocks=n_sb,
+        kh=kh,
+        g=g,
+        view=view,
+        rope_theta=rope_theta,
+        out_dtype=q.dtype,
+        kv_quant=kv_quant,
+    )
+
+    def slot_index(bi, sj, idx_r, pos_r, win_r):
+        return (bi, 0, 0)
+
+    def kv_index(bi, sj, idx_r, pos_r, win_r):
+        # Past-frontier steps clamp to the frontier block (same index ->
+        # Pallas elides the fetch); block units, so one map serves the
+        # packed int4 axis and the full-width layouts alike.
+        return (idx_r[0], bi, jnp.minimum(sj, pos_r[bi] // bs), 0, 0)
+
+    pack = 2 if kv_quant == "int4" else 1
+
+    def row_index(bi, sj, idx_r, pos_r, win_r):
+        # Constant over sj: the appended row flushes ONCE per slot.
+        return (idx_r[0], bi,
+                jnp.minimum(pos_r[bi], view - 1) // pack, 0, 0)
+
+    def srow_index(bi, sj, idx_r, pos_r, win_r):
+        return (idx_r[0], bi, jnp.minimum(pos_r[bi], view - 1), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, h, d), slot_index),
+        pl.BlockSpec((None, kh, d), slot_index),
+        pl.BlockSpec((None, kh, d), slot_index),
+        pl.BlockSpec((None, None, bs // pack, kh, d), kv_index),
+        pl.BlockSpec((None, None, bs // pack, kh, d), kv_index),
+    ]
+    operands = [idx, pos, win, q, k_new, v_new, k_cache, v_cache]
+    out_shapes = [
+        jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+        jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+    ]
+    out_specs = [
+        pl.BlockSpec((None, h, d), slot_index),
+        pl.BlockSpec((None, None, 1, kh, d), row_index),
+        pl.BlockSpec((None, None, 1, kh, d), row_index),
+    ]
+    # Operand index (scalar-prefetch args included) -> output index.
+    aliases = {6: 1, 7: 2}
+    scratch = [
+        pltpu.VMEM((h, d), jnp.float32),  # q_sc (rope'd, pre-scaled)
+        pltpu.VMEM((kh, d), jnp.float32),  # kq_sc
+        pltpu.VMEM((kh, d), jnp.float32),  # vq_sc
+    ]
+    if quantized:
+        ks5 = k_scale.astype(jnp.float32)[..., None]  # [L, B, S, K, 1]
+        vs5 = v_scale.astype(jnp.float32)[..., None]
+        in_specs += [
+            pl.BlockSpec((None, None, bs, kh, 1), kv_index),
+            pl.BlockSpec((None, None, bs, kh, 1), kv_index),
+        ]
+        operands += [ks5, vs5]
+        out_shapes += [
+            jax.ShapeDtypeStruct(ks5.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vs5.shape, jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((None, None, 1, kh, 1), srow_index),
+            pl.BlockSpec((None, None, 1, kh, 1), srow_index),
+        ]
+        aliases.update({8: 3, 9: 4})
+        scratch += [
+            pltpu.VMEM((kh, 128), jnp.float32),  # ksc_sc
+            pltpu.VMEM((kh, 128), jnp.float32),  # vsc_sc
+        ]
+    scratch += [
+        pltpu.VMEM((h, 128), jnp.float32),  # m
+        pltpu.VMEM((h, 128), jnp.float32),  # l
+        pltpu.VMEM((h, d), jnp.float32),  # acc
+    ]
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shapes),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, n_sb),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
+        ),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    if quantized:
+        attn, kc, vc, ks5, vs5 = outs
+        return attn, kc, vc, ks5[..., 0], vs5[..., 0]
+    attn, kc, vc = outs
+    return attn, kc, vc, None, None

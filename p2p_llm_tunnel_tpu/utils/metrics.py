@@ -34,6 +34,14 @@ METRICS_CATALOG: Dict[str, str] = {
     "engine_queue_depth": "requests waiting for a slot (gauge)",
     "engine_batch_occupancy": "fraction of decode slots occupied (gauge)",
     "engine_degraded": "1 while the decode watchdog deems the engine stalled (gauge)",
+    "engine_decode_kernels_per_step": (
+        "launch-proxy major kernels per decode layer-step in the "
+        "TPU-lowered burst program (gauge; utils/hlo.py)"
+    ),
+    "engine_warmup_compile_s": (
+        "wall seconds warmup spent compiling the serving program set "
+        "(gauge; the number a chip window must fit before serving)"
+    ),
     "engine_ttft_ms": "time to first token per request (histogram, ms)",
     "engine_prefill_ms": "prefill step latency (histogram, ms)",
     "engine_decode_fetch_ms": "device->host fetch of a sampled block (histogram, ms)",

@@ -10,9 +10,17 @@ Measures, on the real chip and without the tunnel stack:
   is the int8 dequant materializing a bf16 weight copy?)
 
 Env knobs: PP_MODEL, PP_QUANT (int8|w8a8|int4|none), PP_GROUP (int4 scale
-group size, default 128), PP_SLOTS, PP_STEPS, PP_MAX_SEQ, PP_ITERS,
-PP_POS (starting cache position), PP_PIPELINE=1 (dispatch burst n before
-fetching n-1, like the engine loop).
+group size, default 128), PP_KV_QUANT (none|int8|int4), PP_FUSED=1 (the
+fused decode-layer kernel, ISSUE 4), PP_SLOTS, PP_STEPS, PP_MAX_SEQ,
+PP_ITERS, PP_POS (starting cache position), PP_PIPELINE=1 (dispatch burst
+n before fetching n-1, like the engine loop).
+
+Besides wall times and XLA cost analysis, reports the burst program's
+KERNEL/LAUNCH COUNTS from the TPU-lowered StableHLO (utils/hlo.py) —
+works from any CPU host, so the fused kernel's launch-collapse (and any
+regression re-splitting the layer body) is measurable without a chip
+window.  ``kernels_per_layer_step`` is the major-kernel count of the
+layer-scan body; ``layer_body_ops`` is the unfused-op upper bound.
 
 The int4 acceptance probe (ISSUE 2): with PP_QUANT=int4 on the 8B shape
 the cost analysis must report ≤ 4.5 GB HBM bytes-accessed/step (vs ~7.85
@@ -53,6 +61,8 @@ def main() -> None:
     pipeline = os.environ.get("PP_PIPELINE", "1") == "1"
     kv_view = int(os.environ.get("PP_VIEW", str(max_seq)))
     group = int(os.environ.get("PP_GROUP", "128"))
+    kv_quant = os.environ.get("PP_KV_QUANT", "none")
+    fused = os.environ.get("PP_FUSED", "0") == "1"
 
     from p2p_llm_tunnel_tpu.engine import sampling
     from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
@@ -69,6 +79,7 @@ def main() -> None:
         engine_cfg=EngineConfig(
             model=model, num_slots=slots, max_seq=max_seq,
             decode_steps=steps, quant=quant, quant_group_size=group,
+            kv_quant=kv_quant, fused_decode_layer=fused,
         ),
         tokenizer=ByteTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -144,6 +155,31 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - diagnostics only
         print(f"cost_analysis unavailable: {e}", file=sys.stderr)
 
+    # Kernel/launch counts of the REAL TPU burst program, cross-lowered
+    # from this host (utils/hlo.py) — next to bytes-accessed, so both the
+    # byte-traffic and the launch-count terms of the decode roofline are
+    # visible off-chip.  One recipe, owned by the engine
+    # (decode_launch_report): the probe re-implementing the jit signature
+    # here is the TC02 stale-signature incident class.
+    report = None
+    try:
+        report = eng.decode_launch_report(view=kv_view, steps=steps)
+        if report is not None:
+            print(
+                "launch counts: "
+                f"kernels_per_layer_step={report['layer_body_major']} "
+                f"layer_body_ops={report['layer_body_ops']} "
+                f"layer_body_pallas={report['layer_body_pallas']} "
+                f"total_major={report['total_major']} "
+                f"total_ops={report['total_ops']}",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            print("launch counts unavailable (TPU lowering failed)",
+                  file=sys.stderr)
+    except Exception as e:  # pragma: no cover - diagnostics only
+        print(f"launch counts unavailable: {e}", file=sys.stderr)
+
     t0 = time.monotonic()
     out = eng._jit_decode(
         eng.params, eng.kv_cache, tokens, positions, counts, bias, ovm, ovt,
@@ -185,8 +221,13 @@ def main() -> None:
     per_step_ms = med * 1000.0 / steps
     tok_s = slots * steps / med
     result = {
-        "model": model, "quant": quant, "slots": slots, "steps": steps,
+        "model": model, "quant": quant, "kv_quant": kv_quant,
+        "fused_decode_layer": fused, "slots": slots, "steps": steps,
         "param_gb": round(weight_bytes / 1e9, 2),
+        "kernels_per_layer_step": (
+            report["layer_body_major"] if report else None
+        ),
+        "layer_body_ops": report["layer_body_ops"] if report else None,
         "max_seq": max_seq, "kv_view": kv_view, "init_s": round(t_init, 1),
         "compile_s": round(t_compile, 1),
         "burst_ms_median": round(med * 1000.0, 1),

@@ -68,6 +68,20 @@ GRID = [
     # composed in one program set — the projected-best per-step config.
     ("int4-kv8-sgrid", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int8",
                         "BENCH_FLASH_SGRID": "1"}),
+    # ISSUE 4 fused decode-layer rows, in decision order right after the
+    # int4 rows they build on.  With byte traffic already near-roofline
+    # (int4 weights halved it again), launch overhead is the residual gap
+    # term (~16 ms measured vs ~5 ms int4 floor): the fused kernel
+    # collapses the 32-layer x 16-step launch storm, so THIS pair is what
+    # converts the int4 byte halving into tok/s.  First the direct A/B
+    # against int4-kv8-sgrid (same weights/KV bytes, only the launch
+    # count changes — the cleanest attribution), then the full
+    # composition with the quartered int4 KV stream, which only the
+    # fused/sgrid kernels can serve (in-VMEM nibble unpack).
+    ("int4-kv8-fused", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int8",
+                        "BENCH_FUSED_DECODE": "1"}),
+    ("int4-kv4-fused", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+                        "BENCH_FUSED_DECODE": "1"}),
     # pfx-off right after: it needs ZERO fresh compiles beyond base's
     # program set (same decode variants, plain prefill only — the
     # copy/chunk programs it skips are extra, not different), so with base
@@ -86,9 +100,19 @@ GRID = [
     # slots — if the weight stream really halves, this is where ≥1800
     # tok/s should first appear.
     ("int4-64x24", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int8",
-                    "BENCH_FLASH_SGRID": "1", "BENCH_SLOTS": "64",
+                    "BENCH_SLOTS": "64",
                     "BENCH_CLIENTS": "64", "BENCH_DECODE_STEPS": "24",
+                    "BENCH_FLASH_SGRID": "1",
                     "SWEEP_DEADLINE_S": "900"}),
+    # The fused hero: every decode lever composed — int4 weights, int4 KV,
+    # the fused layer kernel, 64 slots.  Runs after its sgrid twin so the
+    # two rows bracket the launch-overhead term at the hero shape.
+    ("int4-kv4-fused-64x24", {"BENCH_QUANT": "int4",
+                              "BENCH_KV_QUANT": "int4",
+                              "BENCH_FUSED_DECODE": "1",
+                              "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+                              "BENCH_DECODE_STEPS": "24",
+                              "SWEEP_DEADLINE_S": "900"}),
     # Joint-target variant: 48 slots raise the decode ceiling without the
     # 64-wide admission herd that blows the <400 ms TTFT bar.  All-fresh
     # programs: compiles alone can eat the default 420 s on this 1-core

@@ -13,6 +13,7 @@ import pytest
 from p2p_llm_tunnel_tpu.ops.attention import cached_attention
 from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
     flash_decode_attention,
+    flash_decode_attention_plane,
     flash_decode_attention_sgrid,
 )
 
@@ -46,10 +47,10 @@ def test_positions_gate_attendable_prefix():
     b, s, h, kh, d = 2, 256, 4, 2, 16
     q, k, v = _mk(b, s, h, kh, d, seed=1)
     pos = jnp.array([50, 130], jnp.int32)
-    base = flash_decode_attention(q, k, v, pos, interpret=True)
+    base = flash_decode_attention_plane(q, k, v, pos, interpret=True)
     k2 = k.at[:, 200:].set(1e6)
     v2 = v.at[:, 200:].set(-1e6)
-    poisoned = flash_decode_attention(q, k2, v2, pos, interpret=True)
+    poisoned = flash_decode_attention_plane(q, k2, v2, pos, interpret=True)
     np.testing.assert_allclose(np.asarray(base), np.asarray(poisoned))
 
 
@@ -59,7 +60,7 @@ def test_sliding_window_matches_oracle():
     pos = jnp.array([180, 255], jnp.int32)
     for window in (16, 64):
         want = cached_attention(q, k, v, pos, window=window)
-        got = flash_decode_attention(q, k, v, pos, window=window,
+        got = flash_decode_attention_plane(q, k, v, pos, window=window,
                                      interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
@@ -70,7 +71,7 @@ def test_softcap_and_scale_match_oracle():
     q, k, v = _mk(b, s, h, kh, d, seed=3)
     pos = jnp.array([64, 127], jnp.int32)
     want = cached_attention(q, k, v, pos, scale=0.25, softcap=30.0)
-    got = flash_decode_attention(q, k, v, pos, scale=0.25, softcap=30.0,
+    got = flash_decode_attention_plane(q, k, v, pos, scale=0.25, softcap=30.0,
                                  interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -83,7 +84,7 @@ def test_traced_window_scalar():
     pos = jnp.array([100], jnp.int32)
 
     def f(win):
-        return flash_decode_attention(q, k, v, pos, window=win,
+        return flash_decode_attention_plane(q, k, v, pos, window=win,
                                       interpret=True)
 
     got = jax.jit(f)(jnp.asarray(32))
@@ -225,3 +226,20 @@ def test_full_model_decode_flash_parity():
                 np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4,
                 err_msg=f"flash decode diverges on {preset} sgrid={sgrid}",
             )
+
+
+def test_public_entry_routes_to_sgrid():
+    """ISSUE 4 satellite: ``flash_decode_attention`` is the s-grid kernel
+    now — bit-identical output to calling the s-grid entry directly, and
+    the plane body (whole-view DMA, the docstring'd weakness) survives
+    only as ``flash_decode_attention_plane`` for cross-checks."""
+    b, s, h, kh, d = 2, 256, 4, 2, 16
+    q, k, v = _mk(b, s, h, kh, d, seed=9)
+    pos = jnp.array([7, 200], jnp.int32)
+    routed = flash_decode_attention(q, k, v, pos, interpret=True)
+    sgrid = flash_decode_attention_sgrid(q, k, v, pos, interpret=True)
+    np.testing.assert_array_equal(np.asarray(routed), np.asarray(sgrid))
+    # ...and the plane cross-check still agrees with the shared math.
+    plane = flash_decode_attention_plane(q, k, v, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(plane), np.asarray(sgrid),
+                               rtol=2e-5, atol=2e-5)

@@ -926,3 +926,179 @@ def test_registries_match_runtime():
     assert set(ctx.message_types) == {m.name for m in MessageType}
     assert ctx.error_codes == set(ERROR_CODES)
     assert ctx.metrics_names == set(METRICS_CATALOG)
+
+
+# ---------------------------------------------------------------------------
+# TC07 — device dispatches inside per-request/slot loops (serving path)
+# ---------------------------------------------------------------------------
+
+ENGINE_FIXTURE = "p2p_llm_tunnel_tpu/engine/fixture_engine.py"
+
+
+def test_tc07_flags_jit_call_in_request_loop(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._jit_copy = jax.jit(lambda x: x)
+
+            def admit(self, runs):
+                for run in runs:
+                    self._jit_copy(run)
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert rules_of(active) == ["TC07"]
+    assert "_jit_copy" in active[0].message
+
+
+def test_tc07_flags_device_get_in_request_loop(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def drain(requests):
+            out = []
+            for r in requests:
+                out.append(jax.device_get(r))
+            return out
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert rules_of(active) == ["TC07"]
+
+
+def test_tc07_flags_factory_returned_callable_per_slot(tmp_path):
+    """The exact r5 class: a helper factory returns jitted copy ops
+    (tuple-unpacked), and one of them is dispatched once per matched
+    request inside the admission loop."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def make_copy_ops():
+            return jax.jit(lambda c: c), jax.jit(lambda c: c)
+
+        class Engine:
+            def __init__(self):
+                self._copy_in, self._copy_out = make_copy_ops()
+
+            def admit(self, hits):
+                for slot, blocks in hits:
+                    self.cache = self._copy_in(self.cache)
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert rules_of(active) == ["TC07"]
+    assert "_copy_in" in active[0].message
+
+
+def test_tc07_flags_dispatching_helper_via_executor(tmp_path):
+    """A method that transitively dispatches, handed to run_in_executor
+    once per request, is still one dispatch per iteration."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._jit_prefill = jax.jit(lambda t: t)
+
+            def _dispatch_one(self, tokens):
+                return self._jit_prefill(tokens)
+
+            async def admit(self, loop, admitted):
+                for run in admitted:
+                    await loop.run_in_executor(None, self._dispatch_one, run)
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert rules_of(active) == ["TC07"]
+
+
+def test_tc07_batched_outside_loop_and_warmup_loops_clean(tmp_path):
+    """The fixed shape (pack the wave, ONE dispatch after the loop) and
+    compile-time loops over view buckets are clean; so is the engine's
+    `while self._running` main loop (word-wise subject matching — one
+    dispatch per BURST is the design)."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._jit_prefill = jax.jit(lambda t: t)
+                self._running = True
+
+            def admit(self, runs):
+                batch = [r.tokens for r in runs]
+                return self._jit_prefill(batch)
+
+            def warmup(self, views):
+                for view in views:
+                    self._jit_prefill(view)
+
+            def loop(self):
+                while self._running:
+                    self._jit_prefill(0)
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert active == []
+
+
+def test_tc07_out_of_scope_modules_not_scanned(tmp_path):
+    """The rule covers the engine/endpoints serving path only — model
+    code legitimately maps jitted fns over layer lists."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def apply(layers):
+            f = jax.jit(lambda x: x)
+            for layer in layers:  # 'layer' is not a request subject anyway
+                f(layer)
+
+        def per_prompt(prompts):
+            g = jax.jit(lambda x: x)
+            for p in prompts:
+                g(p)
+        """,
+        filename="p2p_llm_tunnel_tpu/models/fixture_model.py",
+        rules=["TC07"],
+    )
+    assert active == []
+
+
+def test_tc07_waiver_records_granularity_contract(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._jit_copy = jax.jit(lambda x: x)
+
+            def admit(self, hits):
+                for lo in range(0, len(hits), 8):
+                    self._jit_copy(hits[lo:lo + 8])  # tunnelcheck: disable=TC07  one dispatch per 8-wide sub-batch
+        """,
+        filename=ENGINE_FIXTURE,
+        rules=["TC07"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC07"]

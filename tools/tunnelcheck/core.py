@@ -409,6 +409,7 @@ def all_rules() -> Dict[str, "object"]:
     from tools.tunnelcheck import (
         rules_async,
         rules_deps,
+        rules_dispatch,
         rules_jax,
         rules_metrics,
         rules_protocol,
@@ -421,6 +422,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC04": rules_deps.check_tc04,
         "TC05": rules_protocol.check_tc05,
         "TC06": rules_metrics.check_tc06,
+        "TC07": rules_dispatch.check_tc07,
     }
 
 
@@ -432,6 +434,7 @@ RULE_SUMMARIES = {
     "TC04": "module-level optional-dep import (websockets/cryptography) outside gated wrappers",
     "TC05": "non-exhaustive MessageType dispatch / typed_error code not in ERROR_CODES",
     "TC06": "metric name not declared in utils.metrics.METRICS_CATALOG",
+    "TC07": "device dispatch inside a per-request/slot loop on the serving path",
 }
 
 
