@@ -55,6 +55,10 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_chaos.py tests/test_deadlines.py -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_chaos.py -q
 	CHAOS_TEST_SEED=23 python -m pytest tests/test_chaos.py -q
+	@# ISSUE 5 matrix row: the same seeded lifecycle scenario on the
+	@# MULTIPLEXED serving loop — drain/deadline/429 semantics must not
+	@# depend on the engine's prefill/decode rhythm.
+	CHAOS_TEST_SEED=5 CHAOS_MUX=1 python -m pytest tests/test_chaos.py tests/test_deadlines.py -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

@@ -66,6 +66,7 @@ SAFE_OVERRIDES = {
     "BENCH_KV_QUANT": "none",
     "BENCH_QUANT": "int8",
     "BENCH_PREFIX_CACHE": "0",
+    "BENCH_MUX": "0",
 }
 
 
@@ -188,9 +189,29 @@ async def _run_attempt(model: str) -> dict:
     # long-context sweep configs turn it on.
     prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0"))
     spec_ngram = int(os.environ.get("BENCH_SPEC_NGRAM", "0"))
+    # Iteration-level prefill/decode multiplexing + prefix-grouped
+    # admission (ISSUE 5) — on by default here AND in the serve CLI
+    # (TUNNEL_MUX), so the benched config is the deployed default; the
+    # sweep's mux-off twins isolate its effect.
+    mux = os.environ.get("BENCH_MUX", "1") == "1"
+    mux_budget = int(os.environ.get("BENCH_MUX_BUDGET", "0"))
+    # Cold-shared-prefix herd (the ISSUE 5 TTFT workload): prepend this
+    # many tokens of IDENTICAL templated text to every measured client's
+    # prompt — but not the warm client's, so the herd hits the prefix
+    # machinery cold, the way a restart or a template rollout does.
+    shared_prefix_tokens = int(
+        os.environ.get("BENCH_SHARED_PREFIX_TOKENS", "0")
+    )
     if model == "tiny":
-        # tiny is the CPU correctness/fallback path; keep it light.
-        clients, slots, max_tokens = min(clients, 8), min(slots, 8), 32
+        # tiny is the CPU correctness/fallback path; keep it light — but
+        # an EXPLICIT env override wins, so CPU herd experiments (the
+        # ISSUE 5 32-client TTFT A/B) can use the real fan-out.
+        if "BENCH_CLIENTS" not in os.environ:
+            clients = min(clients, 8)
+        if "BENCH_SLOTS" not in os.environ:
+            slots = min(slots, 8)
+        if "BENCH_MAX_TOKENS" not in os.environ:
+            max_tokens = 32
 
     prompt = "Benchmark this tunnel with a steady stream of tokens."
     # Long-prompt runs (chunked-prefill / long-context configs): repeat the
@@ -199,6 +220,16 @@ async def _run_attempt(model: str) -> dict:
     if want_tokens > 0:
         reps = max(1, want_tokens // (len(prompt) + 1))
         prompt = " ".join([prompt] * reps)
+    # Measured clients may carry a shared templated prefix the warm client
+    # never saw (see shared_prefix_tokens above): the herd then exercises
+    # cold prefix dedup, not a pool pre-warmed by the warmup request.
+    measure_prompt = prompt
+    if shared_prefix_tokens > 0:
+        blurb = ("You are a helpful assistant serving through a "
+                 "peer-to-peer tunnel; answer with care and cite the "
+                 "system policy where relevant. ")
+        reps = max(1, -(-shared_prefix_tokens // len(blurb)))
+        measure_prompt = (blurb * reps)[:shared_prefix_tokens] + prompt
 
     _log(
         f"attempt model={model} clients={clients} max_tokens={max_tokens} "
@@ -225,6 +256,7 @@ async def _run_attempt(model: str) -> dict:
             flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
+            mux=mux, mux_budget_tokens=mux_budget,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -247,16 +279,17 @@ async def _run_attempt(model: str) -> dict:
     # this length, and a +1 landing on a bucket boundary would warm the
     # next bucket up while live traffic dispatches the lower one.
     worst = render_chat_prompt(
-        [{"role": "user", "content": f"{prompt} ({clients - 1})"}]
+        [{"role": "user", "content": f"{measure_prompt} ({clients - 1})"}]
     )
     worst_toks = len(engine.tokenizer.encode(worst))
     ctx_cap = worst_toks + max_tokens
     os.environ.setdefault("TUNNEL_WARMUP_VIEW_CAP", str(ctx_cap))
     os.environ.setdefault("TUNNEL_WARMUP_PAR", "4")
-    if prefill_chunk == 0:
+    if engine.ecfg.prefill_chunk == 0:
         # Both prompt shapes the run prefills: the warm client (no " (i)"
-        # suffix) and the measured clients.  Chunked-prefill configs skip
-        # the hint — their prompts take the segment path instead.
+        # suffix) and the measured clients.  Chunked-prefill configs —
+        # including mux, which defaults a segment width in — skip the
+        # hint: their prompts take the segment path instead.
         warm_prompt = render_chat_prompt([{"role": "user", "content": prompt}])
         warm_toks = len(engine.tokenizer.encode(warm_prompt))
         os.environ.setdefault(
@@ -306,7 +339,8 @@ async def _run_attempt(model: str) -> dict:
             results: list = []
             await asyncio.gather(
                 *(
-                    _one_client(port, f"{prompt} ({i})", max_tokens, results, i)
+                    _one_client(port, f"{measure_prompt} ({i})", max_tokens,
+                                results, i)
                     for i in range(clients)
                 )
             )
@@ -315,7 +349,7 @@ async def _run_attempt(model: str) -> dict:
             repo = os.path.dirname(os.path.abspath(__file__))
             cfg = json.dumps({
                 "port": port, "clients": clients,
-                "max_tokens": max_tokens, "prompt": prompt,
+                "max_tokens": max_tokens, "prompt": measure_prompt,
             })
             proc = await asyncio.create_subprocess_exec(
                 sys.executable, os.path.join(repo, "scripts", "bench_clients.py"),
@@ -372,6 +406,14 @@ async def _run_attempt(model: str) -> dict:
         # weights the byte decoder buffers invisible UTF-8 fragments, so the
         # engine's submit→first-token histogram is the accurate lower bound.
         "engine_ttft_p50_ms": round(global_metrics.percentile("engine_ttft_ms", 50), 1),
+        # TTFT decomposition (ISSUE 5): queue wait (submit -> slot) +
+        # prefill execution (slot -> first token, incl. dedup park time).
+        "queue_wait_p50_ms": round(
+            global_metrics.percentile("engine_queue_wait_ms", 50), 1
+        ),
+        "prefill_exec_p50_ms": round(
+            global_metrics.percentile("engine_prefill_exec_ms", 50), 1
+        ),
         "prefill_p50_ms": round(global_metrics.percentile("engine_prefill_ms", 50), 1),
         "decode_fetch_p50_ms": round(
             global_metrics.percentile("engine_decode_fetch_ms", 50), 1
@@ -393,8 +435,17 @@ async def _run_attempt(model: str) -> dict:
         # claims the requested value would misattribute the number.
         "prefix_cache": engine._prefix is not None,
         "spec_ngram": engine.ecfg.spec_ngram,
+        # EFFECTIVE mux knobs (the engine may disable/default them) plus
+        # the herd-shape knob, so every mux row is self-describing.
+        "mux": engine.ecfg.mux,
+        "mux_budget_tokens": engine.ecfg.mux_budget_tokens,
+        "mux_prefill_chunk": engine.ecfg.prefill_chunk,
+        "shared_prefix_tokens": shared_prefix_tokens,
         "prefix_hit_tokens": global_metrics.counter(
             "engine_prefix_hit_tokens_total"
+        ),
+        "prefix_dedup_hits": global_metrics.counter(
+            "engine_prefix_dedup_hits_total"
         ),
         "clients": clients,
         "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,

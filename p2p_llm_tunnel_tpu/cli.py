@@ -87,6 +87,19 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--decode-steps", type=int,
                        default=int(_env("TUNNEL_DECODE_STEPS", "8")),
                        help="decode steps per device call (tpu backend)")
+    serve.add_argument("--decode-steps-eager", type=int,
+                       default=int(_env("TUNNEL_DECODE_STEPS_EAGER", "4")),
+                       help="smaller decode burst used while requests are "
+                            "waiting so an admission is never stuck behind "
+                            "a full burst (0 = no adaptation)")
+    serve.add_argument("--prefill-rows", type=int,
+                       default=int(_env("TUNNEL_PREFILL_ROWS", "8")),
+                       help="rows per batched-prefill program: admissions "
+                            "are chunked and padded to exactly this many "
+                            "rows per dispatch")
+    serve.add_argument("--dtype", default=_env("TUNNEL_DTYPE", "bfloat16"),
+                       help="activation/weight dtype for the in-process "
+                            "engine (bfloat16|float32)")
     serve.add_argument("--max-waiting", type=int,
                        default=int(_env("TUNNEL_MAX_WAITING", "64")),
                        help="admission control: max requests buffered in "
@@ -159,6 +172,25 @@ def build_parser() -> argparse.ArgumentParser:
                             "tokens advance one segment of this size per "
                             "engine step, interleaved with decode (0 = "
                             "whole-prompt prefill)")
+    serve.add_argument("--mux",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_MUX", "1") == "1",
+                       help="iteration-level prefill/decode multiplexing "
+                            "(default ON, matching bench.py): each engine "
+                            "step runs one decode burst plus a budgeted "
+                            "slice of chunked-prefill segments, with "
+                            "prefix-grouped admission deduping shared "
+                            "prompt prefixes across the queue; outputs are "
+                            "byte-identical to the legacy rhythm; disable "
+                            "with --no-mux or TUNNEL_MUX=0")
+    serve.add_argument("--mux-budget-tokens", type=int,
+                       default=int(_env("TUNNEL_MUX_BUDGET_TOKENS", "0")),
+                       help="fixed per-iteration prefill token budget "
+                            "under --mux (0 = adaptive controller)")
+    serve.add_argument("--prefix-pool-blocks", type=int,
+                       default=int(_env("TUNNEL_PREFIX_POOL_BLOCKS", "128")),
+                       help="prefix-cache pool capacity in KV blocks "
+                            "(block 0 is scratch)")
     serve.add_argument("--prefix-cache",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFIX_CACHE", "1") == "1",
@@ -402,7 +434,10 @@ async def _engine_backend(args):
                     model=args.model,
                     num_slots=args.slots,
                     max_seq=args.max_seq,
+                    dtype=args.dtype,
                     decode_steps=args.decode_steps,
+                    decode_steps_eager=args.decode_steps_eager,
+                    prefill_rows=args.prefill_rows,
                     tp=args.tp,
                     sp=args.sp,
                     sp_mode=args.sp_mode,
@@ -417,9 +452,12 @@ async def _engine_backend(args):
                     fused_decode_layer=args.fused_decode_layer,
                     prefix_cache=args.prefix_cache,
                     prefix_cache_dir=pfx_dir,
+                    prefix_pool_blocks=args.prefix_pool_blocks,
                     spec_ngram=args.spec_ngram,
                     spec_k=args.spec_k,
                     prefill_chunk=args.prefill_chunk,
+                    mux=args.mux,
+                    mux_budget_tokens=args.mux_budget_tokens,
                     max_waiting=args.max_waiting,
                     watchdog_budget_s=args.watchdog_budget,
                     seed=seed,

@@ -384,6 +384,24 @@ async def _send_healthz(
         "warmup_compile_s": round(
             global_metrics.gauge("engine_warmup_compile_s"), 1
         ),
+        # ISSUE 5 observability: the TTFT decomposition (queue wait vs
+        # prefill execution), the multiplexing controller's current prefill
+        # budget, and shared-prefix admission dedup — the numbers that say
+        # WHERE time-to-first-token went under load.
+        "ttft_split": {
+            "queue_wait_p50_ms": round(
+                global_metrics.percentile("engine_queue_wait_ms", 50), 1
+            ),
+            "prefill_exec_p50_ms": round(
+                global_metrics.percentile("engine_prefill_exec_ms", 50), 1
+            ),
+        },
+        "mux_budget_tokens": int(
+            global_metrics.gauge("engine_mux_budget_tokens")
+        ),
+        "prefix_dedup_hits": int(
+            global_metrics.counter("engine_prefix_dedup_hits_total")
+        ),
     }
     await _send_simple(
         channel, stream_id, 200 if state == "ok" else 503,

@@ -25,7 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from p2p_llm_tunnel_tpu.engine import sampling
-from p2p_llm_tunnel_tpu.engine.scheduler import GenRequest, RunningSlot, Scheduler
+from p2p_llm_tunnel_tpu.engine.scheduler import (
+    GenRequest,
+    MuxController,
+    RunningSlot,
+    Scheduler,
+)
 from p2p_llm_tunnel_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, Tokenizer
 from p2p_llm_tunnel_tpu.models.config import ModelConfig, get_config
 from p2p_llm_tunnel_tpu.models.transformer import (
@@ -64,7 +69,7 @@ class EngineConfig:
     max_seq: int = 256
     dtype: str = "bfloat16"
     seed: int = 0
-    min_prefill_bucket: int = 16
+    min_prefill_bucket: int = 16  # tunnelcheck: disable=TC08  bucket geometry pins the compiled-program set AND the prefix-cache block size (snapshot compat); changing it per-deploy would orphan every banked program/snapshot — programmatic only
     # Decode steps per XLA call (lax.scan with on-device sampling feedback).
     # Host↔device latency dominates per-token cost — measured ~90 ms RTT per
     # device_get through the tunneled-TPU path — so each fetch must return
@@ -159,7 +164,7 @@ class EngineConfig:
     # path instead — each bucket is one compiled program (warmed up front,
     # never on the serving path), and prefix reuse pays most when tails are
     # short anyway.
-    prefix_tail_buckets: int = 2
+    prefix_tail_buckets: int = 2  # tunnelcheck: disable=TC08  compiled-program-count knob (one chunk program per tail bucket x view); a CLI surface would invite warmup-bill surprises — programmatic only
     # Prompt-lookup speculative decoding (vLLM's ngram speculator): when
     # > 0, each decode dispatch proposes spec_k continuation tokens by
     # matching the last spec_ngram generated/prompt tokens against the
@@ -189,6 +194,25 @@ class EngineConfig:
     # degraded (surfaced by serve's /healthz).  Detection only — a stalled
     # XLA dispatch cannot be safely interrupted.  0 disables.
     watchdog_budget_s: float = 0.0
+    # Iteration-level prefill/decode multiplexing (ISSUE 5; DistServe's
+    # goodput argument): each engine-loop iteration dispatches ONE decode
+    # burst plus up to a token BUDGET of chunked-prefill segment rows,
+    # with the budget adapted by scheduler.MuxController from queue depth,
+    # deadline slack, and a decode-stall bound — a full prefill no longer
+    # occupies the device for a whole bucket while decode stalls.  Makes
+    # prefill_chunk the production path: when it is 0 (and legal), a
+    # default segment width is chosen at startup.  With the prefix cache
+    # on, admission becomes prefix-GROUPED (AlignedServe): queued requests
+    # sharing PrefixIndex block keys prefill the shared prefix ONCE (the
+    # FIFO-first member computes it; later members park and fan out from
+    # the pool), and tail segments batch through one chunk program per
+    # iteration.  Token streams are byte-identical to the non-multiplexed
+    # path (tests/test_mux.py).  Off by default HERE (programmatic users
+    # keep the legacy rhythm); the serve CLI and bench default it ON.
+    mux: bool = False
+    # Fixed per-iteration prefill token budget under mux; 0 = adaptive
+    # (the MuxController).  The A/B lever for interference experiments.
+    mux_budget_tokens: int = 0
 
 
 @dataclass
@@ -212,6 +236,10 @@ class _ActiveRequest:
     decoder: StreamDecoder
     t_submit: float
     first_token_at: Optional[float] = None
+    # When the request won a decode slot — the TTFT decomposition anchor:
+    # queue_wait = t_admitted - t_submit, prefill_exec = first_token_at -
+    # t_admitted (the latter includes any prefix-dedup park time).
+    t_admitted: Optional[float] = None
 
 
 class InferenceEngine:
@@ -387,6 +415,24 @@ class InferenceEngine:
             log.warning("chunked prefill disabled: not supported with sp>1")
             self.ecfg = dc_replace(self.ecfg, prefill_chunk=0)
 
+        # Multiplexing (ISSUE 5): chunked prefill is the production path,
+        # so pick a default segment width when none was configured.  Where
+        # the chunk path is illegal (packed int4 KV sequence axis, sp>1
+        # prefill — both zeroed prefill_chunk above), mux falls back to
+        # budgeted whole-prompt admission waves: interference control
+        # without the segment interleave.
+        if self.ecfg.mux and self.ecfg.prefill_chunk <= 0:
+            if self.ecfg.kv_quant != "int4" and self.ecfg.sp <= 1:
+                # 128 measured best on the 32-client herd (PERF.md r8):
+                # wide enough that a shared-prefix owner drains in a few
+                # sub-batches, narrow enough that one segment's compute
+                # stays comparable to a decode burst.
+                self.ecfg = dc_replace(
+                    self.ecfg,
+                    prefill_chunk=max(self.ecfg.min_prefill_bucket,
+                                      min(128, s)),
+                )
+
         # Prefix cache: host index + device block pool + jitted copy ops.
         self._prefix = None
         if self.ecfg.prefix_cache and self.ecfg.sp > 1:
@@ -472,6 +518,29 @@ class InferenceEngine:
         # each loop iteration advances up to prefill_rows of these by ONE
         # prefill_chunk-token segment (see _dispatch_segments).
         self._segmented: Dict[int, Tuple[RunningSlot, int]] = {}
+        # Multiplexed-admission state (ecfg.mux; ISSUE 5):
+        # - slot-holding whole-prompt rows awaiting a budgeted plain wave
+        #   (configs where the chunk path is illegal, e.g. kv_quant=int4);
+        # - the in-flight shared-prefix registry: chain key -> owner rid,
+        #   plus per-owner bookkeeping and the parked group waiters
+        #   (prefix_cache.plan_group_admission / _mux_wake).
+        self._pending_plain: List[RunningSlot] = []
+        self._inflight_prefix: Dict[bytes, int] = {}
+        self._owner_keys: Dict[int, Tuple[RunningSlot, List[bytes]]] = {}
+        self._prefix_waiters: List[Tuple[RunningSlot, int]] = []
+        # Rids already counted in engine_prefix_dedup_hits_total: the
+        # metric counts ADMISSIONS that deduped, so a waiter re-parked
+        # behind a promoted owner (its first owner died) must not count
+        # twice.  Pruned when the rid proceeds or is dropped — bounded by
+        # the currently-parked set.
+        self._dedup_counted: set = set()
+        self._mux_ctl: Optional[MuxController] = None
+        if self.ecfg.mux:
+            self._mux_ctl = MuxController(
+                self.ecfg.prefill_chunk or self.ecfg.min_prefill_bucket,
+                self.ecfg.prefill_rows,
+                self.ecfg.mux_budget_tokens,
+            )
         self._next_request_id = 1
         self._key = jax.random.fold_in(key, 1)
         self._wake = asyncio.Event()
@@ -969,6 +1038,16 @@ class InferenceEngine:
         need = cap + 2 * self.ecfg.decode_steps + 1
         if self.ecfg.spec_ngram > 0:
             need += self.ecfg.spec_k
+        if self.ecfg.prefill_chunk > 0:
+            # Chunk-prefill dispatches pick their view bucket from
+            # starts.max() + the PADDED segment width (_dispatch_chunk_rows)
+            # — a tail near the context cap reaches cap + prefill_chunk,
+            # which EXCEEDS the decode pad whenever the chunk is wider than
+            # a burst.  Under mux every admission runs through the chunk
+            # program, so missing this term means a cold compile on the
+            # serving path the first time a long prompt's tail lands
+            # (ISSUE 5 warmup-coverage fix; pinned by test_warmup_aot).
+            need = max(need, cap + self.ecfg.prefill_chunk)
         needed = next((v for v in views if v >= need), views[-1])
         return [v for v in views if v <= needed]
 
@@ -1374,6 +1453,13 @@ class InferenceEngine:
             global_metrics.observe(
                 "engine_ttft_ms", (state.first_token_at - state.t_submit) * 1000.0
             )
+            if state.t_admitted is not None:
+                # The execution half of the TTFT decomposition (includes
+                # any prefix-dedup park time; queue_wait is the other half).
+                global_metrics.observe(
+                    "engine_prefill_exec_ms",
+                    (state.first_token_at - state.t_admitted) * 1000.0,
+                )
         global_metrics.inc("engine_tokens_total")
         is_stop = token_id in run.request.stop_ids
         finish = None
@@ -1622,10 +1708,21 @@ class InferenceEngine:
         finishing within the next full burst).  Gating on queue depth alone
         would lock a saturated engine (all slots long-running, queue never
         empty) into small bursts — throughput collapses to the fetch-RTT
-        bound with zero admission-latency benefit."""
+        bound with zero admission-latency benefit.
+
+        Under mux, a non-empty prefill BACKLOG (segments, pending plain
+        rows, parked group waiters) also selects the eager burst
+        unconditionally: backlogged rows advance once per loop iteration,
+        so the burst length IS their wait — a full burst between segment
+        dispatches was the dominant TTFT term on the CPU herd (PERF.md
+        round 8).  The saturation argument above does not apply: the
+        backlog drains by iteration count, not by slot availability."""
         eager = self.ecfg.decode_steps_eager
         if not (eager and 0 < eager < self.ecfg.decode_steps):
             return self.ecfg.decode_steps
+        if self.ecfg.mux and (self._segmented or self._pending_plain
+                              or self._prefix_waiters):
+            return eager
         if self.scheduler.queue_depth == 0:
             return self.ecfg.decode_steps
         full = self.ecfg.decode_steps
@@ -2090,6 +2187,29 @@ class InferenceEngine:
         admitted = self.scheduler.admit()
         if not admitted:
             return
+        self._note_admission(admitted)
+        await self._dispatch_plain_waves(loop, admitted)
+
+    def _note_admission(self, admitted: List[RunningSlot]) -> None:
+        """Stamp slot-admission time and record the queue-wait half of the
+        TTFT decomposition (engine_queue_wait_ms + engine_prefill_exec_ms
+        ≈ engine_ttft_ms, ISSUE 5 observability)."""
+        now = time.monotonic()
+        for run in admitted:
+            st = self._requests.get(run.request.request_id)
+            if st is not None and st.t_admitted is None:
+                st.t_admitted = now
+                global_metrics.observe(
+                    "engine_queue_wait_ms", (now - st.t_submit) * 1000.0
+                )
+
+    async def _dispatch_plain_waves(
+        self, loop, admitted: List[RunningSlot]
+    ) -> None:
+        """Dispatch one admission wave's prefills (see _admit_pending for
+        the pipelining/prefix-match contract).  Callers: the legacy
+        admission path (whole wave), the mux echo route, and the mux
+        budgeted whole-prompt drain (a bounded batch per iteration)."""
         hist_of: Dict[int, int] = {}
         pool_ids_of: Dict[int, List[int]] = {}
         for run in admitted:
@@ -2197,9 +2317,176 @@ class InferenceEngine:
                 self._executor, self._prefix_insert, live
             )
 
-    def _dispatch_segments(self):
-        """Advance up to ``prefill_rows`` chunked-prefill slots by ONE
-        segment each, as one chunk-prefill call (executor thread).
+    # -- multiplexed admission (ISSUE 5) ----------------------------------
+
+    async def _admit_mux(self, loop) -> None:
+        """Multiplexed admission: bind waiting requests to slots (FIFO) and
+        ROUTE them — echo/scoring requests to the legacy whole-prompt wave
+        (they need every prompt position's logits), everything else into
+        the prefill backlog — WITHOUT dispatching prefill work here.  The
+        backlog drains under the iteration token budget in the main loop,
+        interleaved with decode bursts (_mux_budget / _dispatch_segments).
+
+        With the prefix cache on, the wave is grouped by PrefixIndex block
+        keys first (prefix_cache.plan_group_admission): a shared
+        not-yet-pooled prefix is computed by its FIFO-first requester only;
+        later group members park as waiters and fan out from the pool once
+        the owner's blocks land (_mux_wake).  The only device work here is
+        the BATCHED pool copy-in for already-pooled prefixes — every
+        per-request loop body is pure host logic (the TC07 contract).
+        """
+        admitted = self.scheduler.admit()
+        if not admitted:
+            return
+        self._note_admission(admitted)
+        echo = [r for r in admitted if r.request.echo_logprobs]
+        if echo:
+            await self._dispatch_plain_waves(loop, echo)
+        rest = [r for r in admitted if not r.request.echo_logprobs]
+        if not rest:
+            return
+        if self._prefix is None:
+            if self.ecfg.prefill_chunk > 0:
+                for run in rest:
+                    self._segmented[run.slot] = (run, 0)
+            else:
+                self._pending_plain.extend(rest)
+            return
+        await self._plan_mux_wave(loop, rest)
+
+    async def _plan_mux_wave(self, loop, runs: List[RunningSlot]) -> None:
+        """Group ``runs`` (FIFO order) against the pool and the in-flight
+        prefix registry; enqueue the owners, park the waiters.  Shared by
+        fresh admissions and waiter re-planning (_mux_wake), so a woken
+        waiter can itself become the owner of its remaining blocks."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            plan_group_admission,
+        )
+
+        by_rid = {run.request.request_id: run for run in runs}
+        owners, waiters = plan_group_admission(
+            self._prefix,
+            self._inflight_prefix,
+            [(run.request.request_id, run.request.prompt_ids)
+             for run in runs],
+        )
+        for rid, owner_rid in waiters:
+            self._prefix_waiters.append((by_rid[rid], owner_rid))
+            if rid not in self._dedup_counted:
+                self._dedup_counted.add(rid)
+                global_metrics.inc("engine_prefix_dedup_hits_total")
+        hits: List[Tuple[int, List[int]]] = []
+        for rid, hist, pool_ids, keys in owners:
+            run = by_rid[rid]
+            self._dedup_counted.discard(rid)
+            if keys:
+                self._owner_keys[rid] = (run, keys)
+            if hist:
+                hits.append((run.slot, pool_ids))
+                global_metrics.inc("engine_prefix_hit_tokens_total", hist)
+            self._segmented[run.slot] = (run, hist)
+        if hits:
+            # Dispatched before any of the wave's segments (same executor,
+            # same device order), so reused history KV is in place when the
+            # first tail segment reads it.
+            await loop.run_in_executor(  # tunnelcheck: disable=TC07  ONE batched copy call per admission wave (prefill_rows-batched internally), not per request
+                self._executor, self._prefix_copy_in, hits
+            )
+
+    async def _mux_wake(self, loop) -> None:
+        """Release dead owners' in-flight prefix claims and RE-PLAN waiters
+        whose owner finished (its blocks are pooled — _finish_segments
+        inserts before this runs) or died mid-prefill (cancel/expiry: the
+        first waiter is promoted to owner and computes the prefix itself,
+        so a cancelled group head never starves its group).  Runs once per
+        loop iteration; pure host work plus at most one batched copy-in
+        for the woken waiters' pooled prefixes."""
+        for rid, (run, _keys) in list(self._owner_keys.items()):
+            seg = self._segmented.get(run.slot)
+            alive = (self.scheduler.slots[run.slot] is run
+                     and seg is not None and seg[0] is run)
+            if not alive:
+                self._owner_done(rid)
+        if not self._prefix_waiters:
+            return
+        ready: List[RunningSlot] = []
+        still: List[Tuple[RunningSlot, int]] = []
+        for run, owner_rid in self._prefix_waiters:
+            if self.scheduler.slots[run.slot] is not run:
+                # Cancelled/expired while parked; slot reclaimed.
+                self._dedup_counted.discard(run.request.request_id)
+                continue
+            if owner_rid in self._owner_keys:
+                still.append((run, owner_rid))
+            else:
+                ready.append(run)
+        self._prefix_waiters = still
+        if ready:
+            await self._plan_mux_wave(loop, ready)
+
+    def _owner_done(self, rid: int) -> None:
+        """Drop a finished/dead owner's claims from the in-flight prefix
+        registry so its waiters re-plan at the next _mux_wake."""
+        entry = self._owner_keys.pop(rid, None)
+        if entry is None:
+            return
+        for key in entry[1]:
+            if self._inflight_prefix.get(key) == rid:
+                del self._inflight_prefix[key]
+
+    def _mux_budget(self) -> int:
+        """This iteration's prefill budget in SEGMENT ROWS, from the
+        controller's token budget (published as engine_mux_budget_tokens).
+        The backlog is counted in remaining DISPATCH rows — a half-done
+        long prompt contributes its remaining segment count — so a full
+        drain budget really drains it.  On the whole-prompt fallback path
+        the unit is min_prefill_bucket, so the row count is a proxy
+        rather than an exact token bound."""
+        chunk = max(1, self._mux_ctl.unit)
+        backlog = len(self._pending_plain)
+        for run, start in self._segmented.values():
+            rest = len(run.request.prompt_ids) - start
+            backlog += max(1, -(-rest // chunk))
+        n = self.ecfg.num_slots
+        active = int(np.count_nonzero(self._active_mask[:n]))
+        now = time.monotonic()
+        # Every place a not-yet-decoding request can sit: the waiting
+        # queue, the segment backlog, pending whole-prompt rows, and
+        # parked prefix waiters — a tight deadline in ANY of them must
+        # trigger the controller's rescue drain.
+        slacks = [
+            req.deadline - now
+            for req in self.scheduler.waiting
+            if req.deadline is not None
+        ]
+        slacks += [
+            run.request.deadline - now
+            for run, _start in self._segmented.values()
+            if run.request.deadline is not None
+        ]
+        slacks += [
+            run.request.deadline - now
+            for run in self._pending_plain
+            if run.request.deadline is not None
+        ]
+        slacks += [
+            run.request.deadline - now
+            for run, _owner in self._prefix_waiters
+            if run.request.deadline is not None
+        ]
+        tokens = self._mux_ctl.budget_tokens(
+            queue_depth=self.scheduler.queue_depth,
+            backlog_rows=backlog,
+            active_rows=active,
+            min_slack_s=min(slacks) if slacks else None,
+        )
+        global_metrics.set_gauge("engine_mux_budget_tokens", tokens)
+        return tokens // self._mux_ctl.unit
+
+    def _dispatch_segments(self, max_rows: Optional[int] = None):
+        """Advance up to ``prefill_rows`` chunked-prefill slots (or the
+        iteration's ``max_rows`` budget under mux, whichever is smaller)
+        by ONE segment each, as one chunk-prefill call (executor thread).
 
         Returns (rows, first_dev) where rows is [(run, was_final)] in row
         order, or None when nothing is pending.  Every segment pads to the
@@ -2208,7 +2495,10 @@ class InferenceEngine:
         which decode overwrites before it ever becomes attendable (the
         standard prefill pad argument).
         """
-        if not self._segmented:
+        limit = self.ecfg.prefill_rows
+        if max_rows is not None:
+            limit = min(limit, max_rows)
+        if not self._segmented or limit <= 0:
             return None
         chunk = self.ecfg.prefill_chunk
         picked: List[Tuple[RunningSlot, int]] = []
@@ -2218,7 +2508,7 @@ class InferenceEngine:
                 del self._segmented[slot]
                 continue
             picked.append((run, start))
-            if len(picked) == self.ecfg.prefill_rows:
+            if len(picked) == limit:
                 break
         if not picked:
             return None
@@ -2312,19 +2602,54 @@ class InferenceEngine:
                     continue
 
                 self._expire_deadlines()
-                await self._admit_pending(loop)
+                if self.ecfg.mux:
+                    await self._admit_mux(loop)
+                    await self._mux_wake(loop)
+                else:
+                    await self._admit_pending(loop)
 
                 global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
                 global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
 
-                # One chunked-prefill segment per iteration, dispatched before
-                # the decode burst: long prompts make steady progress while
-                # every running stream keeps decoding — the interleave that
-                # bounds how long one big prompt can stall the batch.
-                seg = (
-                    await loop.run_in_executor(self._executor, self._dispatch_segments)
-                    if self._segmented else None
-                )
+                # Prefill work for this iteration, dispatched before the
+                # decode burst.  Non-mux: one prefill_rows-wide segment
+                # sub-batch — the pre-ISSUE-5 interleave that bounds how
+                # long one big prompt can stall the batch.  Mux: the
+                # controller's budgeted slice — pending whole-prompt rows
+                # and/or segment rows up to this iteration's token budget.
+                segs: List = []
+                if self.ecfg.mux:
+                    rows_budget = self._mux_budget()
+                    if self._pending_plain and rows_budget > 0:
+                        take = min(rows_budget, len(self._pending_plain))
+                        batch = [
+                            r for r in self._pending_plain[:take]
+                            if self.scheduler.slots[r.slot] is r
+                        ]
+                        del self._pending_plain[:take]
+                        if batch:
+                            await self._dispatch_plain_waves(loop, batch)
+                        rows_budget -= take
+                    # The budget may span several prefill_rows-wide
+                    # sub-batches: dispatch them back-to-back (the device
+                    # queues them; fetches pipeline in _finish_segments),
+                    # so a drain budget costs ONE iteration, not one
+                    # iteration per sub-batch.
+                    while self._segmented and rows_budget > 0:
+                        seg = await loop.run_in_executor(  # tunnelcheck: disable=TC07  one dispatch per prefill_rows-wide sub-batch of the iteration budget, back-to-back
+                            self._executor, self._dispatch_segments,
+                            rows_budget,
+                        )
+                        if seg is None:
+                            break
+                        segs.append(seg)
+                        rows_budget -= len(seg[0])
+                elif self._segmented:
+                    seg = await loop.run_in_executor(
+                        self._executor, self._dispatch_segments
+                    )
+                    if seg is not None:
+                        segs.append(seg)
 
                 if self._spec_usable() and any(self._active_mask):
                     # Speculative step (opt-in): synchronous dispatch+fetch
@@ -2344,7 +2669,7 @@ class InferenceEngine:
                         self._executor, self._dispatch_spec
                     )
                     await self._process_spec(spec_out, spec_assign)
-                    if seg is not None:
+                    for seg in segs:
                         await self._finish_segments(loop, seg)
                     continue
 
@@ -2374,9 +2699,10 @@ class InferenceEngine:
                         "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                     )
                     await self._process_burst(outs, assign)
-                if seg is not None:
-                    # Fetched after the decode work above, so the segment's
-                    # device→host RTT rides under real compute.
+                for seg in segs:
+                    # Fetched after the decode work above, so each segment
+                    # sub-batch's device→host RTT rides under real compute
+                    # (and under its successor sub-batches').
                     await self._finish_segments(loop, seg)
                 in_flight = current
         except Exception:

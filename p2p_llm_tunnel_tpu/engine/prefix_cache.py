@@ -172,6 +172,61 @@ class PrefixIndex:
         return out
 
 
+def plan_group_admission(
+    index: PrefixIndex,
+    inflight: Dict[bytes, int],
+    wave: List[Tuple[int, List[int]]],
+) -> Tuple[List[Tuple[int, int, List[int], List[bytes]]],
+           List[Tuple[int, int]]]:
+    """Prefix-aware batched admission planning (ISSUE 5, AlignedServe):
+    decide, for a FIFO wave of admitted requests, who PREFILLS and who
+    WAITS, so a shared not-yet-pooled prefix is computed exactly once.
+
+    ``wave`` is ``[(rid, prompt_ids)]`` in FIFO order.  ``inflight`` maps
+    chain keys of prompt blocks currently being prefilled by an admitted
+    request to the owning rid, and is UPDATED IN PLACE (new owners
+    register their missing block keys).  Pure host logic — no device work
+    — so the admission loop stays dispatch-free per request (TC07) and the
+    fairness properties are unit-testable (tests/test_mux.py).
+
+    Returns ``(owners, waiters)``:
+
+    - ``owners`` — ``[(rid, hist_tokens, pool_ids, missing_keys)]``:
+      proceed now; their pooled prefix (``hist_tokens`` tokens via
+      ``pool_ids``) is copied in and the tail prefills.  ``missing_keys``
+      are the chain keys this request will compute and later insert; the
+      caller must release them (and re-plan this owner's waiters) when the
+      prefill completes or the request dies.
+    - ``waiters`` — ``[(rid, owner_rid)]``: the request's FIRST missing
+      block is already being computed by ``owner_rid``.  Chain keys commit
+      to the whole prefix (block i's key hashes blocks [0, i]), so sharing
+      that one key proves the waiter's entire uncached prefix up to and
+      including it is the owner's work — park, and re-plan against the
+      pool once the owner's blocks land.
+
+    FIFO is preserved within a group by construction: the owner is the
+    group's first-arriving member (earlier wave entries register keys
+    before later ones consult them), and callers wake waiters in arrival
+    order.
+    """
+    owners: List[Tuple[int, int, List[int], List[bytes]]] = []
+    waiters: List[Tuple[int, int]] = []
+    for rid, prompt_ids in wave:
+        hist, ids = index.match(prompt_ids)
+        missing = index.missing(prompt_ids)
+        if missing:
+            first_key = missing[0][1]
+            owner = inflight.get(first_key)
+            if owner is not None and owner != rid:
+                waiters.append((rid, owner))
+                continue
+        keys = [k for _, k in missing]
+        for k in keys:
+            inflight[k] = rid
+        owners.append((rid, hist, ids, keys))
+    return owners, waiters
+
+
 def save_pool_snapshot(
     dirpath: str, pool: Dict[str, jnp.ndarray], index: PrefixIndex,
     meta: Dict,

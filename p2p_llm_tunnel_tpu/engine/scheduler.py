@@ -14,6 +14,101 @@ from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Tuple
 
 
+class MuxController:
+    """Adaptive iteration-level prefill token budget (pure logic).
+
+    The multiplexed engine loop (ISSUE 5, DistServe's prefill/decode
+    interference argument) dispatches ONE decode burst per iteration plus
+    up to ``budget_tokens`` of prefill work — chunked-prefill segment rows,
+    or whole-prompt rows on configs where the chunk path is illegal.  This
+    controller picks that budget each iteration from three signals:
+
+    - **queue depth + prefill backlog** (admission pressure): more queued
+      work widens the budget toward the cap;
+    - **per-request deadline slack**: a queued/backlogged request within
+      ``SLACK_RESCUE_S`` of its deadline gets the full cap — shedding a
+      request at its deadline because the controller was polite to decode
+      is the worst goodput outcome;
+    - **a decode-stall bound**: while decode streams are live, prefill is
+      capped at a fraction of the row width (quarter normally, half under
+      pressure), so one iteration's prefill work can never stall running
+      streams for more than a bounded slice of the loop cadence.
+
+    Pure and deterministic on purpose (same charter as :class:`Scheduler`):
+    the engine feeds it host-side observations; unit tests drive it with
+    fake ones (tests/test_mux.py).
+    """
+
+    #: Queued work within this many seconds of its deadline lifts the
+    #: budget to the cap regardless of decode pressure.
+    SLACK_RESCUE_S = 1.0
+
+    def __init__(self, unit_tokens: int, max_rows: int,
+                 fixed_tokens: int = 0):
+        if unit_tokens < 1 or max_rows < 1:
+            raise ValueError("unit_tokens and max_rows must be >= 1")
+        self.unit = unit_tokens
+        self.max_rows = max_rows
+        #: Operator override (EngineConfig.mux_budget_tokens): a fixed
+        #: budget disables adaptation entirely — the A/B lever.
+        self.fixed = fixed_tokens
+
+    @property
+    def cap_tokens(self) -> int:
+        return self.unit * self.max_rows
+
+    def budget_tokens(
+        self,
+        *,
+        queue_depth: int,
+        backlog_rows: int,
+        active_rows: int,
+        min_slack_s: Optional[float] = None,
+    ) -> int:
+        """Prefill token budget for ONE loop iteration.
+
+        ``backlog_rows`` counts remaining prefill DISPATCH rows (segments
+        still to run + pending whole-prompt rows — the engine sums
+        per-request remaining segment counts); ``active_rows`` counts live
+        decode streams; ``min_slack_s`` is the tightest deadline slack
+        across queued + backlogged requests (None = no deadlines).  The
+        returned budget may exceed one dispatch's width — the engine
+        pipelines it as back-to-back ``prefill_rows``-wide sub-batches.
+        """
+        demand = queue_depth + backlog_rows
+        if demand <= 0:
+            return 0
+        drain = max(1, backlog_rows) * self.unit
+        if self.fixed > 0:
+            # Clamped to at least one dispatch row: a fixed budget below
+            # the segment width would otherwise floor to zero rows at the
+            # engine and stall every admission forever.
+            return min(max(self.fixed, self.unit), drain)
+        if active_rows == 0:
+            # Nothing to stall: drain the whole backlog this iteration
+            # (the engine pipelines it as back-to-back sub-batches).
+            return drain
+        if min_slack_s is not None and min_slack_s < self.SLACK_RESCUE_S:
+            return drain
+        if demand >= active_rows:
+            # More work waiting than streams running: admission pressure
+            # dominates goodput (DistServe) — throttling prefill here
+            # idles decode slots to protect the few streams already
+            # holding them, and the iteration overhead of a dribbled
+            # drain costs MORE decode throughput than the stall it avoids
+            # (measured on the 32-client CPU herd: the throttled drain
+            # doubled TTFT p50 at a 10% tok/s loss, PERF.md round 8).
+            return drain
+        # Decode-stall bound: with a mostly-busy batch and a shallow
+        # queue, live streams keep at least half (under pressure) /
+        # three quarters (normally) of each iteration's work.
+        if demand >= self.max_rows:
+            rows = max(1, self.max_rows // 2)
+        else:
+            rows = max(1, self.max_rows // 4)
+        return min(rows * self.unit, drain)
+
+
 class QueueFull(Exception):
     """The bounded waiting queue is at capacity; shed instead of buffering.
 

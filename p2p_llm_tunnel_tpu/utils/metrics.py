@@ -29,6 +29,15 @@ METRICS_CATALOG: Dict[str, str] = {
     "engine_spec_accepted_tokens_total": "draft tokens accepted by verify (counter)",
     "engine_prefix_hit_tokens_total": "prompt tokens served from prefix cache (counter)",
     "engine_prefix_saved_blocks_total": "KV blocks saved into prefix cache (counter)",
+    "engine_prefix_dedup_hits_total": (
+        "admissions parked behind an in-flight shared-prefix prefill "
+        "instead of recomputing it (counter; ISSUE 5 prefix-grouped "
+        "admission)"
+    ),
+    "engine_mux_budget_tokens": (
+        "per-iteration prefill token budget picked by the multiplexing "
+        "controller (gauge; 0 when idle or mux off)"
+    ),
     "engine_deadline_timeouts_total": "requests evicted at their deadline (counter)",
     "engine_watchdog_stalls_total": "decode-stall watchdog trips (counter)",
     "engine_queue_depth": "requests waiting for a slot (gauge)",
@@ -43,6 +52,15 @@ METRICS_CATALOG: Dict[str, str] = {
         "(gauge; the number a chip window must fit before serving)"
     ),
     "engine_ttft_ms": "time to first token per request (histogram, ms)",
+    "engine_queue_wait_ms": (
+        "submit -> decode-slot admission wait per request (histogram, ms; "
+        "the queueing half of the TTFT decomposition)"
+    ),
+    "engine_prefill_exec_ms": (
+        "slot admission -> first token per request (histogram, ms; the "
+        "execution half of the TTFT decomposition, incl. prefix-dedup "
+        "park time)"
+    ),
     "engine_prefill_ms": "prefill step latency (histogram, ms)",
     "engine_decode_fetch_ms": "device->host fetch of a sampled block (histogram, ms)",
     # -- serve endpoint --------------------------------------------------

@@ -113,6 +113,31 @@ GRID = [
                               "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
                               "BENCH_DECODE_STEPS": "24",
                               "SWEEP_DEADLINE_S": "900"}),
+    # ISSUE 5 multiplexing twins at the fused hero config, right after the
+    # hero they twin: same weights/KV/kernels, only the serving rhythm
+    # differs (BENCH_MUX recorded in the row), so the pair isolates what
+    # iteration-level prefill/decode interleaving costs or buys in decode
+    # tok/s and TTFT at the throughput shape.  (kv4 keeps prefix grouping
+    # off — packed sequence axis — so this pair measures the interleave
+    # term alone; the mux-herd pair below measures the dedup term.)
+    ("mux-kv4-fused-64x24", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+                             "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+                             "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+                             "BENCH_DECODE_STEPS": "24",
+                             "SWEEP_DEADLINE_S": "900"}),
+    ("mux-off-kv4-fused-64x24", {"BENCH_QUANT": "int4",
+                                 "BENCH_KV_QUANT": "int4",
+                                 "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "0",
+                                 "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+                                 "BENCH_DECODE_STEPS": "24",
+                                 "SWEEP_DEADLINE_S": "900"}),
+    # Cold shared-prefix herd at the base shape (the ISSUE 5 TTFT bar):
+    # 32 clients whose prompts share a ~256-token templated prefix the
+    # warm request never touched.  The off twin quantifies what the herd
+    # costs WITHOUT prefix-grouped admission + segment interleave.
+    ("mux-herd", {"BENCH_MUX": "1", "BENCH_SHARED_PREFIX_TOKENS": "256"}),
+    ("mux-herd-off", {"BENCH_MUX": "0",
+                      "BENCH_SHARED_PREFIX_TOKENS": "256"}),
     # Joint-target variant: 48 slots raise the decode ceiling without the
     # 64-wide admission herd that blows the <400 ms TTFT bar.  All-fresh
     # programs: compiles alone can eat the default 420 s on this 1-core

@@ -210,9 +210,13 @@ async def _scenario(seed: int):
     from p2p_llm_tunnel_tpu.engine.api import engine_backend
     from p2p_llm_tunnel_tpu.engine.engine import EngineConfig, InferenceEngine
 
+    # CHAOS_MUX=1 (the `make chaos` matrix, ISSUE 5) reruns the whole
+    # lifecycle scenario — deadline eviction, 429 shedding, drain — on the
+    # multiplexed serving loop; semantics must be rhythm-independent.
     engine = InferenceEngine(engine_cfg=EngineConfig(
         model="tiny", num_slots=1, max_seq=512, dtype="float32",
         decode_steps=4, max_waiting=1,
+        mux=os.environ.get("CHAOS_MUX", "0") == "1",
     ))
     await engine.start()
     serve_ch, client_ch = loopback_pair()

@@ -435,3 +435,128 @@ def test_watchdog_marks_degraded_and_recovers():
             await engine.stop()
 
     asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# mux fairness: prefix groups vs deadlines & cancellation (ISSUE 5; slow)
+# ---------------------------------------------------------------------------
+# The pure FIFO/exactly-once properties are pinned property-style in
+# tests/test_mux.py over plan_group_admission; these compose the group
+# machinery with the engine's expire() and cancellation paths.
+
+
+@pytest.mark.slow
+def test_mux_group_fifo_preserved_and_parked_waiter_expires():
+    """Under prefix-grouped admission: (a) first tokens within a prefix
+    group arrive in FIFO submission order; (b) a group member whose
+    deadline passes while PARKED behind the owner's prefill is evicted by
+    expire() with DeadlineExceeded (slot reclaimed), and the rest of the
+    group — including LATER-arriving members — still completes: waiting
+    never starves anyone past a deadline silently."""
+    from p2p_llm_tunnel_tpu.engine.engine import (
+        DeadlineExceeded,
+        EngineConfig,
+        InferenceEngine,
+    )
+
+    async def main():
+        engine = InferenceEngine(engine_cfg=EngineConfig(
+            model="tiny", num_slots=8, max_seq=256, dtype="float32",
+            min_prefill_bucket=16, mux=True, prefix_cache=True,
+        ))
+        await engine.start()
+        shared = list(range(1, 100))  # 6 pooled blocks
+        first_order: list = []
+        outcomes = {}
+
+        async def one(tag, tail, deadline=None):
+            try:
+                got_first = False
+                async for _ev in engine.generate(
+                    shared + [tail], max_new_tokens=4, stop_ids=(),
+                    deadline=deadline,
+                ):
+                    if not got_first:
+                        got_first = True
+                        first_order.append(tag)
+                outcomes[tag] = "done"
+            except DeadlineExceeded:
+                outcomes[tag] = "expired"
+
+        try:
+            tasks = []
+            # Submission order pinned: each generator's submit() runs
+            # before the next task is created.
+            for i, tag in enumerate(["owner", "w1", "w2", "w3"]):
+                # w2 gets a deadline far too tight for the owner's cold
+                # chunk-program compile (seconds on this host) — it MUST
+                # expire while parked, not hang.
+                dl = (time.monotonic() + 0.3) if tag == "w2" else None
+                tasks.append(asyncio.create_task(one(tag, 200 + i, dl)))
+                await asyncio.sleep(0.05)
+            await asyncio.wait_for(asyncio.gather(*tasks), 120.0)
+        finally:
+            await engine.stop()
+        return first_order, outcomes
+
+    first_order, outcomes = asyncio.run(main())
+    assert outcomes["w2"] == "expired"
+    assert [t for t in ("owner", "w1", "w3") if outcomes[t] == "done"] == [
+        "owner", "w1", "w3"
+    ]
+    # FIFO within the group among survivors.
+    assert first_order == ["owner", "w1", "w3"]
+    # The expired waiter's slot was reclaimed (nothing leaked).
+    assert global_metrics.counter("engine_deadline_timeouts_total") >= 1
+
+
+@pytest.mark.slow
+def test_mux_owner_cancel_mid_group_does_not_strand_waiters():
+    """Cancelling the group head mid-prefill promotes the first waiter to
+    owner (prefix_cache.plan_group_admission re-plan): the remaining
+    members complete, the in-flight registry drains, and nothing hangs."""
+    from p2p_llm_tunnel_tpu.engine.engine import (
+        EngineConfig,
+        InferenceEngine,
+    )
+
+    async def main():
+        engine = InferenceEngine(engine_cfg=EngineConfig(
+            model="tiny", num_slots=8, max_seq=256, dtype="float32",
+            min_prefill_bucket=16, mux=True, prefix_cache=True,
+        ))
+        await engine.start()
+        shared = list(range(1, 130))  # 8 blocks: a multi-segment owner
+
+        async def one(tail, n=3):
+            got = []
+            async for ev in engine.generate(
+                shared + [tail], max_new_tokens=n, stop_ids=()
+            ):
+                got.append(ev.token_id)
+            return got
+
+        try:
+            owner_task = asyncio.create_task(one(201, n=64))
+            await asyncio.sleep(0.05)  # owner submitted first
+            waiter_tasks = [asyncio.create_task(one(202 + i))
+                            for i in range(3)]
+            await asyncio.sleep(0.2)  # inside the owner's cold compile
+            owner_task.cancel()
+            try:
+                await owner_task
+            except asyncio.CancelledError:
+                pass
+            waited = await asyncio.wait_for(
+                asyncio.gather(*waiter_tasks), 120.0
+            )
+            # Group bookkeeping fully drained.
+            assert engine._prefix_waiters == []
+            assert engine._owner_keys == {}
+            assert engine._inflight_prefix == {}
+        finally:
+            await engine.stop()
+        return waited
+
+    waited = asyncio.run(main())
+    assert all(len(w) == 3 for w in waited)

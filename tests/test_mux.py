@@ -1,0 +1,392 @@
+"""Goodput multiplexing (ISSUE 5): the iteration-level token-budget
+scheduler and prefix-grouped batched admission.
+
+Three layers, matching where the machinery lives:
+- pure controller logic (scheduler.MuxController) — no asyncio, no JAX;
+- pure group planning (prefix_cache.plan_group_admission) driven
+  property-style through multi-round simulations over the REAL
+  PrefixIndex — each shared block computed exactly once, FIFO preserved
+  within a group, owner death never strands waiters;
+- engine-backed behavior (token identity vs the non-multiplexed path,
+  shared-prefix herd dedup, kv-quant composition) — JAX compiles, slow.
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+    PrefixIndex,
+    plan_group_admission,
+)
+from p2p_llm_tunnel_tpu.engine.scheduler import MuxController
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+
+# ---------------------------------------------------------------------------
+# controller: pure budget arithmetic
+# ---------------------------------------------------------------------------
+
+def test_controller_zero_demand_zero_budget():
+    ctl = MuxController(64, 8)
+    assert ctl.budget_tokens(queue_depth=0, backlog_rows=0,
+                             active_rows=4) == 0
+
+
+def test_controller_full_drain_when_decode_idle():
+    """No live streams: the whole backlog drains this iteration — even
+    past the single-dispatch width (the engine pipelines sub-batches)."""
+    ctl = MuxController(64, 8)
+    assert ctl.budget_tokens(queue_depth=3, backlog_rows=20,
+                             active_rows=0) == 20 * 64
+
+
+def test_controller_admission_pressure_beats_stall_bound():
+    """More work waiting than streams running: throttling prefill would
+    idle slots to protect the few streams holding them — drain the whole
+    backlog (the goodput rule; measured on the 32-client herd, a dribbled
+    drain doubled TTFT p50 at a 10% tok/s loss — PERF.md r8)."""
+    ctl = MuxController(64, 8)
+    assert ctl.budget_tokens(queue_depth=8, backlog_rows=4,
+                             active_rows=4) == 4 * 64
+    assert ctl.budget_tokens(queue_depth=0, backlog_rows=31,
+                             active_rows=1) == 31 * 64
+
+
+def test_controller_decode_stall_bound():
+    """With a mostly-busy batch and a SHALLOW queue, prefill is capped at
+    a quarter of the dispatch width normally, half under moderate
+    pressure — never the whole backlog."""
+    ctl = MuxController(64, 8)
+    calm = ctl.budget_tokens(queue_depth=0, backlog_rows=3, active_rows=16)
+    assert calm == 2 * 64  # 8 // 4 rows
+    pressed = ctl.budget_tokens(queue_depth=4, backlog_rows=8,
+                                active_rows=16)
+    assert pressed == 4 * 64  # 8 // 2 rows
+    assert pressed < 8 * 64
+
+
+def test_controller_deadline_rescue_overrides_stall_bound():
+    ctl = MuxController(64, 8)
+    assert ctl.budget_tokens(
+        queue_depth=0, backlog_rows=4, active_rows=8,
+        min_slack_s=0.5,
+    ) == 4 * 64  # full drain
+    # Comfortable slack does not trigger the rescue.
+    assert ctl.budget_tokens(
+        queue_depth=0, backlog_rows=4, active_rows=8,
+        min_slack_s=10.0,
+    ) == 2 * 64  # quarter width
+
+
+def test_controller_fixed_budget_below_unit_still_yields_a_row():
+    """A fixed budget smaller than one segment width must clamp UP to one
+    dispatch row — flooring to zero rows would stall every admission
+    forever (the engine guards on rows > 0)."""
+    ctl = MuxController(128, 8, fixed_tokens=64)
+    got = ctl.budget_tokens(queue_depth=2, backlog_rows=2, active_rows=1)
+    assert got >= ctl.unit
+
+
+def test_controller_fixed_budget_disables_adaptation():
+    ctl = MuxController(64, 8, fixed_tokens=128)
+    for active in (0, 4, 8):
+        assert ctl.budget_tokens(queue_depth=5, backlog_rows=5,
+                                 active_rows=active,
+                                 min_slack_s=0.1) == 128
+    # But never above the actual backlog (a huge fixed budget cannot ask
+    # for rows that do not exist).
+    assert MuxController(64, 8, fixed_tokens=10_000).budget_tokens(
+        queue_depth=1, backlog_rows=2, active_rows=1
+    ) == 2 * 64
+
+
+def test_controller_always_at_least_one_row_under_demand():
+    """Queued-but-unadmitted demand with an empty backlog still yields a
+    one-row budget, never zero (the gauge stays meaningful)."""
+    ctl = MuxController(32, 1)
+    assert ctl.budget_tokens(queue_depth=1, backlog_rows=0,
+                             active_rows=1) == 32
+
+
+# ---------------------------------------------------------------------------
+# group planning: property-style simulation over the real PrefixIndex
+# ---------------------------------------------------------------------------
+
+BLOCK = 4
+
+
+def _simulate(prompts, cancel_rids=frozenset(), capacity=256):
+    """Drive plan_group_admission through wake rounds the way the engine
+    does: owners 'prefill' (their missing blocks are counted as computed,
+    then inserted into the index), cancelled owners die without
+    inserting, waiters re-plan when their owner's claims drop.
+
+    Returns (completion order, computed block-key multiset counter,
+    per-rid prefilled token counts)."""
+    from collections import Counter
+
+    index = PrefixIndex(BLOCK, capacity)
+    inflight = {}
+    pending = list(prompts)  # [(rid, prompt_ids)] FIFO
+    parked = []  # [(rid, owner_rid)]
+    done = []
+    computed = Counter()
+    prefilled = {}
+    for _round in range(10 * len(prompts) + 10):
+        if not pending and not parked:
+            break
+        owners, waiters = plan_group_admission(index, inflight, pending)
+        pending = []
+        parked.extend(waiters)
+        by_rid = dict(prompts)
+        dead_owners = set()
+        for rid, hist, _ids, keys in owners:
+            prompt = by_rid[rid]
+            if rid in cancel_rids:
+                # Dies mid-prefill: claims drop, nothing inserted.
+                for k in keys:
+                    if inflight.get(k) == rid:
+                        del inflight[k]
+                dead_owners.add(rid)
+                done.append(rid)
+                continue
+            computed.update(keys)
+            prefilled[rid] = len(prompt) - hist
+            # Completion: the engine inserts the computed blocks, then
+            # releases the claims (_owner_done via the wake pass).
+            for blk_no, key in index.missing(prompt):
+                (pool_id,) = index.allocate([key]) or (None,)
+                assert pool_id is not None  # capacity sized to fit
+            for k in keys:
+                if inflight.get(k) == rid:
+                    del inflight[k]
+            done.append(rid)
+        live_owner_rids = set(inflight.values())
+        ready = [rid for rid, orid in parked if orid not in live_owner_rids]
+        parked = [(rid, orid) for rid, orid in parked
+                  if orid in live_owner_rids]
+        pending = [(rid, by_rid[rid]) for rid in ready]
+    assert not pending and not parked, "simulation failed to converge"
+    return done, computed, prefilled
+
+
+def test_group_shared_prefix_computed_exactly_once():
+    shared = list(range(100, 100 + 4 * BLOCK))  # 4 full shared blocks
+    prompts = [(rid, shared + [rid]) for rid in range(1, 9)]
+    done, computed, prefilled = _simulate(prompts)
+    # Every chain key computed exactly once across the whole herd.
+    assert computed and all(n == 1 for n in computed.values())
+    # The owner computed the full prompt; every waiter only its 1-token
+    # tail (the distinct id past the 4 pooled blocks).
+    assert prefilled[1] == len(prompts[0][1])
+    for rid in range(2, 9):
+        assert prefilled[rid] == 1
+    # FIFO preserved within the group.
+    assert done == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def test_group_owner_cancel_promotes_first_waiter():
+    shared = list(range(50, 50 + 3 * BLOCK))
+    prompts = [(rid, shared + [rid]) for rid in (1, 2, 3)]
+    done, computed, prefilled = _simulate(prompts, cancel_rids={1})
+    # rid 2 (the first waiter) was promoted and computed the prefix; the
+    # group converged without rid 1's work.
+    assert done == [1, 2, 3]
+    assert all(n == 1 for n in computed.values())
+    assert prefilled[2] == len(prompts[1][1])
+    assert prefilled[3] < len(prompts[2][1])
+
+
+def test_group_planning_property_random_waves():
+    """Property-style: random mixes of shared-prefix families and unique
+    prompts, random cancellations — every computed chain key is computed
+    at most once, FIFO order holds within each family, and the
+    simulation always converges (no waiter is stranded)."""
+    import random
+
+    for seed in range(12):
+        rng = random.Random(seed)
+        prompts = []
+        rid = 0
+        families = {}
+        for fam in range(rng.randint(1, 4)):
+            base = [1000 * (fam + 1) + t
+                    for t in range(rng.randint(1, 5) * BLOCK)]
+            for _ in range(rng.randint(1, 6)):
+                rid += 1
+                prompts.append((rid, base + [rid]))
+                families.setdefault(fam, []).append(rid)
+        rng.shuffle(prompts)
+        cancel = {r for r, _ in prompts if rng.random() < 0.2}
+        done, computed, _ = _simulate(prompts, cancel_rids=cancel)
+        assert all(n == 1 for n in computed.values()), (seed, computed)
+        assert sorted(done) == sorted(r for r, _ in prompts)
+        order = {r: i for i, r in enumerate(done)}
+        fifo = {r: i for i, (r, _p) in enumerate(prompts)}
+        for members in families.values():
+            live = [r for r in members if r not in cancel]
+            arrival = sorted(live, key=fifo.get)
+            completion = sorted(live, key=order.get)
+            assert completion == arrival, (seed, members)
+
+
+def test_group_planning_no_dedup_across_different_prefixes():
+    prompts = [(1, [10] * (2 * BLOCK) + [1]),
+               (2, [20] * (2 * BLOCK) + [2])]
+    index = PrefixIndex(BLOCK, 64)
+    owners, waiters = plan_group_admission(index, {}, prompts)
+    assert [o[0] for o in owners] == [1, 2]
+    assert waiters == []
+
+
+# ---------------------------------------------------------------------------
+# engine-backed: token identity + herd dedup (JAX; slow)
+# ---------------------------------------------------------------------------
+
+pytestmark_slow = pytest.mark.slow
+
+
+def _cfg(**kw):
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig
+
+    base = dict(model="tiny", num_slots=8, max_seq=256, dtype="float32",
+                min_prefill_bucket=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+async def _gen(eng, prompt, max_new=6):
+    out = []
+    async for ev in eng.generate(prompt, max_new_tokens=max_new,
+                                 stop_ids=()):
+        out.append(ev.token_id)
+    return out
+
+
+def _herd(cfg, prompts, max_new=6):
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    async def main():
+        eng = InferenceEngine(engine_cfg=cfg)
+        await eng.start()
+        try:
+            return await asyncio.gather(
+                *(_gen(eng, p, max_new) for p in prompts)
+            ), eng
+        finally:
+            await eng.stop()
+
+    return asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_mux_token_identity_vs_plain_path():
+    """ISSUE 5 acceptance: multiplexed serving emits byte-identical token
+    streams to the pure non-multiplexed path (whole-prompt prefill, no
+    prefix reuse, no segments) for a fixed seed/workload."""
+    prompts = [list(range(1, 90)) + [200 + i] for i in range(6)]
+    plain, _ = _herd(_cfg(mux=False, prefix_cache=False, prefill_chunk=0),
+                     prompts)
+    muxed, eng = _herd(_cfg(mux=True, prefix_cache=True), prompts)
+    assert muxed == plain
+    assert eng.ecfg.prefill_chunk > 0  # mux defaulted the segment width in
+
+
+@pytest.mark.slow
+def test_mux_token_identity_int8_kv_same_chunk_config():
+    """kv_quant=int8: multiplexing is still a pure SCHEDULING change —
+    byte-identical to the non-multiplexed engine at the same
+    prefill_chunk.  (The whole-prompt program is not the baseline here:
+    under a quantized KV cache the chunk path's tail attends QUANTIZED
+    history while a single prefill pass attends full precision, so the
+    first sampled token can legitimately differ between those two
+    programs — a pre-existing chunk-path property, independent of mux.)"""
+    prompts = [list(range(1, 90)) + [200 + i] for i in range(6)]
+    base, _ = _herd(_cfg(kv_quant="int8", mux=False, prefix_cache=True,
+                         prefill_chunk=64), prompts)
+    muxed, _ = _herd(_cfg(kv_quant="int8", mux=True, prefix_cache=True,
+                          prefill_chunk=64), prompts)
+    assert muxed == base
+
+
+@pytest.mark.slow
+def test_mux_kv_int4_falls_back_to_budgeted_plain_waves():
+    """kv_quant=int4 keeps its packed-sequence-axis scope limits (no chunk
+    path, no prefix cache) — mux degrades to budgeted whole-prompt waves
+    and stays token-identical to the non-multiplexed int4 path."""
+    prompts = [list(range(1, 60)) + [300 + i] for i in range(5)]
+    plain, _ = _herd(_cfg(kv_quant="int4", mux=False), prompts)
+    muxed, eng = _herd(_cfg(kv_quant="int4", mux=True), prompts)
+    assert muxed == plain
+    assert eng.ecfg.prefill_chunk == 0  # chunk path stays gated off
+    assert eng.ecfg.mux
+
+
+@pytest.mark.slow
+def test_mux_herd_prefills_shared_prefix_exactly_once():
+    """ISSUE 5 acceptance: a herd of N requests with a common template
+    prefix executes the prefix prefill exactly once — proven two ways:
+    the dedup counter reads N-1, and the prefill-token counter carries
+    ONE copy of the shared prefix plus N small tails (vs N full prompts
+    on the non-grouped path)."""
+    n = 8
+    shared = list(range(1, 100))  # 99 tokens -> 6 pooled blocks of 16
+    prompts = [shared + [200 + i] for i in range(n)]
+
+    global_metrics.reset()
+    plain, _ = _herd(_cfg(mux=False, prefix_cache=False, prefill_chunk=0),
+                     prompts)
+    plain_tokens = global_metrics.counter("engine_prefill_tokens_total")
+    assert plain_tokens == n * len(prompts[0])
+
+    global_metrics.reset()
+    muxed, _ = _herd(_cfg(mux=True, prefix_cache=True), prompts)
+    mux_tokens = global_metrics.counter("engine_prefill_tokens_total")
+    dedup = global_metrics.counter("engine_prefix_dedup_hits_total")
+    assert muxed == plain
+    assert dedup == n - 1
+    # One full prompt (the owner) + N-1 tails of (99 % 16) + 1 = 4 tokens.
+    tail = len(shared) % 16 + 1
+    assert mux_tokens == len(prompts[0]) + (n - 1) * tail
+    # The herd's pooled fan-out is visible too.
+    assert global_metrics.counter("engine_prefix_hit_tokens_total") == (
+        (n - 1) * (len(shared) // 16) * 16
+    )
+
+
+@pytest.mark.slow
+def test_mux_budget_gauge_published():
+    """The budget gauge must actually be SET to a nonzero value while the
+    backlog drains — sampled concurrently, since it legitimately reads 0
+    again once the backlog empties."""
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    prompts = [list(range(1, 120)) + [i] for i in range(4)]
+    global_metrics.reset()
+
+    async def main():
+        eng = InferenceEngine(engine_cfg=_cfg(mux=True, prefix_cache=False))
+        await eng.start()
+        seen = [0.0]
+
+        async def sample():
+            while True:
+                seen[0] = max(
+                    seen[0], global_metrics.gauge("engine_mux_budget_tokens")
+                )
+                await asyncio.sleep(0.005)
+
+        sampler = asyncio.create_task(sample())
+        try:
+            await asyncio.gather(*(_gen(eng, p) for p in prompts))
+        finally:
+            sampler.cancel()
+            await eng.stop()
+        return seen[0]
+
+    peak = asyncio.run(main())
+    assert peak > 0, "engine_mux_budget_tokens was never set nonzero"
+    snap = global_metrics.snapshot()
+    assert "engine_queue_wait_ms_p50" in snap
+    assert "engine_prefill_exec_ms_p50" in snap

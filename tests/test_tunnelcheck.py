@@ -1102,3 +1102,154 @@ def test_tc07_waiver_records_granularity_contract(tmp_path):
     )
     assert active == []
     assert rules_of(waived) == ["TC07"]
+
+
+# ---------------------------------------------------------------------------
+# TC08 — EngineConfig fields must be wired to cli.py flags (config rot)
+# ---------------------------------------------------------------------------
+
+
+def _tc08_tree(tmp_path, engine_src, cli_src):
+    (tmp_path / "pkg").mkdir(exist_ok=True)
+    eng = tmp_path / "pkg" / "engine.py"
+    eng.write_text(textwrap.dedent(engine_src))
+    cli = tmp_path / "pkg" / "cli.py"
+    cli.write_text(textwrap.dedent(cli_src))
+    return run_paths([eng, cli], rules=["TC08"])
+
+
+def test_tc08_unwired_field_is_flagged(tmp_path):
+    active, _ = _tc08_tree(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class EngineConfig:
+            model: str = "tiny"
+            zz_orphan_knob: int = 0
+        """,
+        """
+        from pkg.engine import EngineConfig
+
+        def make(args):
+            return EngineConfig(model=args.model)
+        """,
+    )
+    assert rules_of(active) == ["TC08"]
+    assert "zz_orphan_knob" in active[0].message
+
+
+def test_tc08_regression_env_only_serving_levers(tmp_path):
+    """The incident class this rule exists for: decode_steps_eager and
+    prefill_rows were REAL serving levers (benched via BENCH_* env knobs,
+    documented in README) that no serve flag could reach for four PRs —
+    operators of the deployed binary simply could not turn the TTFT lever.
+    The fixture mirrors that exact shape."""
+    active, _ = _tc08_tree(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class EngineConfig:
+            model: str = "tiny"
+            decode_steps: int = 8
+            decode_steps_eager: int = 4
+            prefill_rows: int = 8
+        """,
+        """
+        from pkg.engine import EngineConfig
+
+        def make(args):
+            return EngineConfig(
+                model=args.model, decode_steps=args.decode_steps,
+            )
+        """,
+    )
+    assert sorted(v.message.split()[0] for v in active) == [
+        "EngineConfig.decode_steps_eager",
+        "EngineConfig.prefill_rows",
+    ]
+
+
+def test_tc08_wired_fields_are_clean(tmp_path):
+    active, _ = _tc08_tree(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class EngineConfig:
+            model: str = "tiny"
+            slots: int = 8
+        """,
+        """
+        from pkg.engine import EngineConfig
+
+        def make(args):
+            return EngineConfig(model=args.model, slots=args.slots)
+        """,
+    )
+    assert active == []
+
+
+def test_tc08_waiver_names_the_reason(tmp_path):
+    active, waived = _tc08_tree(
+        tmp_path,
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class EngineConfig:
+            model: str = "tiny"
+            bucket: int = 16  # tunnelcheck: disable=TC08  geometry pin, programmatic only
+        """,
+        """
+        from pkg.engine import EngineConfig
+
+        def make(args):
+            return EngineConfig(model=args.model)
+        """,
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC08"]
+
+
+def test_tc08_fixture_without_cli_checks_against_repo_cli(tmp_path):
+    """Scanning an EngineConfig definition WITHOUT a cli.py in the scan
+    set falls back to the repo's real CLI — so `tunnelcheck engine.py`
+    alone still catches rot, and a bogus field is flagged against it."""
+    f = tmp_path / "engine.py"
+    f.write_text(textwrap.dedent(
+        """
+        from dataclasses import dataclass
+
+        @dataclass
+        class EngineConfig:
+            model: str = "tiny"
+            zz_never_a_real_flag: int = 0
+        """
+    ))
+    active, _ = run_paths([f], rules=["TC08"])
+    assert rules_of(active) == ["TC08"]
+    assert "zz_never_a_real_flag" in active[0].message
+
+
+def test_tc08_self_run_every_field_wired_or_waived():
+    """The shipped EngineConfig stays rot-free: every field has a serve
+    flag or carries a reasoned waiver (the self-run gate for TC08,
+    narrower and faster than the full-tree self-run above)."""
+    active, waived = run_paths(
+        [
+            REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "engine.py",
+            REPO_ROOT / "p2p_llm_tunnel_tpu" / "cli.py",
+        ],
+        rules=["TC08"],
+    )
+    assert active == [], [v.render(REPO_ROOT) for v in active]
+    # The deliberate env/programmatic-only fields stay visible as waivers,
+    # not silently absent.
+    waived_fields = {v.message.split()[0] for v in waived}
+    assert "EngineConfig.min_prefill_bucket" in waived_fields
+    assert "EngineConfig.prefix_tail_buckets" in waived_fields

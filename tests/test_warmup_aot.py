@@ -179,3 +179,43 @@ def test_fused_decode_variants_covered_by_warmup(persistent_cache,
     assert not live_new, (
         f"live dispatch compiled {len(live_new)} fused programs warmup missed"
     )
+
+
+def test_mux_herd_hits_zero_cold_compiles(persistent_cache, monkeypatch):
+    """ISSUE 5 warmup coverage: under the MULTIPLEXED serving loop, every
+    program the scheduler can reach — both burst sizes x every view
+    bucket, the chunk program at the (defaulted) segment width x every
+    view a padded tail can bucket to (the cap + prefill_chunk term of
+    _warmup_views), the prefix copy ops, and the single batched-segment
+    row shape (rows always pad to prefill_rows, so the budget controller
+    cannot mint new shapes) — is compiled by warmup(); a multiplexed
+    shared-prefix herd with multi-segment, short-tail, and mid-decode
+    admissions then adds ZERO fresh compiles."""
+    monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "100")
+    monkeypatch.setenv("TUNNEL_WARMUP_PAR", "2")
+    tok = ByteTokenizer()
+
+    async def run():
+        eng = InferenceEngine(
+            engine_cfg=EngineConfig(**{**ECFG, "mux": True}),
+            tokenizer=tok,
+        )
+        await eng.start()
+        await eng.warmup()
+        warmed = _cache_files(persistent_cache)
+        shared = list(range(1, 81))  # 5 pooled blocks of 16
+        herd = [shared + [100 + i] for i in range(3)]  # short tails
+        herd.append(list(range(1, 91)))  # multi-segment (90 > chunk 64)
+        outs = await asyncio.gather(*(_collect(eng, p) for p in herd))
+        # Mid-decode admission: the budget controller's interleave path.
+        outs.append(await _collect(eng, shared + [200]))
+        await eng.stop()
+        return outs, warmed
+
+    outs, warmed = asyncio.run(run())
+    assert warmed, "warmup wrote nothing to the persistent cache"
+    assert all(len(o) == 8 for o in outs)
+    live_new = _cache_files(persistent_cache) - warmed
+    assert not live_new, (
+        f"multiplexed herd compiled {len(live_new)} programs warmup missed"
+    )

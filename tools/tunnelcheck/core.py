@@ -408,6 +408,7 @@ def all_rules() -> Dict[str, "object"]:
     """rule id -> check function ``(SourceFile, ProjectContext) -> Iterator``."""
     from tools.tunnelcheck import (
         rules_async,
+        rules_config,
         rules_deps,
         rules_dispatch,
         rules_jax,
@@ -423,6 +424,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC05": rules_protocol.check_tc05,
         "TC06": rules_metrics.check_tc06,
         "TC07": rules_dispatch.check_tc07,
+        "TC08": rules_config.check_tc08,
     }
 
 
@@ -435,6 +437,7 @@ RULE_SUMMARIES = {
     "TC05": "non-exhaustive MessageType dispatch / typed_error code not in ERROR_CODES",
     "TC06": "metric name not declared in utils.metrics.METRICS_CATALOG",
     "TC07": "device dispatch inside a per-request/slot loop on the serving path",
+    "TC08": "EngineConfig field not wired to a cli.py flag (config rot)",
 }
 
 
