@@ -59,6 +59,10 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# MULTIPLEXED serving loop — drain/deadline/429 semantics must not
 	@# depend on the engine's prefill/decode rhythm.
 	CHAOS_TEST_SEED=5 CHAOS_MUX=1 python -m pytest tests/test_chaos.py tests/test_deadlines.py -q
+	@# ISSUE 6 matrix row: request tracing under the same seeded faults —
+	@# two runs must yield the SAME span topology per trace (tracing is
+	@# part of the determinism contract, not an exception to it).
+	CHAOS_TEST_SEED=5 python -m pytest tests/test_tracing.py -k chaos_span_topology -q
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

@@ -389,6 +389,19 @@ async def _run_attempt(model: str) -> dict:
     ttfts = sorted(r["ttft_s"] for r in results if r["ttft_s"] is not None)
     tok_s = visible_tokens / wall if wall > 0 else 0.0
     ttft_p50_ms = statistics.median(ttfts) * 1000.0 if ttfts else None
+
+    def _pct_ms(xs, p):
+        """Client-side percentile in ms via the registry's shared
+        nearest-rank estimator (ISSUE 6: herd rows carry the p99/p999
+        tails next to p50 — goodput per DistServe is defined against
+        per-request SLOs, which live in the tail, not the median).  With
+        a herd smaller than 1/(1-p) this reports the max — honest, and
+        the row's `clients` field says so."""
+        from p2p_llm_tunnel_tpu.utils.metrics import nearest_rank
+
+        if not xs:
+            return None
+        return round(nearest_rank(xs, p) * 1000.0, 1)
     n_params, peak_flops = _model_flops_params(model)
     import jax
 
@@ -402,10 +415,23 @@ async def _run_attempt(model: str) -> dict:
         "unit": "tok/s",
         "vs_baseline": round(tok_s / TARGET_TOK_S, 4),
         "ttft_p50_ms": round(ttft_p50_ms, 1) if ttft_p50_ms is not None else None,
+        # Tail percentiles next to p50 (ISSUE 6, first slice of the
+        # 1k-client ingress item): client-side TTFT tails plus the proxy's
+        # first-byte tails from the upgraded registry reservoirs.
+        "ttft_p99_ms": _pct_ms(ttfts, 99),
+        "ttft_p999_ms": _pct_ms(ttfts, 99.9),
+        "ttfb_p50_ms": round(global_metrics.percentile("proxy_ttfb_ms", 50), 1),
+        "ttfb_p99_ms": round(global_metrics.percentile("proxy_ttfb_ms", 99), 1),
+        "ttfb_p999_ms": round(
+            global_metrics.percentile("proxy_ttfb_ms", 99.9), 1
+        ),
         # Client TTFT waits for the first VISIBLE SSE delta; with random
         # weights the byte decoder buffers invisible UTF-8 fragments, so the
         # engine's submit→first-token histogram is the accurate lower bound.
         "engine_ttft_p50_ms": round(global_metrics.percentile("engine_ttft_ms", 50), 1),
+        "engine_ttft_p99_ms": round(
+            global_metrics.percentile("engine_ttft_ms", 99), 1
+        ),
         # TTFT decomposition (ISSUE 5): queue wait (submit -> slot) +
         # prefill execution (slot -> first token, incl. dedup park time).
         "queue_wait_p50_ms": round(

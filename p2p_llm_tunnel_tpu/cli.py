@@ -66,6 +66,29 @@ def build_parser() -> argparse.ArgumentParser:
                        help="shared credential for an authenticated relay "
                             "(env TUNNEL_RELAY_SECRET) — the --turn-user/"
                             "--turn-pass surface of the reference")
+        # Observability (ISSUE 6): request-scope span recording — both
+        # peers emit spans (proxy ingress, serve dispatch, engine
+        # lifecycle), so the knobs live on the shared surface.
+        p.add_argument("--trace",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_TRACE", "") == "1",
+                       help="record request-scope spans (utils/tracing "
+                            "SPAN_CATALOG) into a bounded ring buffer; "
+                            "export as Chrome trace-event JSON via GET "
+                            "/healthz?trace=1, summarize with "
+                            "scripts/traceview.py (env TUNNEL_TRACE=1; "
+                            "off by default — pure host bookkeeping, but "
+                            "zero is zero)")
+        p.add_argument("--trace-sample", type=float,
+                       default=float(_env("TUNNEL_TRACE_SAMPLE", "1.0")),
+                       help="fraction of traces to record under --trace, "
+                            "decided deterministically per trace id so "
+                            "every layer of one request agrees (env "
+                            "TUNNEL_TRACE_SAMPLE; 1.0 = all)")
+        p.add_argument("--trace-buffer", type=int,
+                       default=int(_env("TUNNEL_TRACE_BUFFER", "4096")),
+                       help="span ring-buffer capacity under --trace "
+                            "(env TUNNEL_TRACE_BUFFER)")
 
     serve = sub.add_parser("serve", help="provider peer: expose an LLM")
     common(serve)
@@ -542,6 +565,17 @@ async def _amain(args) -> None:
 
     if not args.room:
         raise SystemExit("--room (or TUNNEL_ROOM) is required")
+    if getattr(args, "trace", False):
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        global_tracer.configure(
+            enabled=True, capacity=args.trace_buffer,
+            sample=args.trace_sample,
+        )
+        log.info(
+            "request tracing on: buffer=%d sample=%.3f (export: GET "
+            "/healthz?trace=1)", args.trace_buffer, args.trace_sample,
+        )
     if args.command == "serve":
         # Graceful drain: the FIRST SIGTERM stops admission and lets
         # in-flight streams finish (run_serve returns cleanly, the retry

@@ -52,6 +52,15 @@ class HttpResponse:
 Handler = Callable[[HttpRequest], Awaitable[HttpResponse]]
 
 
+def query_flags(path: str) -> "set[str]":
+    """The raw ``k=v`` tokens of a request path's query string, order- and
+    duplicate-insensitive (``/healthz?trace=1&local=1`` → {"trace=1",
+    "local=1"}).  The ONE parser behind the serve/proxy loop-served route
+    flags — per-site hand-rolled variants can silently diverge on
+    reordered or repeated parameters."""
+    return {tok for tok in path.partition("?")[2].split("&") if tok}
+
+
 # ---------------------------------------------------------------------------
 # shared parsing helpers
 # ---------------------------------------------------------------------------

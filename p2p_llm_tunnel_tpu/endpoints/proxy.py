@@ -26,6 +26,7 @@ from typing import AsyncIterator, Dict, Optional, Union
 from p2p_llm_tunnel_tpu.endpoints.http11 import (
     HttpRequest,
     HttpResponse,
+    query_flags,
     start_http_server,
 )
 from p2p_llm_tunnel_tpu.protocol.frames import (
@@ -41,7 +42,14 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
-from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.metrics import Metrics, global_metrics
+from p2p_llm_tunnel_tpu.utils.tracing import (
+    TRACE_HEADER,
+    global_tracer,
+    mint_trace_id,
+    new_span_id,
+    parse_trace_context,
+)
 
 log = get_logger(__name__)
 
@@ -154,6 +162,35 @@ def _plain(status: int, text: str) -> HttpResponse:
 
 async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
     """One HTTP request through the tunnel (proxy.rs:249-426)."""
+    if (req.method.upper() == "GET"
+            and req.path.split("?")[0] == "/metrics"
+            and "local=1" in query_flags(req.path)):
+        # GET /metrics?local=1: THIS process's registry (the proxy-side
+        # proxy_*/transport_* series live here, not behind the tunnel),
+        # answered locally so it works even while the tunnel is down.
+        # Bare /metrics tunnels through to the serve peer like /healthz —
+        # in the deployed two-process topology the proxy listener is the
+        # only HTTP surface, and a local answer there would render the
+        # engine_*/serve_* series as silent zeros (the TC06 bug class).
+        return HttpResponse(
+            200, {"content-type": Metrics.PROM_CONTENT_TYPE},
+            global_metrics.prometheus_text().encode(),
+        )
+    if req.method.upper() == "GET" and req.path.split("?")[0] == "/healthz":
+        if {"trace=1", "local=1"} <= query_flags(req.path):
+            # GET /healthz?trace=1&local=1: THIS process's span journal —
+            # in the two-process topology the proxy's ingress spans
+            # (proxy.request/frame_send/first_byte) live in this ring
+            # buffer, not the serve peer's; without this escape the
+            # documented capture flow would silently lose the proxy layer.
+            # Bare ?trace=1 tunnels through to the serve+engine journal.
+            import json as _json
+
+            return HttpResponse(
+                200, {"content-type": "application/json"},
+                _json.dumps(global_tracer.chrome_trace()).encode(),
+            )
+
     if not state.tunnel_ready:
         return _plain(503, "Tunnel not ready")
 
@@ -163,14 +200,50 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
     global_metrics.inc("proxy_requests_total")
     log.debug("proxying %s %s (stream %d)", req.method, req.path, stream_id)
 
+    # Trace context (ISSUE 6): accept the client's x-tunnel-trace or mint a
+    # fresh trace id here — the proxy is the tunnel's ingress, so this is
+    # where a request's one trace id is decided.  When the trace records
+    # (enabled + sampled, decided once by hashing the id), the outgoing
+    # header re-parents downstream spans under this proxy.request span.
+    # Everything is gated on `enabled` so the disabled default costs zero
+    # per-request work on the ingress hot path (a client-sent header still
+    # forwards untouched via the plain header copy below).
+    inbound = root_span = None
+    trace_id = ""
+    if global_tracer.enabled:
+        inbound = parse_trace_context(req.headers)
+        trace_id = (inbound.trace_id if inbound is not None
+                    else mint_trace_id())
+        root_span = new_span_id() if global_tracer.on(trace_id) else None
+    span_done = False
+
+    def finish_span(status: int) -> None:
+        nonlocal span_done
+        if root_span is None or span_done:
+            return
+        span_done = True
+        global_tracer.add_span(
+            "proxy.request", trace_id=trace_id, span_id=root_span,
+            parent_id=(inbound.span_id or None) if inbound else None,
+            track="proxy", t0=t_start,
+            attrs={"method": req.method, "path": req.path,
+                   "stream_id": stream_id, "status": status},
+        )
+
+    headers_out_tunnel = dict(req.headers)
+    if root_span is not None:
+        headers_out_tunnel[TRACE_HEADER] = f"{trace_id}/{root_span}"
+
     events: asyncio.Queue[_StreamEvent] = asyncio.Queue()
     state.pending[stream_id] = events
     global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
 
+    t_send = time.monotonic()
     try:
         await channel.send(
             TunnelMessage.req_headers(
-                RequestHeaders(stream_id, req.method, req.path, dict(req.headers))
+                RequestHeaders(stream_id, req.method, req.path,
+                               headers_out_tunnel)
             ).encode()
         )
         for frame in encode_body_frames(MessageType.REQ_BODY, stream_id, req.body):
@@ -178,7 +251,14 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         await channel.send(TunnelMessage.req_end(stream_id).encode())
     except ChannelClosed:
         state.pending.pop(stream_id, None)
+        finish_span(502)
         return _plain(502, "Tunnel send failed")
+    if root_span is not None:
+        global_tracer.add_span(
+            "proxy.frame_send", trace_id=trace_id, parent_id=root_span,
+            track="proxy", t0=t_send,
+            attrs={"body_bytes": len(req.body)},
+        )
 
     # Wait for response headers (proxy.rs:338-376).
     res_headers: Optional[ResponseHeaders] = None
@@ -187,19 +267,23 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             state.pending.pop(stream_id, None)
+            finish_span(504)
             return _plain(504, "Tunnel response timeout")
         try:
             event = await asyncio.wait_for(events.get(), remaining)
         except asyncio.TimeoutError:
             state.pending.pop(stream_id, None)
+            finish_span(504)
             return _plain(504, "Tunnel response timeout")
         if isinstance(event, _Headers):
             res_headers = event.headers
         elif isinstance(event, _Error):
             state.pending.pop(stream_id, None)
+            finish_span(502)
             return _plain(502, f"Tunnel error: {event.message}")
         elif isinstance(event, _End):
             state.pending.pop(stream_id, None)
+            finish_span(502)
             return _plain(502, "Tunnel error: response ended before headers")
         else:
             log.warning("received body chunk before headers for stream %d", stream_id)
@@ -221,6 +305,11 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                         global_metrics.observe(
                             "proxy_ttfb_ms", (time.monotonic() - t_start) * 1000.0
                         )
+                        if root_span is not None:
+                            global_tracer.add_event(
+                                "proxy.first_byte", trace_id=trace_id,
+                                parent_id=root_span, track="proxy",
+                            )
                         first = False
                     global_metrics.inc("proxy_body_bytes_total", len(event.data))
                     yield event.data
@@ -250,6 +339,7 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         finally:
             state.pending.pop(stream_id, None)
             global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
+            finish_span(res_headers.status)
 
     return HttpResponse(res_headers.status, headers_out, body_stream())
 

@@ -39,7 +39,13 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
-from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.metrics import Metrics, global_metrics
+from p2p_llm_tunnel_tpu.utils.tracing import (
+    TRACE_HEADER,
+    global_tracer,
+    new_span_id,
+    parse_trace_context,
+)
 
 log = get_logger(__name__)
 
@@ -220,6 +226,17 @@ async def _handle_request(
     channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
     flow: FlowControl,
 ) -> None:
+    t0 = time.monotonic()
+    ctx = parse_trace_context(req.headers)
+    span = None
+    if ctx is not None and global_tracer.on(ctx.trace_id):
+        # This dispatch gets its own span, and the header the BACKEND sees
+        # is rewritten to parent under it — so the engine's spans chain
+        # proxy.request -> serve.dispatch -> engine.request under one
+        # propagated trace id.
+        span = new_span_id()
+        req.headers = dict(req.headers)
+        req.headers[TRACE_HEADER] = f"{ctx.trace_id}/{span}"
     try:
         flow.open(req.stream_id)
         await _handle_request_inner(channel, backend, req, body, flow)
@@ -228,6 +245,12 @@ async def _handle_request(
         log.debug("channel closed while responding to stream %d", req.stream_id)
     finally:
         flow.close(req.stream_id)
+        if span is not None:
+            global_tracer.add_span(
+                "serve.dispatch", trace_id=ctx.trace_id, span_id=span,
+                parent_id=ctx.span_id or None, track="serve", t0=t0,
+                attrs={"stream_id": req.stream_id, "path": req.path},
+            )
 
 
 async def _handle_request_inner(
@@ -236,6 +259,16 @@ async def _handle_request_inner(
 ) -> None:
     stream_id = req.stream_id
     global_metrics.inc("serve_requests_total")
+    tctx = parse_trace_context(req.headers)  # parent: this dispatch's span
+
+    def trace_timeout(where: str) -> None:
+        if tctx is not None:
+            global_tracer.add_event(
+                "serve.timeout", trace_id=tctx.trace_id,
+                parent_id=tctx.span_id or None, track="serve",
+                attrs={"stream_id": stream_id, "where": where},
+            )
+
     # Per-request deadline (x-tunnel-deadline-ms): enforced here over the
     # whole backend call + body relay, independently of the engine's own
     # scheduler-side eviction — this layer also covers the HTTP backend
@@ -266,6 +299,7 @@ async def _handle_request_inner(
         log.warning("stream %d hit its %.0fms deadline before headers",
                     stream_id, dl_ms)
         global_metrics.inc("serve_timeouts_total")
+        trace_timeout("before-headers")
         await _send_simple(
             channel, stream_id, 504, b"Gateway Timeout: deadline exceeded"
         )
@@ -321,6 +355,7 @@ async def _handle_request_inner(
             log.warning("stream %d hit its %.0fms deadline mid-stream",
                         stream_id, dl_ms)
             global_metrics.inc("serve_timeouts_total")
+            trace_timeout("mid-stream")
             await channel.send(
                 TunnelMessage.typed_error(
                     stream_id, "timeout", "deadline exceeded"
@@ -335,6 +370,7 @@ async def _handle_request_inner(
         code = getattr(e, "tunnel_code", None)
         if code == "timeout":
             global_metrics.inc("serve_timeouts_total")
+            trace_timeout("backend")
         if code is not None:
             frame = TunnelMessage.typed_error(stream_id, code, str(e))
         else:
@@ -350,15 +386,17 @@ async def _send_simple(
     channel: Channel, stream_id: int, status: int, body: bytes,
     headers: Optional[Dict[str, str]] = None,
 ) -> None:
-    """One complete small response: headers + body + end."""
+    """One complete small response: headers + body + end.  The body is
+    frame-chunked, so loop-served payloads (a /healthz?trace=1 journal can
+    exceed one frame) never trip the MAX_FRAME_SIZE cap."""
     h = {"content-type": "text/plain"}
     if headers:
         h.update(headers)
     await channel.send(
         TunnelMessage.res_headers(ResponseHeaders(stream_id, status, h)).encode()
     )
-    if body:
-        await channel.send(TunnelMessage.res_body(stream_id, body).encode())
+    for frame in encode_body_frames(MessageType.RES_BODY, stream_id, body):
+        await channel.send(frame)
     await channel.send(TunnelMessage.res_end(stream_id).encode())
 
 
@@ -402,6 +440,35 @@ async def _send_healthz(
         "prefix_dedup_hits": int(
             global_metrics.counter("engine_prefix_dedup_hits_total")
         ),
+        # ISSUE 6 observability: tail percentiles the 1k-client ingress
+        # item's SLO reporting needs (p99/p999 next to the p50 split),
+        # and prefix-pool memory accounting (first slice of the
+        # unified-paged-KV item; kv_bytes reflects the kv_quant mode).
+        "tails": {
+            "ttft_p99_ms": round(
+                global_metrics.percentile("engine_ttft_ms", 99), 1
+            ),
+            "ttft_p999_ms": round(
+                global_metrics.percentile("engine_ttft_ms", 99.9), 1
+            ),
+            "ttfb_p99_ms": round(
+                global_metrics.percentile("proxy_ttfb_ms", 99), 1
+            ),
+            "ttfb_p999_ms": round(
+                global_metrics.percentile("proxy_ttfb_ms", 99.9), 1
+            ),
+        },
+        "prefix_pool": {
+            "blocks_used": int(
+                global_metrics.gauge("engine_prefix_pool_blocks_used")
+            ),
+            "blocks_free": int(
+                global_metrics.gauge("engine_prefix_pool_blocks_free")
+            ),
+            "kv_bytes": int(
+                global_metrics.gauge("engine_prefix_pool_kv_bytes")
+            ),
+        },
     }
     await _send_simple(
         channel, stream_id, 200 if state == "ok" else 503,
@@ -551,17 +618,51 @@ async def _serve_dispatch(
         if entry is not None:
             req, body = entry
             path = req.path.split("?")[0]
+            tctx = (parse_trace_context(req.headers)
+                    if global_tracer.enabled else None)
+            if tctx is not None and global_tracer.on(tctx.trace_id):
+                global_tracer.add_event(
+                    "serve.frame_recv", trace_id=tctx.trace_id,
+                    parent_id=tctx.span_id or None, track="serve",
+                    attrs={"stream_id": req.stream_id, "path": path},
+                )
             if req.method.upper() == "GET" and path == "/healthz":
                 # Answered by the serve loop itself (not the backend) so
                 # health works identically for the HTTP and TPU backends.
+                if "trace=1" in http11.query_flags(req.path):
+                    # The span journal as Chrome trace-event JSON — load
+                    # in chrome://tracing / Perfetto, or summarize with
+                    # scripts/traceview.py.
+                    await _send_simple(
+                        channel, req.stream_id, 200,
+                        json.dumps(global_tracer.chrome_trace()).encode(),
+                        {"content-type": "application/json"},
+                    )
+                    return
                 await _send_healthz(
                     channel, req.stream_id,
                     draining=drain is not None and drain.is_set(),
                     inflight=len(request_tasks),
                 )
                 return
+            if req.method.upper() == "GET" and path == "/metrics":
+                # Prometheus text exposition for the full catalog — also
+                # answered by the serve loop itself, so the HTTP and TPU
+                # backends expose identical scrape surfaces.
+                await _send_simple(
+                    channel, req.stream_id, 200,
+                    global_metrics.prometheus_text().encode(),
+                    {"content-type": Metrics.PROM_CONTENT_TYPE},
+                )
+                return
             if drain is not None and drain.is_set():
                 global_metrics.inc("serve_shed_total")
+                if tctx is not None:
+                    global_tracer.add_event(
+                        "serve.drain_reject", trace_id=tctx.trace_id,
+                        parent_id=tctx.span_id or None, track="serve",
+                        attrs={"stream_id": req.stream_id},
+                    )
                 await _send_simple(
                     channel, req.stream_id, 503,
                     b"Service Unavailable: draining",
@@ -578,6 +679,13 @@ async def _serve_dispatch(
                 # RES_END, so the proxy — which forgets the stream at
                 # RES_END — is unaffected.
                 global_metrics.inc("serve_shed_total")
+                if tctx is not None:
+                    global_tracer.add_event(
+                        "serve.shed", trace_id=tctx.trace_id,
+                        parent_id=tctx.span_id or None, track="serve",
+                        attrs={"stream_id": req.stream_id,
+                               "max_inflight": max_inflight},
+                    )
                 await _send_simple(
                     channel, req.stream_id, 429,
                     b"Too Many Requests: in-flight limit reached",

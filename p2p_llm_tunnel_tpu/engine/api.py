@@ -37,6 +37,7 @@ from p2p_llm_tunnel_tpu.engine.engine import DeadlineExceeded, InferenceEngine
 from p2p_llm_tunnel_tpu.engine.scheduler import QueueFull
 from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders, parse_deadline_ms
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
+from p2p_llm_tunnel_tpu.utils.tracing import parse_trace_context
 
 log = get_logger(__name__)
 
@@ -802,11 +803,21 @@ class EngineAPI:
         if method == "GET" and path == "/health":
             return 200, {"content-type": "text/plain"}, _once(b"ok")
         if method == "GET" and path == "/metrics":
-            # First-class counters (SURVEY.md §5: the reference greps logs;
-            # we expose tok/s, TTFT, queue depth, occupancy directly).
-            from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+            # Prometheus text exposition for the full catalog (SURVEY.md
+            # §5: the reference greps logs; we expose tok/s, TTFT, queue
+            # depth, occupancy as a first-class scrape surface).  The
+            # serve loop intercepts /metrics identically for tunneled
+            # requests; this route covers direct EngineAPI embedding.
+            from p2p_llm_tunnel_tpu.utils.metrics import (
+                Metrics,
+                global_metrics,
+            )
 
-            return _json_response(200, global_metrics.snapshot())
+            return (
+                200,
+                {"content-type": Metrics.PROM_CONTENT_TYPE},
+                _once(global_metrics.prometheus_text().encode()),
+            )
         if method == "GET" and path == "/v1/models":
             return _json_response(200, self._models_payload())
         if method == "GET" and path == "/api/tags":
@@ -928,6 +939,13 @@ class EngineAPI:
                 # so neither a stuck engine nor a stalled tunnel can pin
                 # the request past its budget.
                 kwargs["deadline"] = time.monotonic() + deadline_ms / 1000.0
+            tctx = parse_trace_context(req.headers)
+            if tctx is not None:
+                # Propagated trace context (ISSUE 6): the engine parents
+                # its request spans under the serve-side dispatch span.
+                # The recorder decides sampling; passing the context is
+                # free when tracing is off.
+                kwargs["trace"] = tctx
             stops = self._stop_strings(payload)
             stream = bool(
                 payload.get("stream", path == "/api/generate" or path == "/api/chat")

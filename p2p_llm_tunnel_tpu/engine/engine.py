@@ -41,6 +41,11 @@ from p2p_llm_tunnel_tpu.models.transformer import (
 )
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.tracing import (
+    TraceContext,
+    global_tracer,
+    new_span_id,
+)
 
 log = get_logger(__name__)
 
@@ -240,6 +245,14 @@ class _ActiveRequest:
     # queue_wait = t_admitted - t_submit, prefill_exec = first_token_at -
     # t_admitted (the latter includes any prefix-dedup park time).
     t_admitted: Optional[float] = None
+    # Tracing (ISSUE 6): the propagated context (parent = the serve-side
+    # dispatch span), this request's own engine.request span id, the
+    # prefix-group park start (waiter side), and the recorded outcome.
+    # All None/unused when tracing is off or the trace is sampled out.
+    trace: Optional[TraceContext] = None
+    trace_span: Optional[str] = None
+    t_parked: Optional[float] = None
+    finish: Optional[str] = None
 
 
 class InferenceEngine:
@@ -479,6 +492,15 @@ class InferenceEngine:
                 # Pool leaves are rank-congruent with cache leaves (K axis
                 # in the same place), so the cache specs apply verbatim.
                 self._pool = shard_kv_cache(self._pool, self.mesh)
+            # Per-block resident KV bytes: the pool leaves' total size over
+            # capacity — computed from shapes/dtypes once (no device read),
+            # and already reflecting the kv_quant mode (quantized pools
+            # store packed values + scales, so their leaves are smaller).
+            self._prefix_block_bytes = sum(
+                int(arr.size) * arr.dtype.itemsize
+                for arr in self._pool.values()
+            ) // max(1, self.ecfg.prefix_pool_blocks)
+            self._publish_prefix_gauges()
             # Row-batched (prefill_rows-wide) copy programs: one dispatch
             # per admission-wave sub-batch, not per request — per-request
             # dispatches through the device tunnel tripled prefill p50 in
@@ -1371,12 +1393,19 @@ class InferenceEngine:
         seed: Optional[int] = None,
         logit_bias: Tuple[Tuple[int, float], ...] = (),
         deadline: Optional[float] = None,
+        trace: Optional[TraceContext] = None,
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes.
 
         ``deadline`` is an absolute ``time.monotonic()`` instant: once
         passed, the scheduler evicts the request wherever it is (waiting
         queue or decode slot) and this generator raises DeadlineExceeded.
+
+        ``trace`` is the propagated trace context (utils/tracing): when
+        recording is on and the trace is sampled, the request's lifecycle
+        lands in the span journal as an ``engine.request`` span (parent:
+        the serve-side dispatch span) with queue-wait / prefill-exec /
+        park child spans and first-token / stream-end events.
         """
         if self._crashed:
             raise RuntimeError(
@@ -1417,6 +1446,9 @@ class InferenceEngine:
             queue=asyncio.Queue(), decoder=StreamDecoder(self.tokenizer),
             t_submit=time.monotonic(),
         )
+        if trace is not None and global_tracer.on(trace.trace_id):
+            state.trace = trace
+            state.trace_span = new_span_id()
         self._requests[rid] = state
         self.scheduler.submit(req)
         global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
@@ -1426,19 +1458,58 @@ class InferenceEngine:
             while True:
                 event = await state.queue.get()
                 if event is _CRASHED:
+                    state.finish = "crashed"
                     raise RuntimeError("engine crashed mid-generation")
                 if event is _TIMED_OUT:
+                    state.finish = "timeout"
                     raise DeadlineExceeded(
                         "deadline exceeded; request evicted"
                     )
                 if event is None:
                     return
+                if event.finish_reason is not None:
+                    # Recorded BEFORE the yield: a consumer that stops
+                    # iterating after the final event closes this generator
+                    # at the yield point (GeneratorExit), so a post-yield
+                    # assignment would never run and the trace would log a
+                    # normal finish as "cancelled".
+                    state.finish = event.finish_reason
                 yield event
                 if event.finish_reason is not None:
                     return
         finally:
             self._requests.pop(rid, None)
             self.scheduler.cancel(rid)
+            if state.trace is not None:
+                # Exactly one engine.request span per generation — this
+                # finally runs once on every exit path (finish, deadline,
+                # consumer cancel, crash).  Pure host bookkeeping.
+                t_end = time.monotonic()
+                if state.t_parked is not None:
+                    # Still parked behind a prefix owner at exit (deadline
+                    # eviction or consumer cancel): close the park span
+                    # here, or exactly the slowest traces — the ones whose
+                    # wait WAS the park — would lose their dominant sink.
+                    global_tracer.add_span(
+                        "engine.prefix_park",
+                        trace_id=state.trace.trace_id,
+                        parent_id=state.trace_span, track="engine",
+                        t0=state.t_parked, t1=t_end,
+                        attrs={"terminated": state.finish or "cancelled"},
+                    )
+                    state.t_parked = None
+                global_tracer.add_event(
+                    "engine.stream_end", trace_id=state.trace.trace_id,
+                    parent_id=state.trace_span, track="engine", t=t_end,
+                )
+                global_tracer.add_span(
+                    "engine.request", trace_id=state.trace.trace_id,
+                    span_id=state.trace_span,
+                    parent_id=state.trace.span_id or None, track="engine",
+                    t0=state.t_submit, t1=t_end,
+                    attrs={"rid": rid,
+                           "finish": state.finish or "cancelled"},
+                )
 
     # -- engine loop ------------------------------------------------------
 
@@ -1459,6 +1530,28 @@ class InferenceEngine:
                 global_metrics.observe(
                     "engine_prefill_exec_ms",
                     (state.first_token_at - state.t_admitted) * 1000.0,
+                )
+            if state.trace is not None:
+                # The per-request twins of the TTFT histogram split: the
+                # two child spans tile [submit, first_token] exactly, so a
+                # trace reconstructs the decomposition the aggregate
+                # histograms can only report in percentile form.
+                tid = state.trace.trace_id
+                if state.t_admitted is not None:
+                    global_tracer.add_span(
+                        "engine.queue_wait", trace_id=tid,
+                        parent_id=state.trace_span, track="engine",
+                        t0=state.t_submit, t1=state.t_admitted,
+                    )
+                    global_tracer.add_span(
+                        "engine.prefill_exec", trace_id=tid,
+                        parent_id=state.trace_span, track="engine",
+                        t0=state.t_admitted, t1=state.first_token_at,
+                    )
+                global_tracer.add_event(
+                    "engine.first_token", trace_id=tid,
+                    parent_id=state.trace_span, track="engine",
+                    t=state.first_token_at,
                 )
         global_metrics.inc("engine_tokens_total")
         is_stop = token_id in run.request.stop_ids
@@ -2104,6 +2197,14 @@ class InferenceEngine:
             )
             state = self._requests.get(req.request_id)
             if state is not None:
+                if state.trace is not None:
+                    global_tracer.add_event(
+                        "engine.deadline_evict",
+                        trace_id=state.trace.trace_id,
+                        parent_id=state.trace_span, track="engine",
+                        attrs={"where": "waiting" if slot is None
+                               else f"slot {slot}"},
+                    )
                 state.queue.put_nowait(_TIMED_OUT)
 
     def _account_token(self, slot: int, tok: int, lp_info=None,
@@ -2372,6 +2473,13 @@ class InferenceEngine:
         )
         for rid, owner_rid in waiters:
             self._prefix_waiters.append((by_rid[rid], owner_rid))
+            state = self._requests.get(rid)
+            if (state is not None and state.trace is not None
+                    and state.t_parked is None):
+                # Park starts now; the span closes when this request next
+                # proceeds through an owners wave (below).  Re-parks behind
+                # a promoted owner extend the SAME park span.
+                state.t_parked = time.monotonic()
             if rid not in self._dedup_counted:
                 self._dedup_counted.add(rid)
                 global_metrics.inc("engine_prefix_dedup_hits_total")
@@ -2379,6 +2487,26 @@ class InferenceEngine:
         for rid, hist, pool_ids, keys in owners:
             run = by_rid[rid]
             self._dedup_counted.discard(rid)
+            state = self._requests.get(rid)
+            if state is not None and state.trace is not None:
+                if state.t_parked is not None:
+                    # Waiter woken: its owner's blocks are pooled (or it
+                    # was promoted to owner) — the park is over.
+                    global_tracer.add_span(
+                        "engine.prefix_park",
+                        trace_id=state.trace.trace_id,
+                        parent_id=state.trace_span, track="engine",
+                        t0=state.t_parked,
+                        attrs={"promoted_owner": bool(keys)},
+                    )
+                    state.t_parked = None
+                if keys:
+                    global_tracer.add_event(
+                        "engine.prefix_own",
+                        trace_id=state.trace.trace_id,
+                        parent_id=state.trace_span, track="engine",
+                        attrs={"keys": len(keys), "hist_tokens": hist},
+                    )
             if keys:
                 self._owner_keys[rid] = (run, keys)
             if hist:
@@ -2488,8 +2616,8 @@ class InferenceEngine:
         iteration's ``max_rows`` budget under mux, whichever is smaller)
         by ONE segment each, as one chunk-prefill call (executor thread).
 
-        Returns (rows, first_dev) where rows is [(run, was_final)] in row
-        order, or None when nothing is pending.  Every segment pads to the
+        Returns (rows, first_dev, t_dispatch) where rows is
+        [(run, was_final)] in row order, or None when nothing is pending.  Every segment pads to the
         same ``prefill_chunk`` bucket — one compiled program; a final
         (short) segment's pad positions write junk KV past the prompt end,
         which decode overwrites before it ever becomes attendable (the
@@ -2524,17 +2652,27 @@ class InferenceEngine:
                 self._segmented[run.slot] = (run, start + len(seg))
             chunk_rows.append((run, start, seg, final))
             rows.append((run, final))
+        t_dispatch = time.monotonic()
         first_lp = self._dispatch_chunk_rows(chunk_rows, chunk)
         global_metrics.inc("engine_prefill_segments_total", len(rows))
-        return rows, first_lp
+        return rows, first_lp, t_dispatch
 
     async def _finish_segments(self, loop, seg) -> None:
         """Fetch a segment dispatch's sampled block; activate final rows."""
-        rows, first_dev = seg
+        rows, first_dev, t_dispatch = seg
         firsts, lp, _plp = await loop.run_in_executor(
             self._executor,
             lambda: jax.tree.map(np.asarray, jax.device_get(first_dev)),
         )
+        if global_tracer.enabled:
+            # Engine-scope timeline row (no trace id): one span per
+            # chunked-prefill sub-batch, dispatch -> sampled block on host.
+            global_tracer.add_span(
+                "engine.prefill_segment", trace_id=None, track="engine-loop",
+                t0=t_dispatch,
+                attrs={"rows": len(rows),
+                       "final": sum(1 for _r, f in rows if f)},
+            )
         inserts: List[RunningSlot] = []
         for i, ((run, final), first) in enumerate(
             zip(rows, firsts[: len(rows)])
@@ -2550,6 +2688,34 @@ class InferenceEngine:
             await loop.run_in_executor(
                 self._executor, self._prefix_insert, inserts
             )
+
+    def _trace_burst(self, t_dispatch: float, assign: List) -> None:
+        """Engine-scope decode-burst span: dispatch -> fetched block
+        processed.  Overlapping by construction (burst n+1 dispatches
+        before burst n is fetched) — the Chrome view shows the pipelining
+        directly.  Pure host bookkeeping, skipped when tracing is off."""
+        if not global_tracer.enabled:
+            return
+        global_tracer.add_span(
+            "engine.decode_burst", trace_id=None, track="engine-loop",
+            t0=t_dispatch,
+            attrs={"rows": sum(1 for a in assign if a is not None)},
+        )
+
+    def _publish_prefix_gauges(self) -> None:
+        """Prefix-pool memory accounting (ISSUE 6): blocks used/free and
+        resident KV bytes, surfaced by /healthz and /metrics.  Host
+        arithmetic over the index only — no device traffic."""
+        if self._prefix is None:
+            return
+        used = self._prefix.used_blocks
+        global_metrics.set_gauge("engine_prefix_pool_blocks_used", used)
+        global_metrics.set_gauge(
+            "engine_prefix_pool_blocks_free", self._prefix.free_blocks
+        )
+        global_metrics.set_gauge(
+            "engine_prefix_pool_kv_bytes", used * self._prefix_block_bytes
+        )
 
     async def _process_burst(self, outs, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.
@@ -2588,7 +2754,8 @@ class InferenceEngine:
         # (found the hard way: a shape bug in a new sampler input)
         # strands all generate() callers on a queue nobody will feed.
         try:
-            in_flight = None  # (sampled device array, request-id snapshot)
+            # (sampled device array, request-id snapshot, dispatch instant)
+            in_flight = None
             while self._running:
                 if self.scheduler.idle and in_flight is None:
                     # Idle time is not a stall: keep the watchdog anchored
@@ -2610,6 +2777,7 @@ class InferenceEngine:
 
                 global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
                 global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
+                self._publish_prefix_gauges()
 
                 # Prefill work for this iteration, dispatched before the
                 # decode burst.  Non-mux: one prefill_rows-wide segment
@@ -2657,13 +2825,14 @@ class InferenceEngine:
                     # there is no carry to pipeline.  Drain the pipelined
                     # plain burst first (mode switch mid-stream).
                     if in_flight is not None:
-                        outs_dev, assign = in_flight
+                        outs_dev, assign, t_disp = in_flight
                         outs = await loop.run_in_executor(
                             self._executor,
                             lambda: jax.tree.map(
                                 np.asarray, jax.device_get(outs_dev)),
                         )
                         await self._process_burst(outs, assign)
+                        self._trace_burst(t_disp, assign)
                         in_flight = None
                     spec_out, spec_assign = await loop.run_in_executor(
                         self._executor, self._dispatch_spec
@@ -2681,12 +2850,15 @@ class InferenceEngine:
                 # loop that would stall the tunnel past the transport's 15 s
                 # dead-peer timeout.  warmup() precompiles every variant; this
                 # is the belt to that suspender for consumers that skip it.
-                current = (
-                    await loop.run_in_executor(self._executor, self._dispatch_decode)
-                    if any(self._active_mask) else None
-                )
+                current = None
+                if any(self._active_mask):
+                    t_disp0 = time.monotonic()
+                    outs_dev0, assign0 = await loop.run_in_executor(
+                        self._executor, self._dispatch_decode
+                    )
+                    current = (outs_dev0, assign0, t_disp0)
                 if in_flight is not None:
-                    outs_dev, assign = in_flight
+                    outs_dev, assign, t_disp = in_flight
                     t0 = time.monotonic()
                     outs = await loop.run_in_executor(
                         self._executor,
@@ -2699,6 +2871,7 @@ class InferenceEngine:
                         "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                     )
                     await self._process_burst(outs, assign)
+                    self._trace_burst(t_disp, assign)
                 for seg in segs:
                     # Fetched after the decode work above, so each segment
                     # sub-batch's device→host RTT rides under real compute

@@ -74,6 +74,16 @@ class PrefixIndex:
         self.hits = 0
         self.lookups = 0
 
+    @property
+    def used_blocks(self) -> int:
+        """Pool blocks currently holding cached KV (excludes scratch)."""
+        return len(self._lru)
+
+    @property
+    def free_blocks(self) -> int:
+        """Pool blocks available for insertion without an eviction."""
+        return len(self._free)
+
     def export_state(self) -> List[List]:
         """LRU-ordered [[hex key, pool idx], ...] (oldest first) for the
         pool snapshot."""

@@ -372,6 +372,17 @@ def parse_deadline_ms(headers: Dict[str, str]) -> "Optional[float]":
     return None
 
 
+#: Optional trace-context header (``<trace_id>/<parent_span_id>``): minted
+#: at the proxy, carried in RequestHeaders.headers across the tunnel, and
+#: picked up by serve + the engine — the x-tunnel-deadline-ms precedent.
+#: Defined (with its parser) in utils/tracing.py, which owns the span
+#: vocabulary; re-exported here because, like the deadline, it is a wire
+#: convention peers must agree on.
+from p2p_llm_tunnel_tpu.utils.tracing import (  # noqa: E402
+    TRACE_HEADER,  # noqa: F401  (re-exported: the wire-contract surface)
+)
+
+
 def iter_body_chunks(data: bytes, chunk_size: int = MAX_BODY_CHUNK):
     """Split a body into frame-sized chunks. Yields nothing for empty bodies."""
     for i in range(0, len(data), chunk_size):

@@ -8,18 +8,19 @@ engine, and ``bench.py`` all share.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from collections import defaultdict
-from typing import Dict, List
+from collections import defaultdict, deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 #: The one catalogue of legal metric names.  Every literal string handed to
 #: ``Metrics.inc``/``set_gauge``/``observe`` (and the read-side ``counter``/
 #: ``gauge``/``percentile``/``rate``, which /healthz and bench.py use) must
 #: appear here — enforced statically by tunnelcheck rule TC06, so a typo'd
 #: name can't silently split a time series.  ``snapshot()`` derives
-#: ``<hist>_p50``/``_p95``/``_count`` suffixes from histogram names; those
-#: derived keys are intentionally not catalogued.
+#: ``<hist>_p50``/``_p95``/``_p99``/``_p999``/``_count`` suffixes from
+#: histogram names; those derived keys are intentionally not catalogued.
 METRICS_CATALOG: Dict[str, str] = {
     # -- engine ----------------------------------------------------------
     "engine_tokens_total": "decode tokens emitted to streams (counter)",
@@ -78,13 +79,47 @@ METRICS_CATALOG: Dict[str, str] = {
     "transport_in_flight": "unacked ARQ packets (gauge)",
     "transport_srtt_ms": "smoothed RTT of the ARQ path (gauge, ms)",
     "transport_retransmits_total": "ARQ retransmissions (counter)",
+    # -- prefix pool (ISSUE 6: /healthz memory accounting) ----------------
+    "engine_prefix_pool_blocks_used": (
+        "prefix-cache pool blocks holding cached prompt KV (gauge; "
+        "capacity minus free minus the scratch block)"
+    ),
+    "engine_prefix_pool_blocks_free": (
+        "prefix-cache pool blocks available for insertion (gauge)"
+    ),
+    "engine_prefix_pool_kv_bytes": (
+        "resident KV bytes of used prefix-pool blocks (gauge; reflects the "
+        "kv_quant mode — int8/int4 pools store proportionally fewer bytes "
+        "per block)"
+    ),
 }
+
+#: Default reservoir size per histogram.  Sized for tail quantiles: p999
+#: needs ~1000+ samples AFTER the keep-recent halving, so the floor the
+#: reservoir can drop to (cap/2) must stay comfortably above that.  The
+#: pre-ISSUE-6 cap of 4096 could not support p999 claims right after a
+#: halving; override per-registry or via TUNNEL_METRICS_RESERVOIR.
+DEFAULT_RESERVOIR = 16384
+
+
+def nearest_rank(values: List[float], p: float) -> float:
+    """Nearest-rank percentile ``p`` (0–100) over an unsorted list; 0.0
+    when empty.  The ONE estimator shared by the registry reservoirs,
+    bench herd rows, and scripts/traceview.py — a fix applied here cannot
+    diverge the three tails from each other."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
 
 
 class _Percentiles:
     """Bounded reservoir of observations with percentile queries."""
 
-    def __init__(self, cap: int = 4096):
+    def __init__(self, cap: int = DEFAULT_RESERVOIR):
+        if cap < 2:
+            raise ValueError("reservoir cap must be >= 2")
         self._cap = cap
         self._values: List[float] = []
 
@@ -95,11 +130,21 @@ class _Percentiles:
         self._values.append(v)
 
     def percentile(self, p: float) -> float:
+        return nearest_rank(self._values, p)
+
+    def percentiles(self, ps) -> List[float]:
+        """Several quantiles from ONE sort — snapshot()/prometheus_text()
+        read 4-5 quantiles per histogram while holding the registry lock
+        the per-token hot path contends on, so the sort must not repeat
+        per quantile."""
         if not self._values:
-            return 0.0
+            return [0.0] * len(ps)
         xs = sorted(self._values)
-        idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
-        return xs[idx]
+        n = len(xs)
+        return [
+            xs[min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))]
+            for p in ps
+        ]
 
     @property
     def count(self) -> int:
@@ -107,13 +152,35 @@ class _Percentiles:
 
 
 class Metrics:
-    """Thread-safe registry of counters, gauges, and latency histograms."""
+    """Thread-safe registry of counters, gauges, and latency histograms.
 
-    def __init__(self) -> None:
+    ``hist_cap`` sizes every histogram's reservoir (default
+    DEFAULT_RESERVOIR, overridable process-wide via the
+    ``TUNNEL_METRICS_RESERVOIR`` env var) — the knob that decides which
+    tail quantiles the registry can honestly report.
+    """
+
+    def __init__(self, hist_cap: Optional[int] = None) -> None:
+        if hist_cap is None:
+            hist_cap = int(
+                os.environ.get("TUNNEL_METRICS_RESERVOIR", "")
+                or DEFAULT_RESERVOIR
+            )
+        if hist_cap < 2:
+            # Validated HERE, not lazily in the defaultdict factory: a bad
+            # TUNNEL_METRICS_RESERVOIR must fail at construction, not at
+            # the first observe() deep inside the serving path.
+            raise ValueError("hist_cap (reservoir size) must be >= 2")
+        self._hist_cap = hist_cap
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, _Percentiles] = defaultdict(_Percentiles)
+        self._hists: Dict[str, _Percentiles] = defaultdict(
+            lambda: _Percentiles(self._hist_cap)
+        )
+        #: Per-counter (time, value) samples taken at rate() reads — the
+        #: sliding-window rate state (see rate()).
+        self._rate_hist: Dict[str, Deque[Tuple[float, float]]] = {}
         self._t0 = time.monotonic()
 
     def inc(self, name: str, amount: float = 1.0) -> None:
@@ -140,11 +207,40 @@ class Metrics:
         with self._lock:
             return self._hists[name].percentile(p)
 
-    def rate(self, name: str) -> float:
-        """Counter value divided by registry lifetime — a crude average rate."""
+    def rate(self, name: str, window_s: float = 60.0) -> float:
+        """Average counter rate over (approximately) the last ``window_s``
+        seconds, NOT over registry lifetime.
+
+        Samples are taken at read time: each call records (now, value) and
+        the rate is computed against the oldest retained sample — retained
+        means inside the window, or the one newest sample just outside it
+        (the anchor for pollers spaced wider than the window) — so the
+        number tracks current traffic instead of diluting
+        toward zero as the process ages — and ``reset()`` mid-bench drops
+        the sample history with the counters, so a post-reset read can
+        never divide a fresh count by a stale anchor (the pre-ISSUE-6 bug
+        class).  The first read of a counter falls back to value divided
+        by registry lifetime (the only window that exists yet).
+        """
+        now = time.monotonic()
         with self._lock:
-            dt = time.monotonic() - self._t0
-            return self._counters.get(name, 0.0) / dt if dt > 0 else 0.0
+            cur = self._counters.get(name, 0.0)
+            hist = self._rate_hist.setdefault(name, deque())
+            # Keep the NEWEST sample outside the window as the anchor:
+            # popping every out-of-window sample would leave a poller
+            # spaced wider than the window with no anchor at all and fall
+            # back to the lifetime average every read.
+            while len(hist) >= 2 and now - hist[1][0] > window_s:
+                hist.popleft()
+            if hist:
+                t_old, v_old = hist[0]
+                dt = now - t_old
+                out = (cur - v_old) / dt if dt > 0 else 0.0
+            else:
+                dt = now - self._t0
+                out = cur / dt if dt > 0 else 0.0
+            hist.append((now, cur))
+            return max(0.0, out)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -152,16 +248,71 @@ class Metrics:
             out.update(self._gauges)
             for name, hist in self._hists.items():
                 if hist.count:
-                    out[f"{name}_p50"] = hist.percentile(50)
-                    out[f"{name}_p95"] = hist.percentile(95)
+                    p50, p95, p99, p999 = hist.percentiles(
+                        (50, 95, 99, 99.9)
+                    )
+                    out[f"{name}_p50"] = p50
+                    out[f"{name}_p95"] = p95
+                    out[f"{name}_p99"] = p99
+                    out[f"{name}_p999"] = p999
                     out[f"{name}_count"] = float(hist.count)
             return out
+
+    #: Prometheus summary quantiles every histogram exposes.
+    PROM_QUANTILES = (("0.5", 50.0), ("0.95", 95.0), ("0.99", 99.0),
+                      ("0.999", 99.9))
+    #: Exposition content type (the text format version Prometheus scrapes).
+    PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def prometheus_text(self) -> str:
+        """The FULL catalog in Prometheus text exposition format.
+
+        Every catalogued name appears (zero-valued when never written), so
+        a scraper's first sample already carries the complete schema —
+        dashboards never have to guess whether a missing series means
+        "zero" or "typo".  Histograms render as summaries with the
+        PROM_QUANTILES quantiles.  Kind is derived from the catalogue
+        entry itself: ``*_total`` = counter, ``(histogram`` in the
+        description = summary, everything else = gauge — the same
+        convention the descriptions already follow.
+        """
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {
+                name: (
+                    list(zip(
+                        (q for q, _p in self.PROM_QUANTILES),
+                        h.percentiles([p for _q, p in self.PROM_QUANTILES]),
+                    )),
+                    h.count,
+                )
+                for name, h in self._hists.items()
+            }
+        lines: List[str] = []
+        for name, desc in METRICS_CATALOG.items():
+            help_text = " ".join(desc.split())
+            lines.append(f"# HELP {name} {help_text}")
+            if "(histogram" in desc:
+                lines.append(f"# TYPE {name} summary")
+                quantiles, count = hists.get(name, ([], 0))
+                for q, v in quantiles:
+                    lines.append(f'{name}{{quantile="{q}"}} {v:.6g}')
+                lines.append(f"{name}_count {count}")
+            elif name.endswith("_total"):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {counters.get(name, 0.0):.6g}")
+            else:
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {gauges.get(name, 0.0):.6g}")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._rate_hist.clear()
             self._t0 = time.monotonic()
 
 

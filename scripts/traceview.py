@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace-event capture from ``GET /healthz?trace=1``.
+
+Stdlib-only companion to ``utils/tracing.py``: groups the journal's spans
+by propagated trace id and prints one line per request — total span, the
+TTFT decomposition (queue-wait + prefill-exec), park time, outcome — plus
+aggregate tail percentiles across the capture.  The same JSON loads in
+``chrome://tracing`` / Perfetto for the visual timeline; this is the
+terminal-sized view.
+
+Usage:
+    curl -s 'http://127.0.0.1:8000/healthz?trace=1' > trace.json   # via proxy
+    python scripts/traceview.py trace.json
+    python scripts/traceview.py trace.json --json     # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+# Runnable as `python scripts/traceview.py` from anywhere: put the repo
+# root ahead of scripts/ so the package import below resolves.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _pct(xs: List[float], p: float) -> Optional[float]:
+    """The registry's shared nearest-rank estimator, so traceview tails
+    can never diverge from /metrics quantiles over the same data."""
+    from p2p_llm_tunnel_tpu.utils.metrics import nearest_rank
+
+    return nearest_rank(xs, p) if xs else None
+
+
+def summarize(trace: dict) -> dict:
+    """Per-request rollup of a Chrome trace-event object.
+
+    Returns ``{"requests": [...], "aggregate": {...}, "engine_scope":
+    {...}}`` where each request entry carries ms durations keyed off the
+    span names in utils.tracing.SPAN_CATALOG."""
+    from p2p_llm_tunnel_tpu.utils.tracing import validate_chrome_trace
+
+    validate_chrome_trace(trace)
+    by_trace: Dict[str, List[dict]] = {}
+    engine_scope: Dict[str, List[float]] = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        args = ev.get("args", {})
+        tid = args.get("trace_id")
+        if tid is None:
+            if ev.get("ph") == "X":
+                engine_scope.setdefault(ev["name"], []).append(
+                    ev["dur"] / 1000.0
+                )
+            continue
+        by_trace.setdefault(tid, []).append(ev)
+
+    requests = []
+    for tid, evs in sorted(
+        by_trace.items(), key=lambda kv: min(e["ts"] for e in kv[1])
+    ):
+        spans: Dict[str, List[dict]] = {}
+        events: Dict[str, List[dict]] = {}
+        for e in evs:
+            (spans if e["ph"] == "X" else events).setdefault(
+                e["name"], []
+            ).append(e)
+
+        def earliest(name: str) -> Optional[dict]:
+            lst = spans.get(name)
+            return min(lst, key=lambda e: e["ts"]) if lst else None
+
+        # One HTTP request per trace at the proxy, but one trace can hold
+        # SEVERAL engine generations (n>1 / prompt lists share the
+        # propagated context): children are matched to their generation by
+        # parent linkage — never by name, which would pair generation B's
+        # first token with generation A's span — and the row reports the
+        # first generation plus a generation count.
+        gens = sorted(spans.get("engine.request", ()),
+                      key=lambda e: e["ts"])
+        eng = gens[0] if gens else None
+
+        def child_dur(name: str) -> Optional[float]:
+            if eng is None:
+                return None
+            for e in spans.get(name, ()):
+                if e["args"].get("parent_id") == eng["args"]["span_id"]:
+                    return e["dur"] / 1000.0
+            return None
+
+        ttft = None
+        if eng is not None:
+            for e in events.get("engine.first_token", ()):
+                if e["args"].get("parent_id") == eng["args"]["span_id"]:
+                    ttft = (e["ts"] - eng["ts"]) / 1000.0
+                    break
+        parks = spans.get("engine.prefix_park", ())
+        prx = earliest("proxy.request")
+        top = prx or earliest("serve.dispatch") or eng
+        requests.append({
+            "trace_id": tid,
+            "path": (top or {}).get("args", {}).get("path"),
+            "status": (prx or {}).get("args", {}).get("status"),
+            "finish": (eng or {}).get("args", {}).get("finish"),
+            "total_ms": top["dur"] / 1000.0 if top is not None else None,
+            "ttft_ms": ttft,
+            "queue_wait_ms": child_dur("engine.queue_wait"),
+            "prefill_exec_ms": child_dur("engine.prefill_exec"),
+            "park_ms": (sum(e["dur"] for e in parks) / 1000.0
+                        if parks else None),
+            "generations": len(gens),
+            "layers": sorted({e["cat"] for e in evs}),
+            "spans": len(evs),
+        })
+
+    ttfts = [r["ttft_ms"] for r in requests if r["ttft_ms"] is not None]
+    aggregate = {
+        "requests": len(requests),
+        "ttft_p50_ms": _pct(ttfts, 50),
+        "ttft_p99_ms": _pct(ttfts, 99),
+        "ttft_p999_ms": _pct(ttfts, 99.9),
+    }
+    scope = {
+        name: {"count": len(xs), "p50_ms": _pct(xs, 50)}
+        for name, xs in sorted(engine_scope.items())
+    }
+    return {"requests": requests, "aggregate": aggregate,
+            "engine_scope": scope}
+
+
+def _fmt(v: Optional[float]) -> str:
+    return f"{v:8.1f}" if v is not None else "       -"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="traceview",
+        description="Summarize a /healthz?trace=1 Chrome trace capture.",
+    )
+    ap.add_argument("path", help="trace JSON file ('-' = stdin)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rollup as JSON instead of a table")
+    args = ap.parse_args(argv)
+    raw = (sys.stdin.read() if args.path == "-"
+           else open(args.path).read())
+    out = summarize(json.loads(raw))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    print(f"{'trace':12} {'total':>8} {'ttft':>8} {'queue':>8} "
+          f"{'prefill':>8} {'park':>8}  layers / finish")
+    for r in out["requests"]:
+        layers = "->".join(
+            t for t in ("proxy", "serve", "engine") if t in r["layers"]
+        )
+        print(f"{r['trace_id'][:12]:12} {_fmt(r['total_ms'])} "
+              f"{_fmt(r['ttft_ms'])} {_fmt(r['queue_wait_ms'])} "
+              f"{_fmt(r['prefill_exec_ms'])} {_fmt(r['park_ms'])}  "
+              f"{layers} / {r['finish'] or '-'}")
+    agg = out["aggregate"]
+    print(f"-- {agg['requests']} request(s); engine TTFT ms "
+          f"p50={agg['ttft_p50_ms']} p99={agg['ttft_p99_ms']} "
+          f"p999={agg['ttft_p999_ms']}")
+    for name, s in out["engine_scope"].items():
+        print(f"-- {name}: n={s['count']} p50={s['p50_ms']:.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `traceview … | head` is a normal way to skim a big capture.
+        sys.exit(0)

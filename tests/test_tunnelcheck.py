@@ -1236,6 +1236,115 @@ def test_tc08_fixture_without_cli_checks_against_repo_cli(tmp_path):
     assert "zz_never_a_real_flag" in active[0].message
 
 
+# ---------------------------------------------------------------------------
+# TC09 — span-name registry + host-only emission (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def test_tc09_unknown_span_name_is_flagged(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        def emit(tid):
+            global_tracer.add_span("engine.queue_wiat", trace_id=tid, t0=0.0)
+        """,
+        rules=["TC09"],
+    )
+    assert rules_of(active) == ["TC09"]
+    assert "SPAN_CATALOG" in active[0].message
+
+
+def test_tc09_catalogued_names_and_dynamic_names_are_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        def emit(tid, name):
+            global_tracer.add_span("engine.request", trace_id=tid, t0=0.0)
+            global_tracer.add_event("engine.first_token", trace_id=tid)
+            global_tracer.add_event(name, trace_id=tid)  # non-literal: skipped
+        """,
+        rules=["TC09"],
+    )
+    assert active == []
+
+
+def test_tc09_emission_inside_jitted_function_is_flagged(tmp_path):
+    """Span emission is host-only: a recorder call inside a function this
+    module jits (or scans) is a tracer error at best, a per-step host sync
+    at worst — flagged even when the span name itself is legal."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        def step(x):
+            global_tracer.add_event("engine.first_token", trace_id="ab")
+            return x + 1
+
+        fast = jax.jit(step)
+        """,
+        rules=["TC09"],
+    )
+    assert rules_of(active) == ["TC09"]
+    assert "host-only" in active[0].message
+
+
+def test_tc09_emission_inside_scanned_function_is_flagged(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        def body(carry, x):
+            global_tracer.add_span("engine.decode_burst", trace_id=None,
+                                   t0=0.0)
+            return carry, x
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+        """,
+        rules=["TC09"],
+    )
+    assert rules_of(active) == ["TC09"]
+
+
+def test_tc09_waiver_suppresses(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.utils.tracing import global_tracer
+
+        def emit(tid):
+            global_tracer.add_event(
+                "adhoc.probe", trace_id=tid,
+            )  # tunnelcheck: disable=TC09  one-off debugging probe
+        """,
+        rules=["TC09"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC09"]
+
+
+def test_tc09_emit_sites_match_the_shipped_catalog():
+    """The repo's own emit sites (proxy, serve, engine) stay aligned with
+    SPAN_CATALOG — the narrow self-run gate for TC09."""
+    active, _ = run_paths(
+        [
+            REPO_ROOT / "p2p_llm_tunnel_tpu" / "endpoints",
+            REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine",
+            REPO_ROOT / "p2p_llm_tunnel_tpu" / "utils",
+        ],
+        rules=["TC09"],
+    )
+    assert active == [], [v.render(REPO_ROOT) for v in active]
+
+
 def test_tc08_self_run_every_field_wired_or_waived():
     """The shipped EngineConfig stays rot-free: every field has a serve
     flag or carries a reasoned waiver (the self-run gate for TC08,

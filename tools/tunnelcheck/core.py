@@ -328,6 +328,12 @@ class ProjectContext:
         self.metrics_names: Set[str] = (
             _str_collection(metrics, "METRICS_CATALOG") if metrics else set()
         )
+        tracing = _parse_registry_file(
+            "p2p_llm_tunnel_tpu/utils/tracing.py", self.files
+        )
+        self.span_names: Set[str] = (
+            _str_collection(tracing, "SPAN_CATALOG") if tracing else set()
+        )
 
     def _index_defs(self, sf: SourceFile) -> None:
         class Indexer(ast.NodeVisitor):
@@ -414,6 +420,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_jax,
         rules_metrics,
         rules_protocol,
+        rules_tracing,
     )
 
     return {
@@ -425,6 +432,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC06": rules_metrics.check_tc06,
         "TC07": rules_dispatch.check_tc07,
         "TC08": rules_config.check_tc08,
+        "TC09": rules_tracing.check_tc09,
     }
 
 
@@ -438,6 +446,7 @@ RULE_SUMMARIES = {
     "TC06": "metric name not declared in utils.metrics.METRICS_CATALOG",
     "TC07": "device dispatch inside a per-request/slot loop on the serving path",
     "TC08": "EngineConfig field not wired to a cli.py flag (config rot)",
+    "TC09": "span name not in utils.tracing.SPAN_CATALOG / span emission inside traced fns",
 }
 
 
