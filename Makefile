@@ -1,7 +1,7 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native native-san lint test test-unit test-fast test-local test-race chaos bench serve proxy signal multichip
+.PHONY: native native-san lint test test-unit test-fast test-local test-race chaos bench loadgen serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
@@ -63,6 +63,18 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# two runs must yield the SAME span topology per trace (tracing is
 	@# part of the determinism contract, not an exception to it).
 	CHAOS_TEST_SEED=5 python -m pytest tests/test_tracing.py -k chaos_span_topology -q
+	@# ISSUE 7 matrix row: ingress scale under the slow-reader/bandwidth-
+	@# cap fault — a 500-stream out-of-process herd through a bw-capped
+	@# loopback tunnel must finish with zero stuck streams (loadgen's exit
+	@# code IS the gate) while the frame-mux HOL test pins per-stream
+	@# credit isolation at the same seed.
+	CHAOS_TEST_SEED=5 python -m pytest tests/test_flow_control.py -k stalled_stream -q
+	TUNNEL_CHAOS="seed=5,bw=4e6" LOADGEN_CLIENTS=$${LOADGEN_CLIENTS:-500} $(MAKE) loadgen
+
+loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
+	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \
+		--tenant herd:$${LOADGEN_CLIENTS:-500} \
+		--max-tokens $${LOADGEN_MAX_TOKENS:-16} --json
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py

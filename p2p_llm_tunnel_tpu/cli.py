@@ -129,6 +129,23 @@ def build_parser() -> argparse.ArgumentParser:
                             "the engine's waiting queue before new work is "
                             "shed with HTTP 429 + Retry-After (0 = "
                             "unbounded; tpu backend)")
+    serve.add_argument("--fair-admission",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_FAIR_ADMISSION", "1") == "1",
+                       help="tenant-fair admission (default ON): weighted-"
+                            "fair ordering across x-tunnel-tenant "
+                            "identities plus per-tenant waiting-queue "
+                            "share caps, so one hot API key is shed (429 "
+                            "tenant_overlimit) before it starves others; "
+                            "degenerates to plain FIFO with one tenant "
+                            "(--no-fair-admission or "
+                            "TUNNEL_FAIR_ADMISSION=0 disables)")
+    serve.add_argument("--tenant-weights",
+                       default=_env("TUNNEL_TENANT_WEIGHTS", ""),
+                       help="fairness weights as name=weight,name=weight "
+                            "(unlisted tenants weigh 1.0); a weight-4 "
+                            "tenant gets 4x the contended queue share and "
+                            "admission stride (env TUNNEL_TENANT_WEIGHTS)")
     serve.add_argument("--max-inflight", type=int,
                        default=int(_env("TUNNEL_MAX_INFLIGHT", "256")),
                        help="admission control at the tunnel layer: max "
@@ -274,6 +291,18 @@ def build_parser() -> argparse.ArgumentParser:
     common(proxy)
     proxy.add_argument("--listen", default=DEFAULT_LISTEN,
                        help="local HTTP listen addr (env TUNNEL_LISTEN)")
+    proxy.add_argument("--trust-tenant-header",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_TRUST_TENANT_HEADER", "") == "1",
+                       help="honor a client-sent x-tunnel-tenant at this "
+                            "listener (default OFF: a public listener "
+                            "trusting the label lets one client mint a "
+                            "fresh tenant per request, sidestepping its "
+                            "fair-share cap; identities otherwise come "
+                            "from x-api-key fingerprints or the room "
+                            "fallback — enable only behind a trusted "
+                            "edge that stamps the header; env "
+                            "TUNNEL_TRUST_TENANT_HEADER=1)")
 
     sig = sub.add_parser("signal", help="run the rendezvous server")
     sig.add_argument("--listen", default="127.0.0.1")
@@ -482,6 +511,8 @@ async def _engine_backend(args):
                     mux=args.mux,
                     mux_budget_tokens=args.mux_budget_tokens,
                     max_waiting=args.max_waiting,
+                    fair_admission=args.fair_admission,
+                    tenant_weights=args.tenant_weights,
                     watchdog_budget_s=args.watchdog_budget,
                     seed=seed,
                 )
@@ -540,7 +571,11 @@ async def _proxy_once(args) -> None:
                                        stun_server=args.stun, relay=args.relay,
                                        relay_secret=args.relay_secret)
     try:
-        await run_proxy(channel, host or "127.0.0.1", int(port))
+        # Untagged requests inherit the room as tenant: one proxy
+        # connection = one accountable identity at the serve peer.
+        await run_proxy(channel, host or "127.0.0.1", int(port),
+                        tenant_fallback=args.room or "",
+                        trust_tenant_header=args.trust_tenant_header)
     finally:
         channel.close()
         await signaling.close()

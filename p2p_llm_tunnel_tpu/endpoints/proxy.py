@@ -31,6 +31,7 @@ from p2p_llm_tunnel_tpu.endpoints.http11 import (
 )
 from p2p_llm_tunnel_tpu.protocol.frames import (
     CREDIT_BATCH,
+    TENANT_HEADER,
     Agree,
     Hello,
     MessageType,
@@ -39,6 +40,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     ResponseHeaders,
     TunnelMessage,
     encode_body_frames,
+    parse_tenant,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
@@ -85,10 +87,22 @@ _StreamEvent = Union[_Headers, _Body, _Error, _End]
 class ProxyState:
     """Shared state between the HTTP handler and the response-reader task."""
 
-    def __init__(self, channel: Channel):
+    def __init__(self, channel: Channel, tenant_fallback: str = "",
+                 trust_tenant_header: bool = False):
         self.channel = channel
         self.tunnel_ready = False
         self.flow_enabled = False  # set from the AGREE feature list
+        #: Tenant identity stamped on requests that carry neither an
+        #: x-api-key nor an x-tunnel-tenant header — typically the room
+        #: name, so one proxy connection is one accountable tenant.
+        self.tenant_fallback = tenant_fallback
+        #: Honor a client-sent x-tunnel-tenant at THIS listener.  Off by
+        #: default: a public-facing proxy that trusted the label would let
+        #: one client mint a fresh tenant per request, sidestepping its own
+        #: fair-share cap and crushing every real tenant's share toward the
+        #: floor of 1 (see frames.parse_tenant).  Opt in only when a
+        #: trusted edge stamps the header.
+        self.trust_tenant_header = trust_tenant_header
         self._next_stream_id = 1
         self.pending: Dict[int, asyncio.Queue[_StreamEvent]] = {}
 
@@ -140,10 +154,18 @@ async def _response_reader(state: ProxyState) -> None:
                 q.put_nowait(_End())
         elif msg.msg_type == MessageType.ERROR:
             text = msg.payload.decode("utf-8", "replace")
-            log.error("tunnel error for stream %d: %s", msg.stream_id, text)
             q = state.pending.pop(msg.stream_id, None)
             if q is not None:
+                log.error("tunnel error for stream %d: %s", msg.stream_id, text)
                 q.put_nowait(_Error(text))
+            else:
+                # Expected, not an anomaly: serve relays a backend shed's
+                # typed code ([busy]/[tenant_overlimit]) AFTER RES_END, by
+                # which point this demux has already forgotten the stream.
+                # Error-level here would emit one misleading line per shed
+                # — exactly under the overload the typed codes exist for.
+                log.debug("post-stream tunnel error for %d: %s",
+                          msg.stream_id, text)
         elif msg.msg_type == MessageType.PING:
             try:
                 await channel.send(TunnelMessage.pong().encode())
@@ -198,6 +220,17 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
     stream_id = state.alloc_stream_id()
     t_start = time.monotonic()
     global_metrics.inc("proxy_requests_total")
+    # Tenant identity (ISSUE 7): normalized HERE, at the tunnel's ingress —
+    # an explicit x-tunnel-tenant wins (only behind --trust-tenant-header;
+    # a public listener honoring it would let clients mint identities and
+    # defeat fair admission), else the FINGERPRINT of the client's
+    # x-api-key (the label is exported on /metrics and /healthz; the raw
+    # credential never becomes an identity), else this proxy's
+    # connection-scoped fallback (the room name).  The
+    # canonical header rides RequestHeaders across the tunnel so serve +
+    # engine fair-admit and account per tenant without re-deriving.
+    tenant = parse_tenant(req.headers, state.tenant_fallback,
+                          trust_label=state.trust_tenant_header)
     log.debug("proxying %s %s (stream %d)", req.method, req.path, stream_id)
 
     # Trace context (ISSUE 6): accept the client's x-tunnel-trace or mint a
@@ -222,19 +255,33 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         if root_span is None or span_done:
             return
         span_done = True
+        attrs = {"method": req.method, "path": req.path,
+                 "stream_id": stream_id, "status": status}
+        if tenant:
+            attrs["tenant"] = tenant
         global_tracer.add_span(
             "proxy.request", trace_id=trace_id, span_id=root_span,
             parent_id=(inbound.span_id or None) if inbound else None,
-            track="proxy", t0=t_start,
-            attrs={"method": req.method, "path": req.path,
-                   "stream_id": stream_id, "status": status},
+            track="proxy", t0=t_start, attrs=attrs,
         )
 
     headers_out_tunnel = dict(req.headers)
+    # Drop any client-sent case-variant UNCONDITIONALLY — not just when a
+    # normalized stamp replaces it: inside the tunnel the header is trusted
+    # (api.parse_tenant's proxy-stamped default), so a raw copy surviving a
+    # no-identity request would hand the client the exact identity-minting
+    # hole the untrusted-listener default closes.  When a tenant was
+    # derived, the stamped value must also be the ONLY one on the wire, or
+    # downstream lookups could read the raw (untruncated, unstripped) copy.
+    for k in [k for k in headers_out_tunnel
+              if k.lower() == TENANT_HEADER]:
+        del headers_out_tunnel[k]
+    if tenant:
+        headers_out_tunnel[TENANT_HEADER] = tenant
     if root_span is not None:
         headers_out_tunnel[TRACE_HEADER] = f"{trace_id}/{root_span}"
 
-    events: asyncio.Queue[_StreamEvent] = asyncio.Queue()
+    events: asyncio.Queue[_StreamEvent] = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in BYTES by FLOW credit: the serve peer stops emitting at INITIAL_CREDIT unacked bytes until body_stream() below grants more; against a no-"flow" reference peer the bound is the upstream's own response pacing (documented reference behavior)
     state.pending[stream_id] = events
     global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
 
@@ -349,14 +396,25 @@ async def run_proxy(
     listen_host: str = "127.0.0.1",
     listen_port: int = 8000,
     ready: Optional["asyncio.Future[int]"] = None,
+    tenant_fallback: str = "",
+    trust_tenant_header: bool = False,
 ) -> None:
     """Run the consumer side until the tunnel dies; raises to trigger retry.
 
     ``ready`` (optional) resolves to the bound port once the listener is up —
     the programmatic readiness signal (the reference greps logs instead,
     scripts/test-tunnel.sh:79-86).
+
+    ``tenant_fallback`` stamps x-tunnel-tenant on requests that carry no
+    API key — the CLI passes the room name, so untagged traffic through
+    one proxy connection is one accountable tenant.
+
+    ``trust_tenant_header`` honors a client-sent x-tunnel-tenant at this
+    listener (default off — see ProxyState; enable only behind a trusted
+    edge, otherwise identities are minted from API keys or the fallback).
     """
-    state = ProxyState(channel)
+    state = ProxyState(channel, tenant_fallback=tenant_fallback,
+                       trust_tenant_header=trust_tenant_header)
 
     if not channel.connected.is_set():
         log.info("waiting for channel to be ready...")

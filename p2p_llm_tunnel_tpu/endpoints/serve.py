@@ -25,6 +25,8 @@ from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 from p2p_llm_tunnel_tpu.endpoints import http11
 from p2p_llm_tunnel_tpu.protocol.frames import (
     DEADLINE_HEADER,  # noqa: F401  (re-exported: the serve-side surface)
+    ERROR_CODE_HEADER,
+    ERROR_CODES,
     INITIAL_CREDIT,
     MAX_BODY_CHUNK,
     Agree,
@@ -39,7 +41,11 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
-from p2p_llm_tunnel_tpu.utils.metrics import Metrics, global_metrics
+from p2p_llm_tunnel_tpu.utils.metrics import (
+    Metrics,
+    derived_retry_after_s,
+    global_metrics,
+)
 from p2p_llm_tunnel_tpu.utils.tracing import (
     TRACE_HEADER,
     global_tracer,
@@ -156,7 +162,7 @@ async def _coalesce(
     per-frame cost is Python asyncio, which at 1800+ tok/s × 32 streams is
     material (PERF.md).
     """
-    queue: asyncio.Queue = asyncio.Queue()
+    queue: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in BYTES by the max_buffer window below: the pump pauses at ~4 frames' worth and the consumer reopens the window as it drains (put_nowait must stay infallible for the terminator)
     _done = object()
     # Byte-bounded buffer: the pump must NOT outrun the consumer without
     # limit, or it would defeat the flow-control backpressure the direct
@@ -312,6 +318,21 @@ async def _handle_request_inner(
         )
         return
 
+    # A backend error response may carry a typed tunnel-error code in a
+    # reserved header (e.g. the engine API's 429 busy/tenant_overlimit):
+    # pop it before relaying and follow RES_END with the matching typed
+    # ERROR frame, so protocol-aware peers dispatch on the same vocabulary
+    # regardless of which layer shed the request.  Sent after RES_END —
+    # the proxy forgets the stream there, so HTTP clients are unaffected.
+    shed_code = None
+    for k in list(headers):
+        if k.lower() == ERROR_CODE_HEADER:
+            v = headers.pop(k)
+            if v in ERROR_CODES:
+                shed_code = v
+            else:
+                log.warning("backend sent unknown %s %r; dropping",
+                            ERROR_CODE_HEADER, v)
     await channel.send(
         TunnelMessage.res_headers(ResponseHeaders(stream_id, status, headers)).encode()
     )
@@ -364,8 +385,14 @@ async def _handle_request_inner(
     except Exception as e:
         # Upstream dropped mid-stream — truncate with an ERROR frame
         # (serve.rs:278-284); the proxy ends the HTTP body without an error.
-        # Exceptions that carry a tunnel_code (engine DeadlineExceeded,
-        # scheduler QueueFull) emit the typed form.
+        # Exceptions that carry a tunnel_code emit the typed form.  NOTE:
+        # the engine API's STREAMING bodies no longer raise typed
+        # exceptions here — a mid-stream shed/deadline eviction ends the
+        # SSE body in-band (typed finish_reason + [DONE]) instead of
+        # truncating a 200 (ISSUE 7); mid-stream timeouts still get their
+        # typed frame from the deadline branch above when the client sent
+        # x-tunnel-deadline-ms, and engine_deadline_timeouts_total counts
+        # every engine-side eviction regardless of which layer noticed.
         log.error("upstream stream error for stream %d: %s", stream_id, e)
         code = getattr(e, "tunnel_code", None)
         if code == "timeout":
@@ -379,6 +406,11 @@ async def _handle_request_inner(
     finally:
         await agen.aclose()
     await channel.send(TunnelMessage.res_end(stream_id).encode())
+    if shed_code is not None:
+        global_metrics.inc("serve_shed_total")
+        await channel.send(TunnelMessage.typed_error(
+            stream_id, shed_code, f"shed by backend admission ({status})",
+        ).encode())
     log.debug("response %d complete: status=%d", stream_id, status)
 
 
@@ -398,6 +430,17 @@ async def _send_simple(
     for frame in encode_body_frames(MessageType.RES_BODY, stream_id, body):
         await channel.send(frame)
     await channel.send(TunnelMessage.res_end(stream_id).encode())
+
+
+def _retry_after_s(inflight: int) -> float:
+    """Advisory Retry-After for a serve-layer 429, derived from the live
+    load instead of a constant: the time to turn over the current
+    in-flight set at the recent dispatch rate (shared formula:
+    utils.metrics.derived_retry_after_s).  Published as the
+    ``serve_retry_after_s`` gauge on every computation (ISSUE 7)."""
+    return derived_retry_after_s(
+        inflight, "serve_requests_total", "serve_retry_after_s",
+    )
 
 
 async def _send_healthz(
@@ -468,6 +511,15 @@ async def _send_healthz(
             "kv_bytes": int(
                 global_metrics.gauge("engine_prefix_pool_kv_bytes")
             ),
+        },
+        # ISSUE 7 observability: per-tenant ingress accounting (in-flight,
+        # token rate, sheds) and the advisory Retry-After the 429 paths
+        # are currently quoting — the numbers that say WHO is loading the
+        # server and whether fairness is biting.
+        "tenants": global_metrics.tenant_snapshot(),
+        "retry_after_s": {
+            "engine": round(global_metrics.gauge("engine_retry_after_s"), 1),
+            "serve": round(global_metrics.gauge("serve_retry_after_s"), 1),
         },
     }
     await _send_simple(
@@ -689,7 +741,9 @@ async def _serve_dispatch(
                 await _send_simple(
                     channel, req.stream_id, 429,
                     b"Too Many Requests: in-flight limit reached",
-                    {"retry-after": "1"},
+                    {"retry-after": str(int(
+                        _retry_after_s(len(request_tasks)) + 0.5
+                    ))},
                 )
                 await channel.send(TunnelMessage.typed_error(
                     req.stream_id, "busy",

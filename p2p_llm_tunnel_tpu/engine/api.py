@@ -35,7 +35,12 @@ from typing import AsyncIterator, Dict, Tuple
 
 from p2p_llm_tunnel_tpu.engine.engine import DeadlineExceeded, InferenceEngine
 from p2p_llm_tunnel_tpu.engine.scheduler import QueueFull
-from p2p_llm_tunnel_tpu.protocol.frames import RequestHeaders, parse_deadline_ms
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    ERROR_CODE_HEADER,
+    RequestHeaders,
+    parse_deadline_ms,
+    parse_tenant,
+)
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 from p2p_llm_tunnel_tpu.utils.tracing import parse_trace_context
 
@@ -58,15 +63,27 @@ def _error(status: int, message: str):
     return _json_response(status, {"error": {"message": message, "type": "invalid_request_error"}})
 
 
-def _overloaded():
-    """HTTP 429 + Retry-After: the admission queue is full (shed, don't
-    buffer — the goodput argument of DistServe/AlignedServe, PAPERS.md)."""
+def _overloaded(retry_after_s: float = 1.0, code: str = "busy"):
+    """HTTP 429 + Retry-After: shed, don't buffer (the goodput argument of
+    DistServe/AlignedServe, PAPERS.md).
+
+    ``retry_after_s`` is the queue-depth-derived advisory (engine
+    retry_after_s()), never a constant; ``code`` is the typed tunnel-error
+    vocabulary entry ("busy" for a full global queue, "tenant_overlimit"
+    when THIS tenant is over its fair share) — carried in the
+    x-tunnel-error-code response header so the serve loop can follow the
+    relayed 429 with the matching typed ERROR frame.
+    """
+    if code == "tenant_overlimit":
+        msg = ("tenant over fair-share limit: this API key is consuming "
+               "more than its weighted share of a contended server")
+    else:
+        msg = "server overloaded: admission queue full"
     status, headers, it = _json_response(
-        429,
-        {"error": {"message": "server overloaded: admission queue full",
-                   "type": "overloaded_error"}},
+        429, {"error": {"message": msg, "type": "overloaded_error"}},
     )
-    headers["retry-after"] = "1"
+    headers["retry-after"] = str(max(1, int(retry_after_s + 0.5)))
+    headers[ERROR_CODE_HEADER] = code
     return status, headers, it
 
 
@@ -444,28 +461,38 @@ class EngineAPI:
         first = True
         n_tokens = 0
         pending_lp = []  # events for tokens whose text is still held
-        async for text, ev, finish in self._events(prompt_ids, kwargs, stops):
-            if ev is not None:
-                n_tokens += 1
-            if first and chat:
-                # OpenAI chat streams open with a role-only delta chunk;
-                # emitting it when the FIRST token lands (not at accept)
-                # also gives clients an honest time-to-first-token signal
-                # even when the token's text is empty (mid-codepoint byte,
-                # special id).  Legacy streams have no role chunk.
-                yield chunk({"role": "assistant"}, None)
-            first = False
-            if ev is not None and ev.logprob is not None:
-                pending_lp.append(ev)
-            if text:
-                if pending_lp:
-                    yield lp_chunk(text, pending_lp) if chat else \
-                        legacy_chunk(text, lp_obj_of(pending_lp), None)
-                    pending_lp = []
-                else:
-                    yield content_chunk(text)
-            if finish is not None:
-                finish_reason = finish
+        try:
+            async for text, ev, finish in self._events(prompt_ids, kwargs,
+                                                       stops):
+                if ev is not None:
+                    n_tokens += 1
+                if first and chat:
+                    # OpenAI chat streams open with a role-only delta chunk;
+                    # emitting it when the FIRST token lands (not at accept)
+                    # also gives clients an honest time-to-first-token signal
+                    # even when the token's text is empty (mid-codepoint
+                    # byte, special id).  Legacy streams have no role chunk.
+                    yield chunk({"role": "assistant"}, None)
+                first = False
+                if ev is not None and ev.logprob is not None:
+                    pending_lp.append(ev)
+                if text:
+                    if pending_lp:
+                        yield lp_chunk(text, pending_lp) if chat else \
+                            legacy_chunk(text, lp_obj_of(pending_lp), None)
+                        pending_lp = []
+                    else:
+                        yield content_chunk(text)
+                if finish is not None:
+                    finish_reason = finish
+        except (QueueFull, DeadlineExceeded) as e:
+            # Same contract as _openai_stream_multi's per-choice handling:
+            # the 200/SSE headers are already on the wire, so a mid-queue
+            # shed (tenant-fair displacement) or deadline eviction must end
+            # the stream with the typed code as its finish_reason — not
+            # propagate and truncate the body mid-stream, which a plain
+            # HTTP client can't tell apart from a dropped connection.
+            finish_reason = getattr(e, "tunnel_code", None) or "error"
         if pending_lp:
             # Entries whose text never emitted (mid-codepoint final byte,
             # zero-text stop): attach them to the final chunk so stream and
@@ -555,6 +582,14 @@ class EngineAPI:
             try:
                 async for item in self._events(pids, run_kwargs(i), stops):
                     await queue.put((i, item))
+            except (QueueFull, DeadlineExceeded) as e:
+                # A mid-queue shed (tenant-fair displacement) or deadline
+                # eviction of ONE choice must not masquerade as a clean
+                # "stop": the merged stream cannot abort its siblings, so
+                # the typed code becomes this choice's finish_reason.
+                await queue.put(
+                    (i, (None, None, getattr(e, "tunnel_code", "error")))
+                )
             finally:
                 await queue.put((i, None))
 
@@ -932,6 +967,11 @@ class EngineAPI:
 
         try:
             kwargs, n_top, echo, score_only = self._gen_kwargs(payload)
+            tenant = parse_tenant(req.headers)
+            if tenant:
+                # Fair-admission identity + per-tenant accounting; ""
+                # (direct untagged embedding) opts out of both.
+                kwargs["tenant"] = tenant
             deadline_ms = parse_deadline_ms(req.headers)
             if deadline_ms is not None:
                 # Absolute monotonic deadline: enforced by the scheduler
@@ -965,11 +1005,20 @@ class EngineAPI:
             # prompt-list dimension must not escape the bound n has.
             max_fanout = 16
             # Admission control BEFORE any streaming 200 goes out: a full
-            # waiting queue means this request would only buffer, so shed
-            # it now with 429 + Retry-After.  (QueueFull from a submit race
-            # is additionally caught below for the non-stream paths.)
-            if self.engine.overloaded(n_choices):
-                return _overloaded()
+            # waiting queue — or a tenant over its fair share of one —
+            # means this request would only buffer or displace, so shed it
+            # now with 429 + a queue-derived Retry-After.  (QueueFull /
+            # TenantOverLimit from a submit race is additionally caught
+            # below for the non-stream paths.)
+            shed_code = self.engine.admission_check(n_choices, tenant)
+            if shed_code is not None:
+                if shed_code == "tenant_overlimit":
+                    from p2p_llm_tunnel_tpu.utils.metrics import (
+                        global_metrics,
+                    )
+
+                    global_metrics.tenant_shed(tenant)
+                return _overloaded(self.engine.retry_after_s(), shed_code)
 
             if path == "/v1/chat/completions":
                 if echo:
@@ -1061,8 +1110,13 @@ class EngineAPI:
                           "message": {"role": "assistant", "content": text},
                           "done": True, "done_reason": finish, "eval_count": n},
                 )
-        except QueueFull:
-            return _overloaded()
+        except QueueFull as e:
+            # TenantOverLimit subclasses QueueFull and carries its own
+            # typed code; both get the live queue-derived Retry-After.
+            return _overloaded(
+                self.engine.retry_after_s(),
+                getattr(e, "tunnel_code", "busy"),
+            )
         except DeadlineExceeded as e:
             return _timeout(str(e))
         except (ValueError, TypeError) as e:

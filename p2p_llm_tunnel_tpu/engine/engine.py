@@ -30,6 +30,8 @@ from p2p_llm_tunnel_tpu.engine.scheduler import (
     MuxController,
     RunningSlot,
     Scheduler,
+    TenantOverLimit,
+    parse_tenant_weights,
 )
 from p2p_llm_tunnel_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder, Tokenizer
 from p2p_llm_tunnel_tpu.models.config import ModelConfig, get_config
@@ -40,7 +42,10 @@ from p2p_llm_tunnel_tpu.models.transformer import (
     prefill_into_cache,
 )
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
-from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.metrics import (
+    derived_retry_after_s,
+    global_metrics,
+)
 from p2p_llm_tunnel_tpu.utils.tracing import (
     TraceContext,
     global_tracer,
@@ -58,6 +63,12 @@ _CRASHED = object()
 #: the slot/queue entry; generate() raises DeadlineExceeded so the response
 #: layer can emit a typed timeout instead of a silently truncated stream.
 _TIMED_OUT = object()
+
+#: Queue sentinel for a tenant-fair displacement: the scheduler evicted
+#: this queued request in an under-share tenant's favor; generate() raises
+#: TenantOverLimit so the response layer emits the typed
+#: ``tenant_overlimit`` error instead of a silently truncated stream.
+_SHED = object()
 
 
 class DeadlineExceeded(Exception):
@@ -218,6 +229,17 @@ class EngineConfig:
     # Fixed per-iteration prefill token budget under mux; 0 = adaptive
     # (the MuxController).  The A/B lever for interference experiments.
     mux_budget_tokens: int = 0
+    # Tenant-fair admission (ISSUE 7): weighted-fair ordering across
+    # tenants (stride scheduling, FIFO within a tenant) plus per-tenant
+    # waiting-queue share caps under max_waiting — one hot API key is shed
+    # (429 tenant_overlimit) before it can starve the herd.  ON by
+    # default: with zero or one tenant present it degenerates exactly to
+    # the historical FIFO, so untenanted deployments pay nothing.
+    fair_admission: bool = True
+    # Fairness weight spec "name=weight,name=weight" (unlisted tenants
+    # weigh 1.0): a premium tenant at weight 4 gets 4x the contended queue
+    # share and 4x the admission stride of a default tenant.
+    tenant_weights: str = ""
 
 
 @dataclass
@@ -418,7 +440,11 @@ class InferenceEngine:
             # tp shards the kv-head axis; the slot axis stays whole (the
             # engine's dp axis is 1 — replica routing is a layer above).
             self.kv_cache = shard_kv_cache(self.kv_cache, self.mesh)
-        self.scheduler = Scheduler(b, s, max_waiting=self.ecfg.max_waiting)
+        self.scheduler = Scheduler(
+            b, s, max_waiting=self.ecfg.max_waiting,
+            tenant_weights=parse_tenant_weights(self.ecfg.tenant_weights),
+            fair=self.ecfg.fair_admission,
+        )
 
         if self.ecfg.prefill_chunk > 0 and self.ecfg.sp > 1:
             # Same scope limit as the prefix cache below: the chunk-prefill
@@ -1335,12 +1361,47 @@ class InferenceEngine:
 
     # -- public API -------------------------------------------------------
 
-    def overloaded(self, n: int = 1) -> bool:
-        """Would submitting ``n`` more requests overflow the bounded
-        waiting queue?  Always False with max_waiting=0 (unbounded).
-        Callers use this to shed BEFORE committing to a streaming 200."""
+    def admission_check(self, n: int = 1, tenant: str = "") -> Optional[str]:
+        """Pre-flight admission verdict for ``n`` submissions by ``tenant``:
+        None (admit), ``"busy"`` (global queue would overflow), or
+        ``"tenant_overlimit"`` (the tenant is over its fair share of a
+        contended queue).  The typed-error code IS the return value, so the
+        API layer can shed before any streaming 200 with the same
+        vocabulary the scheduler raises mid-stream."""
         mw = self.ecfg.max_waiting
-        return mw > 0 and self.scheduler.queue_depth + n > mw
+        if mw <= 0:
+            return None
+        sched = self.scheduler
+        # The anonymous "" bucket goes through the SAME arithmetic as any
+        # named tenant — the scheduler treats it as one (submit() applies
+        # its fair cap and lets it displace); skipping it here would let
+        # untagged traffic pass pre-flight only to be shed mid-stream.
+        cap = sched.fair_cap(tenant)
+        if cap is not None and sched.tenant_queue_depth(tenant) + n > cap:
+            return "tenant_overlimit"
+        if sched.queue_depth + n > mw:
+            # A tenant under its share may displace a monopolist instead
+            # of bouncing: only report busy when displacement cannot make
+            # enough room for ALL n submissions (displaceable() shares
+            # _displace's cap arithmetic — including counting the
+            # submitter as active — so this verdict and the submit
+            # outcome can never disagree).
+            need = sched.queue_depth + n - mw
+            if (self.ecfg.fair_admission
+                    and sched.displaceable(tenant) >= need):
+                return None
+            return "busy"
+        return None
+
+    def retry_after_s(self) -> float:
+        """Advisory Retry-After for a 429, derived from the live queue:
+        current depth over the recent admission drain rate (shared
+        formula: utils.metrics.derived_retry_after_s).  Published as the
+        ``engine_retry_after_s`` gauge on every computation."""
+        return derived_retry_after_s(
+            self.scheduler.queue_depth, "engine_admissions_total",
+            "engine_retry_after_s",
+        )
 
     async def embed(self, prompts: List[List[int]]) -> np.ndarray:
         """Mean-pooled embeddings for a batch of token-id prompts.
@@ -1394,12 +1455,19 @@ class InferenceEngine:
         logit_bias: Tuple[Tuple[int, float], ...] = (),
         deadline: Optional[float] = None,
         trace: Optional[TraceContext] = None,
+        tenant: str = "",
     ) -> AsyncIterator[TokenEvent]:
         """Submit one request; yields TokenEvents as the batch decodes.
 
         ``deadline`` is an absolute ``time.monotonic()`` instant: once
         passed, the scheduler evicts the request wherever it is (waiting
         queue or decode slot) and this generator raises DeadlineExceeded.
+
+        ``tenant`` is the fair-admission identity (x-tunnel-tenant): it
+        drives weighted-fair ordering, per-tenant queue-share caps
+        (TenantOverLimit on overflow/displacement), and the per-tenant
+        in-flight/token-rate accounting in utils.metrics.  "" opts out of
+        all of it.
 
         ``trace`` is the propagated trace context (utils/tracing): when
         recording is on and the trace is sampled, the request's lifecycle
@@ -1441,6 +1509,7 @@ class InferenceEngine:
             echo_logprobs=echo_logprobs,
             stop_ids=tuple(stop_ids),
             deadline=deadline,
+            tenant=tenant,
         )
         state = _ActiveRequest(
             queue=asyncio.Queue(), decoder=StreamDecoder(self.tokenizer),
@@ -1450,7 +1519,24 @@ class InferenceEngine:
             state.trace = trace
             state.trace_span = new_span_id()
         self._requests[rid] = state
-        self.scheduler.submit(req)
+        try:
+            displaced = self.scheduler.submit(req)
+        except TenantOverLimit:
+            self._requests.pop(rid, None)
+            global_metrics.tenant_shed(tenant)
+            raise
+        except Exception:
+            self._requests.pop(rid, None)
+            raise
+        for dreq in displaced:
+            # An under-share tenant claimed queue space back from a
+            # monopolist: wake the displaced consumer with the typed shed
+            # (its scheduler entry is already gone).
+            d_state = self._requests.get(dreq.request_id)
+            if d_state is not None:
+                d_state.queue.put_nowait(_SHED)
+            global_metrics.tenant_shed(dreq.tenant)
+        global_metrics.tenant_begin(tenant)
         global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
         self._wake.set()
 
@@ -1464,6 +1550,12 @@ class InferenceEngine:
                     state.finish = "timeout"
                     raise DeadlineExceeded(
                         "deadline exceeded; request evicted"
+                    )
+                if event is _SHED:
+                    state.finish = "shed"
+                    raise TenantOverLimit(
+                        "displaced by tenant-fair admission; retry after "
+                        "backing off"
                     )
                 if event is None:
                     return
@@ -1480,6 +1572,7 @@ class InferenceEngine:
         finally:
             self._requests.pop(rid, None)
             self.scheduler.cancel(rid)
+            global_metrics.tenant_end(tenant)
             if state.trace is not None:
                 # Exactly one engine.request span per generation — this
                 # finally runs once on every exit path (finish, deadline,
@@ -1502,13 +1595,17 @@ class InferenceEngine:
                     "engine.stream_end", trace_id=state.trace.trace_id,
                     parent_id=state.trace_span, track="engine", t=t_end,
                 )
+                attrs = {"rid": rid, "finish": state.finish or "cancelled"}
+                if tenant:
+                    # traceview groups its TTFT summary by this attribute
+                    # when any request in the capture carries one.
+                    attrs["tenant"] = tenant
                 global_tracer.add_span(
                     "engine.request", trace_id=state.trace.trace_id,
                     span_id=state.trace_span,
                     parent_id=state.trace.span_id or None, track="engine",
                     t0=state.t_submit, t1=t_end,
-                    attrs={"rid": rid,
-                           "finish": state.finish or "cancelled"},
+                    attrs=attrs,
                 )
 
     # -- engine loop ------------------------------------------------------
@@ -1554,6 +1651,12 @@ class InferenceEngine:
                     t=state.first_token_at,
                 )
         global_metrics.inc("engine_tokens_total")
+        if run.request.tenant:
+            # Per-tenant consumption: the /metrics-visible rate AND the
+            # stride charge-back that costs a hot tenant future queue
+            # priority (Scheduler.charge_tokens).
+            global_metrics.tenant_tokens(run.request.tenant)
+            self.scheduler.charge_tokens(run.request.tenant, 1)
         is_stop = token_id in run.request.stop_ids
         finish = None
         if evicted:
@@ -2296,6 +2399,7 @@ class InferenceEngine:
         TTFT decomposition (engine_queue_wait_ms + engine_prefill_exec_ms
         ≈ engine_ttft_ms, ISSUE 5 observability)."""
         now = time.monotonic()
+        global_metrics.inc("engine_admissions_total", len(admitted))
         for run in admitted:
             st = self._requests.get(run.request.request_id)
             if st is not None and st.t_admitted is None:

@@ -11,7 +11,31 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """Parse a ``name=weight,name=weight`` fairness spec (EngineConfig.
+    tenant_weights / --tenant-weights).  Unlisted tenants weigh 1.0.
+    Raises ValueError on malformed entries or non-positive weights — a
+    fairness policy that silently half-parses is worse than none.
+    """
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(f"tenant weight must be name=weight, got {part!r}")
+        try:
+            w = float(val)
+        except ValueError:
+            raise ValueError(f"bad weight for tenant {name!r}: {val!r}")
+        if w <= 0:
+            raise ValueError(f"tenant {name!r} weight must be > 0, got {w}")
+        out[name.strip()] = w
+    return out
 
 
 class MuxController:
@@ -120,6 +144,22 @@ class QueueFull(Exception):
     tunnel_code = "busy"
 
 
+class TenantOverLimit(QueueFull):
+    """One tenant exceeded its weighted-fair share of a CONTENDED ingress.
+
+    Raised instead of plain :class:`QueueFull` when the waiting queue has
+    room in aggregate but the submitting tenant is already holding its fair
+    share of it while other tenants are active — the hot tenant is shed
+    BEFORE it can displace anyone else (ISSUE 7).  Also raised by a
+    displaced request's consumer: when a full queue is monopolized by an
+    over-share tenant, an under-share tenant's submit evicts the
+    monopolist's newest queued request rather than bouncing the victim.
+    """
+
+    #: Typed tunnel-error code (protocol.frames.TunnelMessage.typed_error).
+    tunnel_code = "tenant_overlimit"
+
+
 @dataclass
 class GenRequest:
     """One generation request as admitted to the batch."""
@@ -148,6 +188,11 @@ class GenRequest:
     # request — queued OR running — once now passes it, so a slow client
     # can never pin a decode slot forever.  None = no deadline.
     deadline: Optional[float] = None
+    # Tenant identity (ISSUE 7): the x-tunnel-tenant value stamped at the
+    # proxy (API key, falling back to room/connection).  "" = untenanted —
+    # all such requests share one anonymous bucket, which degenerates to
+    # the pre-tenant FIFO behavior when nothing else is tagged.
+    tenant: str = ""
 
     def __post_init__(self) -> None:
         if not self.prompt_ids:
@@ -171,48 +216,367 @@ class RunningSlot:
 
 
 class Scheduler:
-    """Fixed-slot admission/eviction; FIFO among waiting requests.
+    """Fixed-slot admission/eviction; weighted-fair among tenants, FIFO
+    within each tenant.
 
     ``max_waiting`` bounds the waiting queue (0 = unbounded): under overload
     submit() raises QueueFull instead of buffering work the engine cannot
     finish — the goodput-over-throughput shedding DistServe/AlignedServe
     argue for (PAPERS.md).
+
+    Tenant fairness (ISSUE 7, ``fair=True``): admission order is stride
+    scheduling over tenants — each tenant carries a monotone *pass* value
+    advanced by ``1/weight`` per admission (plus ``TOKEN_COST/weight`` per
+    decode token the engine charges back via :meth:`charge_tokens`), and
+    admit() always picks the backlogged tenant with the smallest pass.
+    While other tenants are ACTIVE (queued or running), a tenant is
+    additionally held to its weight share on both axes: its *running
+    slots* are capped at its fraction of ``num_slots`` (:meth:`slot_cap` —
+    the latency reservation that keeps an aggressor's admitted streams
+    from saturating the decode batch) and its share of the *waiting
+    queue* is capped at its fraction of ``max_waiting`` — an over-share
+    submitter gets :class:`TenantOverLimit`, and when the queue is
+    already full of an over-share tenant's backlog, an under-share
+    submitter DISPLACES the monopolist's newest queued request (submit()
+    returns the displaced requests so the engine can shed their consumers
+    with the same typed error).  A lone active tenant sees plain FIFO and
+    may use every slot and the whole queue; fairness costs nothing until
+    a second tenant shows up, and a tenant with no work reserves nothing.
+    Pure and deterministic: same submission sequence, same outcome.
     """
 
-    def __init__(self, num_slots: int, max_seq: int, max_waiting: int = 0):
+    #: Pass advanced per decode token charged back by the engine, relative
+    #: to the 1.0 charged per admission: 64 streamed tokens weigh like one
+    #: extra admission, so a tenant holding long ignore_eos streams loses
+    #: queue priority to one issuing short requests even at equal request
+    #: rates.
+    TOKEN_COST = 1.0 / 64.0
+
+    def __init__(self, num_slots: int, max_seq: int, max_waiting: int = 0,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 fair: bool = True):
         if num_slots < 1:
             raise ValueError("need at least one slot")
         self.num_slots = num_slots
         self.max_seq = max_seq
         self.max_waiting = max_waiting
+        self.fair = fair
+        self.tenant_weights: Dict[str, float] = dict(tenant_weights or {})
         self.waiting: Deque[GenRequest] = deque()
         self.slots: List[Optional[RunningSlot]] = [None] * num_slots
+        #: Stride-scheduling state: per-tenant pass value + the global
+        #: virtual time (the pass of the last tenant served), which anchors
+        #: joining tenants so idle time never banks priority.
+        self._pass: Dict[str, float] = {}
+        self._vt = 0.0
+        #: Per-tenant waiting-queue depth, maintained incrementally at every
+        #: queue mutation: admission runs several depth/active-tenant
+        #: queries per arriving request, and at max_waiting=600 x 1k
+        #: clients/s a deque scan per query is the ingress hot path.
+        self._depths: Dict[str, int] = {}
+        #: Distinct tenants currently holding slots, rebuilt lazily
+        #: (``_slots_dirty``) inside charge_tokens: the solo-tenant check
+        #: there runs once per generated token per running slot, and an
+        #: O(num_slots) scan per call put O(slots^2) Python work into
+        #: every decode step.  Scheduler methods that mutate ``slots``
+        #: invalidate it; code that writes ``self.slots[i]`` directly
+        #: (test shorthand) must not charge tokens before the next
+        #: scheduler-driven slot mutation.
+        self._running_tenants: frozenset = frozenset()
+        self._slots_dirty = False
+
+    # -- tenant bookkeeping ------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        return self.tenant_weights.get(tenant, 1.0)
+
+    def _q_append(self, req: GenRequest) -> None:
+        self.waiting.append(req)
+        self._depths[req.tenant] = self._depths.get(req.tenant, 0) + 1
+
+    def _q_forget(self, req: GenRequest) -> None:
+        """Account one request leaving ``waiting`` (already removed)."""
+        d = self._depths.get(req.tenant, 0) - 1
+        if d > 0:
+            self._depths[req.tenant] = d
+        else:
+            self._depths.pop(req.tenant, None)
+
+    def _active_tenants(self, extra: Optional[str] = None) -> List[str]:
+        """Tenants with queued or running work (deduplicated; deterministic
+        order — queued tenants in first-queued order, then running)."""
+        seen: List[str] = list(self._depths)
+        for run in self.slots:
+            if run is not None and run.request.tenant not in seen:
+                seen.append(run.request.tenant)
+        if extra is not None and extra not in seen:
+            seen.append(extra)
+        return seen
+
+    def tenant_queue_depth(self, tenant: str) -> int:
+        return self._depths.get(tenant, 0)
+
+    def _share(self, bound: int, tenant: str, total_w: float) -> int:
+        """THE weighted-share formula: ``tenant``'s weight fraction of
+        ``bound`` slots/queue entries over ``total_w``, floored at 1.
+        Single source for fair_cap, _overshoots and slot_cap — the
+        pre-flight 429 verdict (admission_check → fair_cap/displaceable)
+        and the submit outcome agree only while these stay byte-for-byte
+        the same arithmetic."""
+        return max(1, int(bound * self.weight(tenant) / total_w))
+
+    def fair_cap(self, tenant: str) -> Optional[int]:
+        """Max waiting-queue entries ``tenant`` may hold right now, or None
+        when no cap applies (unbounded queue, fairness off, or the tenant
+        is alone — a lone tenant keeps the whole queue, work-conserving).
+        The cap is the tenant's weight fraction of ``max_waiting`` over the
+        currently-active tenants, floored at 1 so a configured tenant can
+        always queue *something*.
+        """
+        if self.max_waiting <= 0 or not self.fair:
+            return None
+        active = self._active_tenants(extra=tenant)
+        if len(active) <= 1:
+            return None
+        total_w = sum(self.weight(t) for t in active)
+        return self._share(self.max_waiting, tenant, total_w)
+
+    def charge_tokens(self, tenant: str, n: int) -> None:
+        """Charge ``n`` decode tokens against ``tenant``'s stride pass —
+        the token-rate half of fair admission: sustained decode consumption
+        costs future queue priority exactly like extra admissions would."""
+        if not self.fair or n <= 0:
+            return
+        self._pass[tenant] = (
+            self._pass.get(tenant, self._vt)
+            + n * self.TOKEN_COST / self.weight(tenant)
+        )
+        # A LONE tenant's consumption defines the virtual time.  admit()
+        # takes the single-tenant FIFO path (never advancing _vt), so
+        # without this a solo tenant's pass outruns _vt without bound and
+        # — because joiners anchor AT _vt — a second tenant arriving after
+        # an hour of solo decode would win every admission tie for
+        # arbitrarily long.  Fairness must cost nothing until a second
+        # tenant actually shows up, and no debt may outlive the solo era.
+        if self._slots_dirty:
+            self._running_tenants = frozenset(
+                run.request.tenant for run in self.slots if run is not None
+            )
+            self._slots_dirty = False
+        if (all(t == tenant for t in self._depths)
+                and self._running_tenants <= {tenant}):
+            self._vt = max(self._vt, self._pass[tenant])
+        if len(self._pass) > 1024:
+            # Cardinality bound: forget the most-caught-up tenants that
+            # have no current work (their pass would re-anchor to the
+            # virtual time on return anyway).
+            active = set(self._active_tenants())
+            for t in sorted(self._pass, key=self._pass.get):
+                if len(self._pass) <= 512:
+                    break
+                if t not in active:
+                    del self._pass[t]
+
+    def _anchor_if_idle(self, tenant: str) -> None:
+        """Stride join rule, applied at the idle→active edge ONLY: a
+        tenant with no queued or running work anchors its pass at the
+        current virtual time, so idle time banks no priority.  A tenant
+        that stayed backlogged keeps its pass untouched — re-anchoring
+        every admit() round would forgive a hot tenant's token-charge
+        debt (and wipe a slot-capped victim's earned priority) the
+        moment the virtual time overtook it."""
+        if not self.fair:
+            return
+        if self._depths.get(tenant):
+            return
+        for run in self.slots:
+            if run is not None and run.request.tenant == tenant:
+                return
+        self._pass[tenant] = max(self._pass.get(tenant, self._vt), self._vt)
 
     # -- admission --------------------------------------------------------
 
-    def submit(self, req: GenRequest) -> None:
+    def submit(self, req: GenRequest) -> List[GenRequest]:
+        """Queue one request; returns requests DISPLACED to make room.
+
+        Raises QueueFull when the bounded queue is full of in-share work,
+        TenantOverLimit when the submitting tenant is over its own share of
+        a contended queue.  The returned (usually empty) list holds queued
+        requests evicted in the submitter's favor — an under-share tenant
+        claiming queue space back from a monopolist; the engine sheds their
+        consumers with the same ``tenant_overlimit`` semantics.
+        """
         if len(req.prompt_ids) >= self.max_seq:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens does not fit max_seq={self.max_seq}"
             )
-        if self.max_waiting > 0 and len(self.waiting) >= self.max_waiting:
-            raise QueueFull(
-                f"waiting queue full ({len(self.waiting)}/{self.max_waiting})"
+        self._anchor_if_idle(req.tenant)
+        if self.max_waiting <= 0:
+            self._q_append(req)
+            return []
+        cap = self.fair_cap(req.tenant)
+        if cap is not None and self.tenant_queue_depth(req.tenant) >= cap:
+            raise TenantOverLimit(
+                f"tenant {req.tenant!r} holds its fair share of the "
+                f"waiting queue ({cap}/{self.max_waiting})"
             )
-        self.waiting.append(req)
+        if len(self.waiting) >= self.max_waiting:
+            displaced = self._displace(req.tenant)
+            if not displaced:
+                raise QueueFull(
+                    f"waiting queue full ({len(self.waiting)}/{self.max_waiting})"
+                )
+            self._q_append(req)
+            return displaced
+        self._q_append(req)
+        return []
+
+    def _overshoots(self, for_tenant: str) -> Dict[str, int]:
+        """Per-tenant queue overshoot beyond the fair caps — the entries
+        displaceable in ``for_tenant``'s favor (never its own).  Caps are
+        computed with the SUBMITTER counted as active: its very first
+        request must already shrink a monopolist's share, or a full queue
+        of tenant A would bounce tenant B forever."""
+        if not self.fair or self.max_waiting <= 0:
+            return {}
+        active = self._active_tenants(extra=for_tenant)
+        if len(active) <= 1:
+            return {}
+        total_w = sum(self.weight(t) for t in active)
+        overshoot: Dict[str, int] = {}
+        for t, depth in self._depths.items():
+            if t == for_tenant:
+                continue
+            cap = self._share(self.max_waiting, t, total_w)
+            over = depth - cap
+            if over > 0:
+                overshoot[t] = over
+        return overshoot
+
+    def displaceable(self, for_tenant: str) -> int:
+        """How many queued entries could be displaced in ``for_tenant``'s
+        favor right now — the engine's pre-flight twin of :meth:`_displace`
+        (same cap arithmetic, so the 429 verdict and the submit outcome
+        can never disagree)."""
+        return sum(self._overshoots(for_tenant).values())
+
+    def _displace(self, for_tenant: str) -> List[GenRequest]:
+        """Evict the newest queued request of the most-over-share tenant
+        (never ``for_tenant`` itself).  Returns [] when every other tenant
+        is within its share — then the queue is legitimately full."""
+        overshoot = self._overshoots(for_tenant)
+        if not overshoot:
+            return []
+        # Deterministic victim: largest overshoot, tenant name as tiebreak.
+        victim = max(overshoot, key=lambda t: (overshoot[t], t))
+        for i in range(len(self.waiting) - 1, -1, -1):
+            if self.waiting[i].tenant == victim:
+                out = self.waiting[i]
+                del self.waiting[i]
+                self._q_forget(out)
+                return [out]
+        return []
+
+    def slot_cap(self, tenant: str, active: List[str]) -> int:
+        """Max decode slots ``tenant`` may HOLD while the given tenants are
+        active: its weight fraction of ``num_slots``, floored at 1.
+
+        This is the latency half of fairness (the queue cap is the buffer
+        half): queue-order fairness alone cannot protect a victim tenant's
+        TTFT once an aggressor's admitted streams occupy every slot —
+        each stream holds its slot for the full decode, and the batch the
+        victim eventually joins is as large (and as slow, on batch-scaled
+        backends) as the aggressor made it.  Reserving the weighted slot
+        share keeps headroom for every active tenant; a tenant with no
+        work at all is not counted, so truly idle capacity still
+        redistributes.
+        """
+        total_w = sum(self.weight(t) for t in active)
+        return self._share(self.num_slots, tenant, total_w)
 
     def admit(self) -> List[RunningSlot]:
-        """Move waiting requests into free slots (FIFO). Returns admissions."""
+        """Move waiting requests into free slots. Returns admissions.
+
+        Weighted-fair across tenants (stride order), FIFO within a tenant;
+        with one ACTIVE tenant (queued or running) this IS the historical
+        FIFO admit.  Under contention each tenant's running-slot count is
+        additionally capped at its weight share (:meth:`slot_cap`); a
+        capped tenant's backlog waits even if slots sit free — that
+        headroom is precisely what keeps the other tenants' admission
+        latency independent of the aggressor's backlog.
+        """
         admitted: List[RunningSlot] = []
+        if not self.waiting:
+            return admitted
+        if all(s is not None for s in self.slots):
+            # Full decode batch: nothing can be admitted, so skip the
+            # O(len(waiting)) caps scan below — under sustained overload
+            # (600-deep queue, every slot busy) the engine loop calls
+            # admit() each iteration and this is its hot path.
+            return admitted
+        active = self._active_tenants()
+        fair = self.fair and len(active) > 1
+        caps: Dict[str, int] = {}
+        running: Dict[str, int] = {}
+        if fair:
+            # Pass records were minted at the idle→active edge in submit()
+            # (_anchor_if_idle — the stride join rule); setdefault only
+            # covers a fair-flag flip mid-flight.  Re-anchoring backlogged
+            # tenants here would erase earned priority every round.
+            for req in self.waiting:
+                t = req.tenant
+                if t not in caps:
+                    self._pass.setdefault(t, self._vt)
+                    caps[t] = self.slot_cap(t, active)
+            for run in self.slots:
+                if run is not None:
+                    t = run.request.tenant
+                    running[t] = running.get(t, 0) + 1
         for i in range(self.num_slots):
             if not self.waiting:
                 break
-            if self.slots[i] is None:
+            if self.slots[i] is not None:
+                continue
+            if fair:
+                req = self._pop_fair(caps, running)
+                if req is None:
+                    break  # every backlogged tenant is at its slot share
+                running[req.tenant] = running.get(req.tenant, 0) + 1
+            else:
                 req = self.waiting.popleft()
-                run = RunningSlot(req, i, cache_len=len(req.prompt_ids))
-                self.slots[i] = run
-                admitted.append(run)
+                self._q_forget(req)
+            run = RunningSlot(req, i, cache_len=len(req.prompt_ids))
+            self.slots[i] = run
+            self._slots_dirty = True
+            admitted.append(run)
         return admitted
+
+    def _pop_fair(self, caps: Dict[str, int],
+                  running: Dict[str, int]) -> Optional[GenRequest]:
+        """Pop the head request of the smallest-stride-pass tenant still
+        under its slot share (earliest queue position breaks ties —
+        deterministic, and FIFO within a tenant by construction), or None
+        when every backlogged tenant is at its cap."""
+        best_idx = -1
+        best_key = None
+        seen: set = set()
+        for idx, req in enumerate(self.waiting):
+            if req.tenant in seen:
+                continue  # only each tenant's FIFO head competes
+            seen.add(req.tenant)
+            if running.get(req.tenant, 0) >= caps[req.tenant]:
+                continue
+            key = (self._pass.get(req.tenant, self._vt), idx)
+            if best_key is None or key < best_key:
+                best_key, best_idx = key, idx
+        if best_idx < 0:
+            return None
+        req = self.waiting[best_idx]
+        del self.waiting[best_idx]
+        self._q_forget(req)
+        self._vt = self._pass.get(req.tenant, self._vt)
+        self._pass[req.tenant] = self._vt + 1.0 / self.weight(req.tenant)
+        return req
 
     # -- stepping ---------------------------------------------------------
 
@@ -227,6 +591,7 @@ class Scheduler:
         run.cache_len += 1
         if run.done or run.cache_len >= self.max_seq:
             self.slots[slot] = None
+            self._slots_dirty = True
         return run
 
     def cancel(self, request_id: int) -> bool:
@@ -234,10 +599,12 @@ class Scheduler:
         for i, req in enumerate(self.waiting):
             if req.request_id == request_id:
                 del self.waiting[i]
+                self._q_forget(req)
                 return True
         for i, run in enumerate(self.slots):
             if run is not None and run.request.request_id == request_id:
                 self.slots[i] = None
+                self._slots_dirty = True
                 return True
         return False
 
@@ -259,12 +626,15 @@ class Scheduler:
             else:
                 keep.append(req)
         self.waiting = keep
+        for _, req in expired:
+            self._q_forget(req)
         for i, run in enumerate(self.slots):
             if run is None:
                 continue
             d = run.request.deadline
             if d is not None and now >= d:
                 self.slots[i] = None
+                self._slots_dirty = True
                 expired.append((i, run.request))
         return expired
 

@@ -22,6 +22,7 @@ memory for a frame that should never exist on the wire.
 from __future__ import annotations
 
 import enum
+import hashlib
 import json
 import struct
 from dataclasses import dataclass, field
@@ -62,7 +63,13 @@ CREDIT_BATCH = 64 * 1024
 #:   busy     — shed by admission control (scheduler queue or max_inflight)
 #:   draining — server is draining; retry against another peer
 #:   upstream — the backend failed mid-stream
-ERROR_CODES = frozenset({"timeout", "busy", "draining", "upstream"})
+#:   tenant_overlimit — shed by tenant-fair admission: THIS tenant is over
+#:     its weighted share of a contended ingress (other tenants are not);
+#:     backing off helps, switching API keys is the attack the code exists
+#:     to make visible
+ERROR_CODES = frozenset(
+    {"timeout", "busy", "draining", "upstream", "tenant_overlimit"}
+)
 
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE
 
@@ -370,6 +377,76 @@ def parse_deadline_ms(headers: Dict[str, str]) -> "Optional[float]":
                 return None
             return ms if ms > 0 else None
     return None
+
+
+#: Tenant identity header (ISSUE 7): stamped at the proxy ingress from an
+#: explicit ``x-tunnel-tenant`` or the fingerprint of the client's API key
+#: (``x-api-key``), falling back to the room/connection name — carried in
+#: RequestHeaders.headers across the tunnel so serve + the engine account
+#: and fair-admit per tenant.  A wire convention like the deadline header,
+#: so it lives with the frame codec.
+TENANT_HEADER = "x-tunnel-tenant"
+#: Client-facing API-key header the proxy maps to a tenant identity.
+API_KEY_HEADER = "x-api-key"
+#: Longest tenant identity carried on the wire; longer values truncate so
+#: an adversarial header cannot bloat per-tenant accounting keys.
+MAX_TENANT_LEN = 64
+
+#: Response header carrying a typed tunnel-error code alongside an HTTP
+#: error body (e.g. a 429 from the engine API): the serve loop pops it
+#: before relaying and follows RES_END with the matching typed ERROR frame,
+#: so protocol-aware peers get the same dispatchable code whether the shed
+#: happened at the tunnel layer or inside the backend.
+ERROR_CODE_HEADER = "x-tunnel-error-code"
+
+
+def tenant_fingerprint(api_key: str) -> str:
+    """Stable accounting label for an API key: ``key-`` + 12 hex chars of
+    its SHA-256.  The tenant identity is exported on unauthenticated
+    surfaces (/metrics labels, /healthz, trace attrs), so the credential
+    itself must never BE the identity — the fingerprint keeps same-key
+    requests in one bucket without leaking the secret to any scraper."""
+    return "key-" + hashlib.sha256(api_key.encode()).hexdigest()[:12]
+
+
+def parse_tenant(headers: Dict[str, str], fallback: str = "",
+                 trust_label: bool = True) -> str:
+    """The request's tenant identity, or ``fallback`` when untagged.
+
+    ``x-tunnel-tenant`` (the canonical tunnel header, an operator-chosen
+    label, used verbatim) wins over ``x-api-key`` (a CREDENTIAL — mapped
+    through :func:`tenant_fingerprint`, never used raw).  Values are
+    stripped and truncated to MAX_TENANT_LEN; a present-but-empty header
+    means "untagged", never an empty-string tenant key.
+
+    ``trust_label=False`` ignores the explicit label entirely — the
+    public-ingress posture: a client minting a fresh x-tunnel-tenant per
+    request would otherwise sidestep its own fair-share cap AND crush
+    every legitimate tenant's share toward the floor of 1.  Inside the
+    tunnel the header is proxy-stamped and trusted (the default); at the
+    proxy's HTTP listener it is honored only behind an operator opt-in
+    (``--trust-tenant-header``, for deployments where a trusted edge
+    stamps it), so minting identities requires distinct API keys.
+
+    CAVEAT: nothing in this stack VALIDATES API keys — the fingerprint
+    makes same-key traffic accountable, it does not authenticate.  At a
+    truly public listener an attacker can still mint identities by
+    varying x-api-key; the per-tenant metric registry is bounded
+    (TENANT_CAP + ~other overflow) but fair-share caps dilute as the
+    active-tenant set grows.  Fairness guarantees assume the edge in
+    front of this proxy rejects unknown credentials (README "Operating
+    at scale"); authenticated key validation is a ROADMAP follow-up.
+    """
+    explicit = api_key = ""
+    for k, v in headers.items():
+        lk = k.lower()
+        if lk == TENANT_HEADER:
+            if trust_label:
+                explicit = v.strip()
+        elif lk == API_KEY_HEADER:
+            api_key = v.strip()
+    out = explicit or (tenant_fingerprint(api_key) if api_key else "") or fallback
+    return out[:MAX_TENANT_LEN]
 
 
 #: Optional trace-context header (``<trace_id>/<parent_span_id>``): minted

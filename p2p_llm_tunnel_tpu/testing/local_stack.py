@@ -1,0 +1,149 @@
+"""Self-contained serve+proxy stack over loopback, runnable as a process.
+
+The server half of the out-of-process ingress load test (ISSUE 7): one
+process hosts the REAL serving path — tiny-model CPU engine → EngineAPI →
+run_serve ⇄ loopback tunnel ⇄ run_proxy → HTTP listener — while
+``scripts/loadgen.py`` hammers the listener from a separate process, so
+client-side parsing never shares an interpreter (or a GIL) with the stack
+under test.  This is the same topology bench.py builds in-process, minus
+the bench harness and plus a parseable readiness line:
+
+    LOADGEN_STACK_PORT=<port>
+
+printed on stdout once the engine is warm and the listener is accepting.
+
+Usage (normally spawned by ``scripts/loadgen.py --spawn`` / ``make
+loadgen``):
+
+    JAX_PLATFORMS=cpu python -m p2p_llm_tunnel_tpu.testing.local_stack \
+        --port 0 --slots 32 --max-seq 256 --max-waiting 600
+
+Runs until SIGTERM/SIGINT.  TUNNEL_CHAOS wraps the loopback tunnel like
+any other transport, so the ingress herd can run under seeded faults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+# CPU by default: this is a load harness, not a chip benchmark.  Mirrors
+# tests/conftest.py — the env var must be set before jax imports, and the
+# config update wins over PJRT plugins that force-register other backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from p2p_llm_tunnel_tpu.endpoints.proxy import run_proxy  # noqa: E402
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve  # noqa: E402
+from p2p_llm_tunnel_tpu.engine.api import engine_backend  # noqa: E402
+from p2p_llm_tunnel_tpu.engine.engine import (  # noqa: E402
+    EngineConfig,
+    InferenceEngine,
+)
+from p2p_llm_tunnel_tpu.transport.chaos import maybe_chaos  # noqa: E402
+from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair  # noqa: E402
+from p2p_llm_tunnel_tpu.utils.logging import get_logger, init_logging  # noqa: E402
+
+log = get_logger(__name__)
+
+#: Readiness line prefix loadgen greps for.
+READY_PREFIX = "LOADGEN_STACK_PORT="
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="local_stack",
+        description="loopback serve+proxy stack for out-of-process load "
+                    "tests",
+    )
+    ap.add_argument("--port", type=int, default=0,
+                    help="HTTP listen port (0 = ephemeral, reported on "
+                         "stdout)")
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--decode-steps", type=int, default=8)
+    ap.add_argument("--max-waiting", type=int, default=600,
+                    help="engine admission bound (the fairness cap base)")
+    ap.add_argument("--max-inflight", type=int, default=4096,
+                    help="serve-layer in-flight bound (sized above the "
+                         "herd by default so sheds come from the engine's "
+                         "tenant-aware admission)")
+    ap.add_argument("--tenant-weights", default=os.environ.get(
+        "TUNNEL_TENANT_WEIGHTS", ""))
+    ap.add_argument("--no-fair-admission", action="store_true",
+                    help="disable tenant-fair admission (the A/B lever "
+                         "for the aggressor experiment)")
+    return ap
+
+
+async def amain(args) -> None:
+    engine = InferenceEngine(engine_cfg=EngineConfig(
+        model=args.model,
+        num_slots=args.slots,
+        max_seq=args.max_seq,
+        decode_steps=args.decode_steps,
+        max_waiting=args.max_waiting,
+        fair_admission=not args.no_fair_admission,
+        tenant_weights=args.tenant_weights,
+        mux=True,
+        watchdog_budget_s=120.0,
+    ))
+    await engine.start()
+    await engine.warmup()
+
+    serve_ch, proxy_ch = loopback_pair()
+    serve_ch = maybe_chaos(serve_ch)
+    proxy_ch = maybe_chaos(proxy_ch)
+    serve_task = asyncio.create_task(run_serve(
+        serve_ch, backend=engine_backend(engine, args.model),
+        max_inflight=args.max_inflight,
+    ))
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    proxy_task = asyncio.create_task(run_proxy(
+        proxy_ch, "127.0.0.1", args.port, ready=ready,
+        tenant_fallback="local",
+        # loadgen IS the trusted edge here: it stamps x-tunnel-tenant so
+        # server-side series match its --tenant spec names.  A public
+        # proxy keeps the default (off) — see --trust-tenant-header.
+        trust_tenant_header=True,
+    ))
+    try:
+        # run_proxy resolves ``ready`` only once its listener is accepting;
+        # a startup failure (port already bound) stores the exception in
+        # proxy_task instead, so waiting on ``ready`` alone would hang this
+        # process forever with the bind error swallowed.
+        await asyncio.wait({ready, proxy_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if not ready.done():
+            proxy_task.result()  # raises the proxy's startup error
+            raise RuntimeError("proxy exited before reporting readiness")
+        port = ready.result()
+        # The contract line loadgen --spawn waits for; everything else this
+        # process prints goes to stderr via logging.
+        print(f"{READY_PREFIX}{port}", flush=True)
+        await asyncio.gather(serve_task, proxy_task)
+    finally:
+        serve_task.cancel()
+        proxy_task.cancel()
+        await asyncio.gather(serve_task, proxy_task, return_exceptions=True)
+        await engine.stop()
+
+
+def main(argv=None) -> int:
+    init_logging()
+    args = build_parser().parse_args(argv)
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,7 +46,7 @@ class PyArq:
 
     def __init__(self, cwnd_cap: float = 512.0):
         # in-flight, in send (== seq) order: [seq, sent_at, tries]
-        self._inflight: Deque[list] = deque()
+        self._inflight: Deque[list] = deque()  # tunnelcheck: disable=TC10  bounded by the congestion window: can_send() refuses past cwnd (<= cwnd_cap), so at most cwnd entries are ever in flight
         self._srtt: Optional[float] = None
         self._rttvar = 0.0
         self._rto = RTO_MAX / 2

@@ -30,7 +30,7 @@ class Channel:
     def __init__(self) -> None:
         self.connected = asyncio.Event()
         self.disconnected = asyncio.Event()
-        self._rx: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        self._rx: asyncio.Queue[Optional[bytes]] = asyncio.Queue()  # tunnelcheck: disable=TC10  recv-side demux: both endpoint loops recv() every iteration, and what a PEER can have in flight is bounded upstream (ARQ cwnd on the datagram plane, FLOW credit per response stream); a maxsize here would have to drop frames on overflow, which the loss-handling layers above would misread as network loss
         self._closed = False
 
     # -- sending ----------------------------------------------------------

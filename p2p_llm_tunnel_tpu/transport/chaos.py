@@ -23,6 +23,10 @@ Spec grammar (comma-separated ``key=value``):
 - ``corrupt=P``     — flip one byte of the payload with probability P
 - ``stall=P:SECS``  — delay delivery SECS seconds with probability P
 - ``partition=N:K`` — after N messages, drop the next K outright
+- ``bw=BYTES``      — bandwidth cap: pace sends to BYTES per second (the
+                      slow-reader/bandwidth-cap fault of ISSUE 7: a WAN
+                      client draining at modem speed; exercises FLOW-credit
+                      backpressure without losing a single frame)
 - ``seed=N``        — RNG seed for the schedule (default 0)
 
 Faults apply on the SEND side only; ``recv``/lifecycle delegate to the
@@ -62,6 +66,11 @@ class ChaosSpec:
     stall_s: float = 0.0
     partition_after: int = 0  # messages before the partition opens (0 = off)
     partition_len: int = 0  # messages dropped while partitioned
+    #: Bandwidth cap in bytes/second (0 = off).  Deterministic like
+    #: partition — every send pays len(data)/bw of pacing delay, no RNG
+    #: draw — so the schedule part of the determinism contract holds (the
+    #: DELAY is wall-clock, like stall durations).
+    bw_bytes_per_s: float = 0.0
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSpec":
@@ -88,6 +97,12 @@ class ChaosSpec:
                     after, _, length = val.partition(":")
                     kw["partition_after"] = int(after)
                     kw["partition_len"] = int(length) if length else 1
+                elif key == "bw":
+                    kw["bw_bytes_per_s"] = float(val)
+                    if kw["bw_bytes_per_s"] <= 0:
+                        raise ChaosSpecError(
+                            f"bw must be > 0 bytes/s, got {val!r}"
+                        )
                 else:
                     raise ChaosSpecError(f"unknown chaos key {key!r}")
             except (TypeError, ValueError) as e:
@@ -122,6 +137,10 @@ class ChaosChannel(Channel):
         self._rng = random.Random(spec.seed)
         self._sent = 0
         self._held: Optional[bytes] = None  # reorder buffer (one message)
+        #: Bandwidth-cap pacing horizon: the monotonic instant the link is
+        #: next free.  Cumulative, so burst sends pay the full serialized
+        #: transfer time rather than each waiting only its own share.
+        self._bw_free_at = 0.0
         self.faults: List[Tuple[int, str]] = []
 
     # -- fault schedule ----------------------------------------------------
@@ -158,6 +177,20 @@ class ChaosChannel(Channel):
         if spec.stall_p and r_stall < spec.stall_p:
             self.faults.append((idx, "stall"))
             await asyncio.sleep(spec.stall_s)
+        if spec.bw_bytes_per_s > 0:
+            # Slow-reader/bandwidth-cap fault (ISSUE 7): pace every
+            # surviving message through a link that serializes at bw
+            # bytes/s.  The fault RECORD is a pure function of the send
+            # sequence (every paced message logs, whether or not it had to
+            # wait this time) so the determinism oracle holds; the pacing
+            # itself is wall-clock, like stall durations.
+            self.faults.append((idx, "bw"))
+            now = asyncio.get_running_loop().time()
+            start = max(now, self._bw_free_at)
+            self._bw_free_at = start + len(data) / spec.bw_bytes_per_s
+            wait = self._bw_free_at - now
+            if wait > 0:
+                await asyncio.sleep(wait)
         if spec.reorder and r_reorder < spec.reorder and self._held is None:
             # Hold this message; it rides out behind the NEXT send.
             self.faults.append((idx, "reorder"))

@@ -79,6 +79,45 @@ METRICS_CATALOG: Dict[str, str] = {
     "transport_in_flight": "unacked ARQ packets (gauge)",
     "transport_srtt_ms": "smoothed RTT of the ARQ path (gauge, ms)",
     "transport_retransmits_total": "ARQ retransmissions (counter)",
+    # -- per-tenant ingress accounting (ISSUE 7) --------------------------
+    # The tenant_* names render as LABELED series ({tenant="..."}) in the
+    # Prometheus exposition and as the /healthz "tenants" section; they are
+    # written through the registry's tenant_* methods, never inc/set_gauge.
+    "tenant_in_flight": (
+        "concurrently generating requests per tenant (gauge, labeled "
+        "{tenant})"
+    ),
+    "tenant_requests_total": (
+        "generation requests begun per tenant (counter, labeled {tenant})"
+    ),
+    "tenant_tokens_total": (
+        "decode tokens emitted per tenant (counter, labeled {tenant})"
+    ),
+    "tenant_tokens_per_s": (
+        "sliding-window decode token rate per tenant (gauge, labeled "
+        "{tenant}; the consumption signal behind weighted-fair admission)"
+    ),
+    "tenant_sheds_total": (
+        "requests shed by tenant-fair admission per tenant (counter, "
+        "labeled {tenant})"
+    ),
+    "engine_tenant_sheds_total": (
+        "requests shed by tenant-fair admission, all tenants (counter; "
+        "per-tenant split in the tenant_sheds_total labeled series)"
+    ),
+    "engine_admissions_total": (
+        "requests admitted into decode slots (counter; the drain-rate "
+        "numerator behind the derived Retry-After)"
+    ),
+    "engine_retry_after_s": (
+        "advisory Retry-After the engine API last attached to a 429 "
+        "(gauge, s; queue depth / admission drain rate, clamped to "
+        "[1, 60])"
+    ),
+    "serve_retry_after_s": (
+        "advisory Retry-After the serve loop last attached to a 429 "
+        "(gauge, s; in-flight count / dispatch rate, clamped to [1, 60])"
+    ),
     # -- prefix pool (ISSUE 6: /healthz memory accounting) ----------------
     "engine_prefix_pool_blocks_used": (
         "prefix-cache pool blocks holding cached prompt KV (gauge; "
@@ -112,6 +151,33 @@ def nearest_rank(values: List[float], p: float) -> float:
     xs = sorted(values)
     idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
     return xs[idx]
+
+
+#: Ceiling on distinct tenants the registry tracks.  At the cap, a new
+#: tenant evicts the least-recently-active idle one; if every tracked
+#: tenant is mid-flight, overflow lumps into the "~other" bucket — per-key
+#: accounting must never become an unbounded-memory vector for an
+#: adversary minting API keys.
+TENANT_CAP = 512
+#: Aggregation bucket for tenants beyond TENANT_CAP.
+TENANT_OVERFLOW = "~other"
+
+
+class _TenantStats:
+    """One tenant's ingress accounting (mutated under the registry lock)."""
+
+    __slots__ = ("in_flight", "requests", "sheds", "tokens", "samples",
+                 "last")
+
+    def __init__(self) -> None:
+        self.in_flight = 0
+        self.requests = 0.0
+        self.sheds = 0.0
+        self.tokens = 0.0
+        #: (time, cumulative tokens) samples taken at read time — the
+        #: same sliding-window scheme as Metrics.rate().
+        self.samples: Deque[Tuple[float, float]] = deque()
+        self.last = 0.0
 
 
 class _Percentiles:
@@ -181,6 +247,8 @@ class Metrics:
         #: Per-counter (time, value) samples taken at rate() reads — the
         #: sliding-window rate state (see rate()).
         self._rate_hist: Dict[str, Deque[Tuple[float, float]]] = {}
+        #: Per-tenant ingress accounting (ISSUE 7), bounded at TENANT_CAP.
+        self._tenants: Dict[str, _TenantStats] = {}
         self._t0 = time.monotonic()
 
     def inc(self, name: str, amount: float = 1.0) -> None:
@@ -242,6 +310,110 @@ class Metrics:
             hist.append((now, cur))
             return max(0.0, out)
 
+    # -- per-tenant accounting (ISSUE 7) ----------------------------------
+
+    def _tenant(self, tenant: str) -> _TenantStats:
+        """Stats record for ``tenant`` (lock held by the caller)."""
+        st = self._tenants.get(tenant)
+        if st is None:
+            if len(self._tenants) >= TENANT_CAP:
+                idle = [
+                    t for t, s in self._tenants.items()
+                    if s.in_flight == 0 and t != TENANT_OVERFLOW
+                ]
+                if idle:
+                    victim = min(idle, key=lambda t: self._tenants[t].last)
+                    del self._tenants[victim]
+                else:
+                    return self._tenants.setdefault(
+                        TENANT_OVERFLOW, _TenantStats()
+                    )
+            st = self._tenants[tenant] = _TenantStats()
+        st.last = time.monotonic()
+        return st
+
+    def tenant_begin(self, tenant: str) -> None:
+        """One generation request for ``tenant`` entered the engine."""
+        if not tenant:
+            return
+        with self._lock:
+            st = self._tenant(tenant)
+            st.in_flight += 1
+            st.requests += 1
+
+    def tenant_end(self, tenant: str) -> None:
+        """The matching exit for tenant_begin (every finish path).
+
+        Balances against whichever record absorbed the begin: the named
+        record when it holds flight, else the overflow bucket — a begin
+        that lumped into ``~other`` at the cap must not leak a permanent
+        in-flight count there when the end arrives after a slot freed up
+        (tenant_end never CREATES a record; only begin does).
+        """
+        if not tenant:
+            return
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None and st.in_flight > 0:
+                st.in_flight -= 1
+                st.last = time.monotonic()
+                return
+            ov = self._tenants.get(TENANT_OVERFLOW)
+            if ov is not None and ov.in_flight > 0:
+                ov.in_flight -= 1
+                ov.last = time.monotonic()
+
+    def tenant_tokens(self, tenant: str, n: int = 1) -> None:
+        """Charge ``n`` decode tokens to ``tenant`` (the hot path)."""
+        if not tenant:
+            return
+        with self._lock:
+            self._tenant(tenant).tokens += n
+
+    def tenant_shed(self, tenant: str) -> None:
+        """One request shed by tenant-fair admission."""
+        with self._lock:
+            self._counters["engine_tenant_sheds_total"] += 1
+            if tenant:
+                self._tenant(tenant).sheds += 1
+
+    def _tenant_rate(self, st: _TenantStats, now: float,
+                     window_s: float) -> float:
+        """Sliding-window token rate (lock held; same anchor-retention
+        scheme as rate())."""
+        hist = st.samples
+        while len(hist) >= 2 and now - hist[1][0] > window_s:
+            hist.popleft()
+        if hist:
+            t_old, v_old = hist[0]
+            dt = now - t_old
+            out = (st.tokens - v_old) / dt if dt > 0 else 0.0
+        else:
+            dt = now - self._t0
+            out = st.tokens / dt if dt > 0 else 0.0
+        hist.append((now, st.tokens))
+        return max(0.0, out)
+
+    def tenant_snapshot(self, window_s: float = 30.0) -> Dict[str, Dict[str, float]]:
+        """Per-tenant rollup for /healthz and the Prometheus exposition:
+        ``{tenant: {in_flight, requests, tokens, tokens_per_s, sheds}}``.
+        Reading samples the token-rate window, so spaced pollers see
+        current traffic, not lifetime averages."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                t: {
+                    "in_flight": float(st.in_flight),
+                    "requests": st.requests,
+                    "tokens": st.tokens,
+                    "tokens_per_s": round(
+                        self._tenant_rate(st, now, window_s), 3
+                    ),
+                    "sheds": st.sheds,
+                }
+                for t, st in sorted(self._tenants.items())
+            }
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out: Dict[str, float] = dict(self._counters)
@@ -274,7 +446,10 @@ class Metrics:
         PROM_QUANTILES quantiles.  Kind is derived from the catalogue
         entry itself: ``*_total`` = counter, ``(histogram`` in the
         description = summary, everything else = gauge — the same
-        convention the descriptions already follow.
+        convention the descriptions already follow.  The ``tenant_*``
+        names render as LABELED series ({tenant="..."}) from the
+        per-tenant table — one sample per tracked tenant, none when no
+        tenanted traffic has arrived.
         """
         with self._lock:
             counters = dict(self._counters)
@@ -289,10 +464,28 @@ class Metrics:
                 )
                 for name, h in self._hists.items()
             }
+        tenants = self.tenant_snapshot()
+        tenant_field = {
+            "tenant_in_flight": "in_flight",
+            "tenant_requests_total": "requests",
+            "tenant_tokens_total": "tokens",
+            "tenant_tokens_per_s": "tokens_per_s",
+            "tenant_sheds_total": "sheds",
+        }
         lines: List[str] = []
         for name, desc in METRICS_CATALOG.items():
             help_text = " ".join(desc.split())
             lines.append(f"# HELP {name} {help_text}")
+            if name in tenant_field:
+                kind = "counter" if name.endswith("_total") else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
+                for t, row in tenants.items():
+                    label = t.replace("\\", "\\\\").replace('"', '\\"')
+                    lines.append(
+                        f'{name}{{tenant="{label}"}} '
+                        f'{row[tenant_field[name]]:.6g}'
+                    )
+                continue
             if "(histogram" in desc:
                 lines.append(f"# TYPE {name} summary")
                 quantiles, count = hists.get(name, ([], 0))
@@ -313,8 +506,29 @@ class Metrics:
             self._gauges.clear()
             self._hists.clear()
             self._rate_hist.clear()
+            self._tenants.clear()
             self._t0 = time.monotonic()
 
 
 #: Process-wide default registry.
 global_metrics = Metrics()
+
+
+def derived_retry_after_s(backlog: int, rate_name: str, gauge: str) -> float:
+    """THE queue-derived Retry-After advisory (ISSUE 7), shared by the
+    engine (queue depth over admission drain) and the serve loop
+    (in-flight over dispatch rate) so the formula cannot drift between
+    layers: time to turn over ``backlog``+1 units at ``rate_name``'s
+    recent (10 s window) rate, clamped to [1, 60] s.  A stalled server
+    (zero rate, nonzero backlog) reports the cap rather than pretending
+    1 s will help; an idle one reports the floor.  Publishes ``gauge``
+    on every computation so the advisory is scrapeable next to the 429
+    counters."""
+    rate = global_metrics.rate(rate_name, window_s=10.0)
+    if rate > 0:
+        out = (backlog + 1) / rate
+    else:
+        out = 1.0 if backlog == 0 else 60.0
+    out = min(60.0, max(1.0, out))
+    global_metrics.set_gauge(gauge, out)
+    return out

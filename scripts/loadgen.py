@@ -1,0 +1,446 @@
+#!/usr/bin/env python
+"""Out-of-process SSE ingress load generator (ISSUE 7, stdlib-only).
+
+Drives hundreds-to-1000 concurrent ``/v1/chat/completions`` SSE streams
+against a tunnel proxy from a SEPARATE process — client-side HTTP parsing
+must never share an interpreter with the server under test (the same
+reason the reference drives load from curl, scripts/test-tunnel.sh:88-96).
+Unlike scripts/bench_clients.py (bench.py's helper, which imports the
+package), this speaks raw HTTP/1.1 + chunked transfer over asyncio
+sockets, so it also runs against a deployed proxy with nothing installed.
+
+Per-tenant mixes model the hot-tenant-aggressor-vs-victim-herd scenario:
+each ``--tenant name:clients[:requests]`` spec contributes ``clients``
+concurrent clients issuing ``requests`` sequential generations tagged with
+``x-tunnel-tenant: name`` (the explicit label, so server-side series and
+``--tenant-weights`` match the spec names; ``x-api-key`` identities are
+fingerprinted server-side); the report aggregates the same p50/p99/p999
+TTFT/TTFB rows bench.py records, per tenant, plus ok/shed/error/stuck
+counts.
+
+Usage:
+    # against a running proxy
+    python scripts/loadgen.py --port 8000 --tenant herd:500
+
+    # self-contained: spawn the loopback stack in a subprocess first
+    python scripts/loadgen.py --spawn --tenant victim:400 --tenant hot:100:8
+
+Exit code 1 when any stream got stuck (no completion within --timeout,
+or its client task crashed) OR the post-run /healthz leak check finds
+nonzero in-flight/queue/occupancy — the "zero stuck streams or leaked
+slots" acceptance gate is the exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def nearest_rank(values: List[float], p: float) -> Optional[float]:
+    """Nearest-rank percentile — the same estimator utils.metrics uses,
+    re-stated here because this script must not import the package."""
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+# ---------------------------------------------------------------------------
+# minimal HTTP/1.1 client (chunked-aware) over asyncio sockets
+# ---------------------------------------------------------------------------
+
+async def _read_headers(reader) -> Tuple[int, Dict[str, str]]:
+    status_line = await reader.readline()
+    if not status_line:
+        raise ConnectionError("empty response")
+    parts = status_line.decode("latin-1").split(" ", 2)
+    status = int(parts[1])
+    headers: Dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers
+
+
+async def _iter_body(reader, headers):
+    """Yield body chunks for chunked or content-length responses."""
+    if "chunked" in headers.get("transfer-encoding", "").lower():
+        while True:
+            size_line = await reader.readline()
+            size = int(size_line.strip().split(b";")[0], 16)
+            if size == 0:
+                await reader.readline()  # trailing CRLF
+                return
+            data = await reader.readexactly(size)
+            await reader.readexactly(2)  # CRLF
+            yield data
+    else:
+        n = int(headers.get("content-length", "0") or "0")
+        if n:
+            yield await reader.readexactly(n)
+
+
+class ReqResult:
+    __slots__ = ("status", "ttfb_ms", "ttft_ms", "tokens", "wall_ms",
+                 "outcome", "finish", "retry_after")
+
+    def __init__(self):
+        self.status = 0
+        self.ttfb_ms = None
+        self.ttft_ms = None
+        self.tokens = 0
+        self.wall_ms = 0.0
+        self.outcome = "error"  # ok | shed | error | stuck
+        self.finish = None  # finish_reason of the last SSE chunk, if any
+        self.retry_after = None
+
+
+#: finish_reason values that mean the server SHED the stream after the 200
+#: was already on the wire (mid-queue displacement, drain) — protocol
+#: contract from p2p_llm_tunnel_tpu.protocol.frames.ERROR_CODES, spelled
+#: out here because this script must stay stdlib-only.  Classifying these
+#: as "ok" would let a fairness regression that displaces victim streams
+#: read as "victim N/N ok" and pass the gate.
+SHED_FINISH_REASONS = frozenset({"tenant_overlimit", "busy", "draining"})
+
+
+async def one_request(host: str, port: int, tenant: str, rid: str,
+                      prompt: str, max_tokens: int) -> ReqResult:
+    out = ReqResult()
+    t0 = time.monotonic()
+    body = json.dumps({
+        "model": "loadgen",
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "stream": True,
+        "temperature": 0.0,
+        "ignore_eos": True,
+    }).encode()
+    req = (
+        f"POST /v1/chat/completions HTTP/1.1\r\n"
+        f"host: {host}:{port}\r\n"
+        f"x-tunnel-tenant: {tenant}\r\n"
+        f"x-request-tag: {rid}\r\n"
+        f"content-type: application/json\r\n"
+        f"content-length: {len(body)}\r\n"
+        f"connection: close\r\n\r\n"
+    ).encode() + body
+    reader = writer = None
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(req)
+        await writer.drain()
+        status, headers = await _read_headers(reader)
+        out.status = status
+        out.retry_after = headers.get("retry-after")
+        buf = b""
+        async for chunk in _iter_body(reader, headers):
+            if out.ttfb_ms is None:
+                out.ttfb_ms = (time.monotonic() - t0) * 1000.0
+            if status != 200:
+                continue  # drain the error body
+            buf += chunk
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                if not event.startswith(b"data: "):
+                    continue
+                data = event[6:]
+                if data == b"[DONE]":
+                    continue
+                payload = json.loads(data)
+                choices = payload.get("choices") or []
+                if not choices:
+                    continue
+                delta = choices[0].get("delta", {})
+                if choices[0].get("finish_reason"):
+                    out.finish = choices[0]["finish_reason"]
+                if out.ttft_ms is None and delta:
+                    out.ttft_ms = (time.monotonic() - t0) * 1000.0
+                if delta.get("content"):
+                    out.tokens += 1
+        if status == 200:
+            # A 200 is not automatically a success: a stream displaced
+            # after admission ends with a typed shed finish_reason on an
+            # otherwise-clean SSE body.
+            out.outcome = ("shed" if out.finish in SHED_FINISH_REASONS
+                           else "ok")
+        elif status == 429:
+            out.outcome = "shed"
+        else:
+            out.outcome = "error"
+    except (ConnectionError, asyncio.IncompleteReadError, OSError,
+            ValueError):
+        out.outcome = "error"
+    finally:
+        if writer is not None:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        out.wall_ms = (time.monotonic() - t0) * 1000.0
+    return out
+
+
+async def one_client(host: str, port: int, tenant: str, idx: int,
+                     requests: int, prompt_pad: int, max_tokens: int,
+                     delay: float, results: List[ReqResult]) -> None:
+    if delay > 0:
+        await asyncio.sleep(delay)
+    for r in range(requests):
+        # Unique per (tenant, client, round) so prefix dedup cannot
+        # collapse the herd into one prefill.
+        prompt = f"load {tenant} {idx} {r} ".ljust(prompt_pad, "x")
+        results.append(await one_request(
+            host, port, tenant, f"{tenant}-{idx}-{r}", prompt, max_tokens
+        ))
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def tenant_rows(per_tenant: Dict[str, List[ReqResult]]) -> List[dict]:
+    rows = []
+    for tenant, rs in sorted(per_tenant.items()):
+        ttfts = [r.ttft_ms for r in rs if r.ttft_ms is not None]
+        ttfbs = [r.ttfb_ms for r in rs if r.ttfb_ms is not None]
+        n = lambda v: round(v, 1) if v is not None else None  # noqa: E731
+        rows.append({
+            "tenant": tenant,
+            "requests": len(rs),
+            "ok": sum(1 for r in rs if r.outcome == "ok"),
+            "shed_429": sum(1 for r in rs if r.outcome == "shed"),
+            "errors": sum(1 for r in rs if r.outcome == "error"),
+            "stuck": sum(1 for r in rs if r.outcome == "stuck"),
+            "tokens": sum(r.tokens for r in rs),
+            "ttft_p50_ms": n(nearest_rank(ttfts, 50)),
+            "ttft_p99_ms": n(nearest_rank(ttfts, 99)),
+            "ttft_p999_ms": n(nearest_rank(ttfts, 99.9)),
+            "ttfb_p50_ms": n(nearest_rank(ttfbs, 50)),
+            "ttfb_p99_ms": n(nearest_rank(ttfbs, 99)),
+            "ttfb_p999_ms": n(nearest_rank(ttfbs, 99.9)),
+        })
+    return rows
+
+
+async def fetch_healthz(host: str, port: int) -> Optional[dict]:
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write((f"GET /healthz HTTP/1.1\r\nhost: {host}\r\n"
+                      "connection: close\r\n\r\n").encode())
+        await writer.drain()
+        _status, headers = await _read_headers(reader)
+        body = b""
+        async for chunk in _iter_body(reader, headers):
+            body += chunk
+        writer.close()
+        return json.loads(body)
+    except (ConnectionError, OSError, ValueError,
+            asyncio.IncompleteReadError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def parse_tenant_spec(spec: str) -> Tuple[str, int, int]:
+    parts = spec.split(":")
+    if not 2 <= len(parts) <= 3 or not parts[0]:
+        raise SystemExit(
+            f"--tenant must be name:clients[:requests], got {spec!r}"
+        )
+    return parts[0], int(parts[1]), int(parts[2]) if len(parts) == 3 else 1
+
+
+async def run_load(args) -> dict:
+    per_tenant: Dict[str, List[ReqResult]] = {}
+    tasks = []
+    t0 = time.monotonic()
+    for name, clients, requests in args.tenants:
+        results = per_tenant.setdefault(name, [])
+        for i in range(clients):
+            # Stagger connection starts across the ramp so the connect
+            # storm itself is not the experiment.
+            delay = args.ramp * i / max(1, clients)
+            tasks.append(asyncio.create_task(one_client(
+                args.host, args.port, name, i, requests,
+                args.prompt_pad, args.max_tokens, delay, results,
+            )))
+    done, pending = await asyncio.wait(tasks, timeout=args.timeout)
+    for t in pending:
+        t.cancel()
+    # Retrieve every task's outcome: cancelled stragglers AND tasks that
+    # died with an uncaught exception (whose remaining requests would
+    # otherwise vanish from the report with the exit code still 0).
+    settled = await asyncio.gather(*tasks, return_exceptions=True)
+    crashed = sum(1 for t, r in zip(tasks, settled)
+                  if t not in pending and isinstance(r, BaseException))
+    stuck = len(pending) + crashed
+    for name, clients, requests in args.tenants:
+        got = len(per_tenant[name])
+        # Tasks cancelled or crashed mid-flight under-report; every
+        # planned request must land in some bucket — mark the gap stuck.
+        expect = clients * requests
+        for _ in range(expect - got):
+            r = ReqResult()
+            r.outcome = "stuck"
+            per_tenant[name].append(r)
+    wall = time.monotonic() - t0
+    healthz = None
+    if not args.no_healthz:
+        await asyncio.sleep(0.5)  # let the server settle before leak check
+        healthz = await fetch_healthz(args.host, args.port)
+    return {
+        "clients": sum(c for _n, c, _r in args.tenants),
+        "wall_s": round(wall, 2),
+        "stuck_tasks": stuck,
+        "tenants": tenant_rows(per_tenant),
+        # Leak check: in-flight and occupancy must be back to zero once
+        # every client is done — a nonzero value here is a leaked slot.
+        "healthz_after": None if healthz is None else {
+            "status": healthz.get("status"),
+            "inflight_requests": healthz.get("inflight_requests"),
+            "queue_depth": healthz.get("queue_depth"),
+            "slot_occupancy": healthz.get("slot_occupancy"),
+            "tenants": healthz.get("tenants"),
+            "retry_after_s": healthz.get("retry_after_s"),
+        },
+    }
+
+
+def spawn_stack(args) -> Tuple[subprocess.Popen, int]:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "p2p_llm_tunnel_tpu.testing.local_stack",
+        "--port", "0", "--slots", str(args.stack_slots),
+        "--max-seq", str(args.stack_max_seq),
+        "--max-waiting", str(args.stack_max_waiting),
+    ]
+    if args.stack_tenant_weights:
+        cmd += ["--tenant-weights", args.stack_tenant_weights]
+    if args.stack_no_fair:
+        cmd += ["--no-fair-admission"]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    deadline = time.monotonic() + args.spawn_timeout
+    port = None
+    assert proc.stdout is not None
+    while time.monotonic() < deadline:
+        # readline() on a silent pipe blocks forever — a stack wedged in
+        # warmup that prints NOTHING would hang loadgen (and the chaos
+        # gate) past --spawn-timeout.  select() bounds each wait, so the
+        # deadline is enforced even with zero output.
+        ready, _, _ = select.select(
+            [proc.stdout], [], [], max(0.1, deadline - time.monotonic())
+        )
+        if not ready:
+            break
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("LOADGEN_STACK_PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+    if port is None:
+        proc.terminate()
+        raise SystemExit("stack never reported a port (warmup failure?)")
+    return proc, port
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="loadgen", description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--tenant", action="append", default=[],
+                    help="name:clients[:requests] (repeatable; default "
+                         "herd:500)")
+    ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--prompt-pad", type=int, default=24,
+                    help="prompt length in bytes (byte tokenizer: ~tokens)")
+    ap.add_argument("--ramp", type=float, default=2.0,
+                    help="seconds over which each tenant's connects stagger")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="whole-run budget; clients past it count as STUCK")
+    ap.add_argument("--no-healthz", action="store_true",
+                    help="skip the post-run /healthz leak check")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output only")
+    ap.add_argument("--spawn", action="store_true",
+                    help="spawn p2p_llm_tunnel_tpu.testing.local_stack in "
+                         "a subprocess and aim at it")
+    ap.add_argument("--spawn-timeout", type=float, default=900.0)
+    ap.add_argument("--stack-slots", type=int, default=32)
+    ap.add_argument("--stack-max-seq", type=int, default=256)
+    ap.add_argument("--stack-max-waiting", type=int, default=600)
+    ap.add_argument("--stack-tenant-weights", default="")
+    ap.add_argument("--stack-no-fair", action="store_true")
+    args = ap.parse_args(argv)
+    args.tenants = [parse_tenant_spec(s) for s in (args.tenant or
+                                                   ["herd:500"])]
+
+    proc = None
+    try:
+        if args.spawn:
+            proc, args.port = spawn_stack(args)
+            if not args.json:
+                print(f"stack up on port {args.port}", file=sys.stderr)
+        out = asyncio.run(run_load(args))
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    print(json.dumps(out, indent=None if args.json else 2), flush=True)
+    total_stuck = sum(r["stuck"] for r in out["tenants"])
+    # The leak check is part of the gate, not advisory: "zero stuck
+    # streams or leaked slots" means a nonzero post-run in-flight/queue/
+    # occupancy (or an unreachable /healthz when the check was requested)
+    # must fail the run the same way a stuck stream does.
+    leaked = False
+    if not args.no_healthz:
+        hz = out.get("healthz_after")
+        leaked = hz is None or any(
+            hz.get(k) or 0
+            for k in ("inflight_requests", "queue_depth", "slot_occupancy")
+        )
+        if leaked:
+            detail = ("unreachable" if hz is None
+                      else f"not clean: {hz!r}")
+            print(f"# LEAK: post-run /healthz {detail}", file=sys.stderr)
+    if not args.json:
+        for r in out["tenants"]:
+            print(
+                f"# {r['tenant']}: {r['ok']}/{r['requests']} ok, "
+                f"{r['shed_429']} shed, {r['errors']} errors, "
+                f"{r['stuck']} stuck; ttft p50/p99/p999 = "
+                f"{r['ttft_p50_ms']}/{r['ttft_p99_ms']}/"
+                f"{r['ttft_p999_ms']} ms",
+                file=sys.stderr,
+            )
+    return 1 if (total_stuck or leaked) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,8 +103,16 @@ def summarize(trace: dict) -> dict:
         parks = spans.get("engine.prefix_park", ())
         prx = earliest("proxy.request")
         top = prx or earliest("serve.dispatch") or eng
+        # Tenant identity (ISSUE 7): stamped on proxy.request and
+        # engine.request span attrs when the ingress derived one.
+        tenant = None
+        for e in (prx, eng):
+            if e is not None and e["args"].get("tenant"):
+                tenant = e["args"]["tenant"]
+                break
         requests.append({
             "trace_id": tid,
+            "tenant": tenant,
             "path": (top or {}).get("args", {}).get("path"),
             "status": (prx or {}).get("args", {}).get("status"),
             "finish": (eng or {}).get("args", {}).get("finish"),
@@ -126,6 +134,25 @@ def summarize(trace: dict) -> dict:
         "ttft_p99_ms": _pct(ttfts, 99),
         "ttft_p999_ms": _pct(ttfts, 99.9),
     }
+    # Per-tenant TTFT rollup (ISSUE 7) — present only when the capture
+    # carries tenant identities, so untenanted traces render unchanged.
+    if any(r["tenant"] for r in requests):
+        by_tenant: Dict[str, List[float]] = {}
+        counts: Dict[str, int] = {}
+        for r in requests:
+            t = r["tenant"] or "-"
+            counts[t] = counts.get(t, 0) + 1
+            if r["ttft_ms"] is not None:
+                by_tenant.setdefault(t, []).append(r["ttft_ms"])
+        aggregate["by_tenant"] = {
+            t: {
+                "requests": counts[t],
+                "ttft_p50_ms": _pct(by_tenant.get(t, []), 50),
+                "ttft_p99_ms": _pct(by_tenant.get(t, []), 99),
+                "ttft_p999_ms": _pct(by_tenant.get(t, []), 99.9),
+            }
+            for t in sorted(counts)
+        }
     scope = {
         name: {"count": len(xs), "p50_ms": _pct(xs, 50)}
         for name, xs in sorted(engine_scope.items())
@@ -167,6 +194,10 @@ def main(argv=None) -> int:
     print(f"-- {agg['requests']} request(s); engine TTFT ms "
           f"p50={agg['ttft_p50_ms']} p99={agg['ttft_p99_ms']} "
           f"p999={agg['ttft_p999_ms']}")
+    for t, row in (agg.get("by_tenant") or {}).items():
+        print(f"-- tenant {t}: n={row['requests']} TTFT ms "
+              f"p50={row['ttft_p50_ms']} p99={row['ttft_p99_ms']} "
+              f"p999={row['ttft_p999_ms']}")
     for name, s in out["engine_scope"].items():
         print(f"-- {name}: n={s['count']} p50={s['p50_ms']:.1f} ms")
     return 0

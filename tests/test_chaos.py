@@ -62,10 +62,16 @@ def test_spec_parse_defaults_and_partials():
 
 @pytest.mark.parametrize("bad", [
     "drop", "drop=x", "frobnicate=1", "drop=1.5", "stall=2:1",
+    "bw=0", "bw=-100", "bw=fast",
 ])
 def test_spec_parse_rejects_malformed(bad):
     with pytest.raises(ChaosSpecError):
         ChaosSpec.parse(bad)
+
+
+def test_spec_parse_bw():
+    assert ChaosSpec.parse("bw=65536").bw_bytes_per_s == 65536.0
+    assert ChaosSpec.parse("seed=2,bw=1e6,drop=0.1").bw_bytes_per_s == 1e6
 
 
 def test_maybe_chaos_passthrough_and_wrap(monkeypatch):
@@ -164,6 +170,51 @@ def test_stall_delays_but_delivers():
         assert c.faults == [(0, "stall")]
 
     asyncio.run(main())
+
+
+def test_bw_paces_but_delivers_everything():
+    """The slow-reader/bandwidth-cap fault (ISSUE 7): every byte arrives —
+    no loss, no reorder — but a burst pays the full serialized transfer
+    time of the capped link, cumulatively across messages."""
+    async def main():
+        c, rx = _chaos_pair("seed=1,bw=40960")  # 40 KiB/s
+        msgs = [bytes([i]) * 1024 for i in range(4)]  # 4 KiB burst
+        t0 = time.monotonic()
+        for m in msgs:
+            await c.send(m)
+        elapsed = time.monotonic() - t0
+        assert await _drain_rx(rx, 4, timeout=0.2) == msgs
+        # 4096 bytes / 40960 B/s = 100 ms serialized, paid cumulatively.
+        assert elapsed >= 0.09
+        assert c.faults == [(i, "bw") for i in range(4)]
+
+    asyncio.run(main())
+
+
+def test_bw_schedule_deterministic_and_composes():
+    """The bw fault record is a pure function of the send sequence, so it
+    composes with the RNG-driven faults without perturbing their draws —
+    two runs yield identical schedules and identical delivered bytes."""
+    spec = "seed=11,bw=1e6,drop=0.3,dup=0.3"
+    msgs = [bytes([i]) * 200 for i in range(20)]
+
+    async def run_once():
+        c, rx = _chaos_pair(spec)
+        for m in msgs:
+            await c.send(m)
+        got = await _drain_rx(rx, 100, timeout=0.2)
+        return c.faults, got
+
+    f1, g1 = asyncio.run(run_once())
+    f2, g2 = asyncio.run(run_once())
+    assert f1 == f2 and g1 == g2
+    kinds = {kind for _, kind in f1}
+    assert "bw" in kinds and ("drop" in kinds or "dup" in kinds)
+    # Every non-dropped message was paced; dropped ones never hit the link.
+    dropped = {i for i, kind in f1 if kind == "drop"}
+    assert {i for i, kind in f1 if kind == "bw"} == (
+        set(range(len(msgs))) - dropped
+    )
 
 
 def test_same_seed_same_schedule():
@@ -269,7 +320,11 @@ async def _scenario(seed: int):
         await client.wait(h, timeout=120.0)
         burst_statuses = tuple(sorted(r.status for r in burst))
         retry_after_ok = all(
-            r.headers.get("retry-after") == "1"
+            # Load-derived advisory (ISSUE 7): the contract is an integer
+            # in [1, 60] s; the exact value depends on live rate state, so
+            # only this range-membership BOOL is part of the two-run
+            # determinism oracle.
+            1 <= int(r.headers.get("retry-after", "0")) <= 60
             for r in burst if r.status == 429
         )
 

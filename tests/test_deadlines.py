@@ -243,7 +243,11 @@ def test_max_inflight_sheds_with_429_retry_after_and_busy_frame():
             r2 = await client.request("GET", "/b")
             await client.wait(r2, timeout=10.0)
             assert r2.status == 429
-            assert r2.headers.get("retry-after") == "1"
+            # Load-derived advisory (ISSUE 7): in-flight over dispatch
+            # rate, clamped — the contract is the [1, 60] s range, not a
+            # constant (the exact value depends on process-global rate
+            # state, i.e. what ran before this test).
+            assert 1 <= int(r2.headers.get("retry-after")) <= 60
             # Typed busy frame follows RES_END for protocol-aware peers.
             await asyncio.sleep(0.2)
             assert r2.error_code == "busy", (r2.error_code, r2.error)
@@ -401,7 +405,8 @@ def test_engine_api_sheds_429_when_queue_full():
             json.dumps({"prompt": "hi", "max_tokens": 4}).encode(),
         )
         assert status == 429
-        assert headers.get("retry-after") == "1"
+        # Queue-depth-over-drain-rate advisory, clamped to [1, 60] s.
+        assert 1 <= int(headers.get("retry-after")) <= 60
 
     asyncio.run(main())
 

@@ -168,6 +168,98 @@ def test_serve_streams_freely_without_flow_feature():
 
 
 # ---------------------------------------------------------------------------
+# head-of-line isolation across the frame mux (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def test_stalled_stream_does_not_block_siblings():
+    """One stream whose consumer grants no credit must not delay bytes or
+    FLOW-credit processing on a sibling stream sharing the channel: the
+    sibling streams its whole body to RES_END while the stalled stream sits
+    frozen at exactly INITIAL_CREDIT.  Runs over a seeded bandwidth-capped
+    chaos link (the ISSUE 7 slow-reader fault) and asserts the identical
+    outcome across two runs — per-stream byte accounting included."""
+    import os
+
+    from p2p_llm_tunnel_tpu.transport.chaos import ChaosChannel, ChaosSpec
+
+    seed = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+    total = INITIAL_CREDIT + 64 * 1024
+
+    async def run_once():
+        serve_ch, peer_ch = loopback_pair()
+        # Seeded capped link on the serve→peer path: every response frame
+        # of BOTH streams serializes through it, so isolation must come
+        # from per-stream credit gating, not from idle bandwidth.
+        chaos_ch = ChaosChannel(
+            serve_ch, ChaosSpec.parse(f"seed={seed},bw=2e7")
+        )
+        serve_task = asyncio.create_task(
+            run_serve(chaos_ch, backend=_big_body_backend(total))
+        )
+        await peer_ch.send(
+            TunnelMessage.hello(Hello(features=["sse", "flow"])).encode()
+        )
+        raw = await asyncio.wait_for(peer_ch.recv(), 5.0)
+        assert "flow" in Agree.from_json(TunnelMessage.decode(raw).payload).features
+        for sid in (1, 2):
+            await peer_ch.send(TunnelMessage.req_headers(
+                RequestHeaders(sid, "GET", "/blob")
+            ).encode())
+            await peer_ch.send(TunnelMessage.req_end(sid).encode())
+
+        got = {1: 0, 2: 0}
+        ended = {1: False, 2: False}
+        granted2 = 0
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while not ended[2]:
+            timeout = deadline - asyncio.get_running_loop().time()
+            assert timeout > 0, f"sibling stream starved: {got}"
+            try:
+                raw = await asyncio.wait_for(peer_ch.recv(), min(timeout, 0.5))
+            except asyncio.TimeoutError:
+                continue
+            msg = TunnelMessage.decode(raw)
+            if msg.msg_type == MessageType.RES_BODY:
+                got[msg.stream_id] += len(msg.payload)
+                if msg.stream_id == 2:
+                    # The well-behaved consumer: replenish stream 2 in
+                    # CREDIT_BATCH steps; stream 1 NEVER gets a grant.
+                    granted2 += len(msg.payload)
+                    if granted2 >= CREDIT_BATCH:
+                        await peer_ch.send(
+                            TunnelMessage.flow(2, granted2).encode()
+                        )
+                        granted2 = 0
+            elif msg.msg_type == MessageType.RES_END:
+                ended[msg.stream_id] = True
+            else:
+                continue  # headers/pings are irrelevant to the byte count
+        # Settle: stream 1 must stay frozen at its initial credit.
+        await asyncio.sleep(0.2)
+        with contextlib.suppress(asyncio.TimeoutError):
+            while True:
+                msg = TunnelMessage.decode(
+                    await asyncio.wait_for(peer_ch.recv(), 0.1)
+                )
+                if msg.msg_type == MessageType.RES_BODY:
+                    got[msg.stream_id] += len(msg.payload)
+        serve_task.cancel()
+        serve_ch.close()
+        await asyncio.gather(serve_task, return_exceptions=True)
+        return got[1], got[2], ended[1], ended[2]
+
+    out1 = asyncio.run(run_once())
+    out2 = asyncio.run(run_once())
+    assert out1 == out2, "HOL outcome must be deterministic across runs"
+    got1, got2, end1, end2 = out1
+    assert got2 == total and end2, "sibling did not complete"
+    assert got1 == INITIAL_CREDIT, (
+        f"stalled stream sent {got1}, expected exactly {INITIAL_CREDIT}"
+    )
+    assert not end1
+
+
+# ---------------------------------------------------------------------------
 # full stack: proxy replenishes credit as its client consumes
 # ---------------------------------------------------------------------------
 

@@ -1362,3 +1362,95 @@ def test_tc08_self_run_every_field_wired_or_waived():
     waived_fields = {v.message.split()[0] for v in waived}
     assert "EngineConfig.min_prefill_bucket" in waived_fields
     assert "EngineConfig.prefix_tail_buckets" in waived_fields
+
+
+# ---------------------------------------------------------------------------
+# TC10 — every queue/buffer on the frame-mux path declares its bound (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def test_tc10_unbounded_queue_and_deque_flagged_in_scope(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        from collections import deque
+
+        events = asyncio.Queue()
+        backlog = deque()
+        """,
+        filename="endpoints/snippet.py",
+        rules=["TC10"],
+    )
+    assert rules_of(active) == ["TC10", "TC10"]
+    assert "backpressure" in active[0].message
+
+
+def test_tc10_explicitly_unbounded_still_flags(tmp_path):
+    """Literal maxsize=0 / maxlen=None assert unboundedness without naming
+    the compensating mechanism — say it in a waiver instead."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        import collections
+
+        q = asyncio.Queue(maxsize=0)
+        d = collections.deque(maxlen=None)
+        """,
+        filename="transport/snippet.py",
+        rules=["TC10"],
+    )
+    assert rules_of(active) == ["TC10", "TC10"]
+    assert "explicitly unbounded" in active[0].message
+
+
+def test_tc10_bounded_constructions_are_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        from collections import deque
+
+        CAP = 64
+        q1 = asyncio.Queue(maxsize=256)
+        q2 = asyncio.Queue(CAP)
+        d1 = deque(maxlen=8)
+        d2 = deque([], 8)
+        """,
+        filename="protocol/snippet.py",
+        rules=["TC10"],
+    )
+    assert active == []
+
+
+def test_tc10_out_of_scope_dirs_are_exempt(tmp_path):
+    """engine/ (and anything else off the frame-mux path) is out of scope:
+    its per-request queues are bounded by max_new_tokens per stream and
+    audited by the serving-path rules."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+
+        q = asyncio.Queue()
+        """,
+        filename="engine/snippet.py",
+        rules=["TC10"],
+    )
+    assert active == []
+
+
+def test_tc10_waiver_names_the_backpressure_provider(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        import asyncio
+
+        q = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in bytes by FLOW credit
+        """,
+        filename="endpoints/snippet.py",
+        rules=["TC10"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC10"]

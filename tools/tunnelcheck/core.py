@@ -420,6 +420,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_jax,
         rules_metrics,
         rules_protocol,
+        rules_queues,
         rules_tracing,
     )
 
@@ -433,6 +434,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC07": rules_dispatch.check_tc07,
         "TC08": rules_config.check_tc08,
         "TC09": rules_tracing.check_tc09,
+        "TC10": rules_queues.check_tc10,
     }
 
 
@@ -447,6 +449,7 @@ RULE_SUMMARIES = {
     "TC07": "device dispatch inside a per-request/slot loop on the serving path",
     "TC08": "EngineConfig field not wired to a cli.py flag (config rot)",
     "TC09": "span name not in utils.tracing.SPAN_CATALOG / span emission inside traced fns",
+    "TC10": "unbounded Queue/deque in endpoints/transport/protocol without a backpressure waiver",
 }
 
 

@@ -1,0 +1,88 @@
+"""TC10: every queue/buffer on the frame-mux path must declare its bound.
+
+The 1k-client ingress audit (ISSUE 7): an ``asyncio.Queue()`` or ``deque()``
+with no ``maxsize``/``maxlen`` in ``endpoints/``, ``transport/``, or
+``protocol/`` is a place where a slow reader or a hot sender can buffer
+without limit — exactly the class of bug FLOW credit and the coalescer's
+byte window exist to prevent.  Every construction must either pass an
+explicit bound or carry a per-line waiver *stating who provides the
+backpressure* (e.g. "bounded in bytes by FLOW credit"), so the audit is
+re-checkable instead of review folklore.
+
+An explicit literal ``maxsize=0`` / ``maxlen=None`` still flags: that
+spelling asserts unboundedness without naming the compensating mechanism —
+say it in a waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from tools.tunnelcheck.core import (
+    ProjectContext,
+    SourceFile,
+    Violation,
+    resolve_dotted,
+)
+
+#: Directories whose queue constructions are on (or adjacent to) the
+#: proxy<->serve frame-mux path.  engine/ is deliberately out of scope:
+#: its per-request queues are bounded by max_new_tokens per stream and
+#: audited by the serving-path rules (TC07).
+SCOPE_DIRS = frozenset({"endpoints", "transport", "protocol"})
+
+#: Constructors that allocate an unbounded buffer unless told otherwise,
+#: mapped to the keyword that bounds them and its positional index.
+QUEUE_CTORS = {
+    "asyncio.Queue": ("maxsize", 0),
+    "asyncio.LifoQueue": ("maxsize", 0),
+    "asyncio.PriorityQueue": ("maxsize", 0),
+    "asyncio.queues.Queue": ("maxsize", 0),
+    "collections.deque": ("maxlen", 1),
+    "deque": ("maxlen", 1),
+}
+
+
+def _bound_expr(node: ast.Call, kw_name: str, pos_idx: int) -> Optional[ast.AST]:
+    """The expression bounding this construction, or None when absent."""
+    for kw in node.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    if len(node.args) > pos_idx:
+        return node.args[pos_idx]
+    return None
+
+
+def _explicitly_unbounded(expr: ast.AST) -> bool:
+    """Literal 0 / None bounds assert unboundedness rather than a limit."""
+    return isinstance(expr, ast.Constant) and expr.value in (0, None)
+
+
+def check_tc10(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if not (SCOPE_DIRS & set(sf.path.parts)):
+        return iter(())
+    out: List[Violation] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = resolve_dotted(node.func, sf.aliases)
+        if resolved not in QUEUE_CTORS:
+            continue
+        kw_name, pos_idx = QUEUE_CTORS[resolved]
+        expr = _bound_expr(node, kw_name, pos_idx)
+        if expr is not None and not _explicitly_unbounded(expr):
+            continue
+        kind = "explicitly unbounded" if expr is not None else "unbounded"
+        out.append(
+            Violation(
+                "TC10",
+                sf.path,
+                node.lineno,
+                f"{kind} `{resolved}(...)` on the frame-mux path — pass an "
+                f"explicit {kw_name}= or waive stating who provides the "
+                "backpressure (FLOW credit, a byte window, a cwnd, ...)",
+                end_line=node.end_lineno,
+            )
+        )
+    return iter(out)
