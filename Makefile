@@ -45,7 +45,7 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 		tests/test_spec_decode.py tests/test_multi_choice.py \
 		tests/test_seeded_sampling.py tests/test_logit_bias.py \
 		tests/test_spmd_serve.py tests/test_chaos.py \
-		tests/test_deadlines.py -q
+		tests/test_deadlines.py tests/test_fabric.py -q
 
 # Three fixed seeds: each pins a different deterministic fault schedule
 # (drops land on different frames); the e2e scenario asserts identical
@@ -70,6 +70,16 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# credit isolation at the same seed.
 	CHAOS_TEST_SEED=5 python -m pytest tests/test_flow_control.py -k stalled_stream -q
 	TUNNEL_CHAOS="seed=5,bw=4e6" LOADGEN_CLIENTS=$${LOADGEN_CLIENTS:-500} $(MAKE) loadgen
+	@# ISSUE 8 matrix row: 3-serve-peer fabric, one peer murdered mid-herd
+	@# by the seeded chaos kill schedule (kill=N is deterministic in
+	@# message count) — zero failures among requests that had not yet
+	@# streamed (transparent re-dispatch to survivors), the typed
+	@# [peer_lost] finish on the mid-stream one, identical outcomes across
+	@# two seeded runs (asserted INSIDE the test), and the recovery time
+	@# recorded in proxy_failover_ms.
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_fabric.py -q
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_reconnect.py -k fabric -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_reconnect.py -k fabric -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import os
+import random
 import sys
 from typing import Optional
 
@@ -287,10 +288,38 @@ def build_parser() -> argparse.ArgumentParser:
                             "tp/sp/ep stay slice-local on ICI "
                             "(parallel/distributed.py)")
 
+    serve.add_argument("--fabric",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_FABRIC", "") == "1",
+                       help="join the room as a role-tagged `serve` peer of "
+                            "a multi-peer fabric (ISSUE 8): the room holds "
+                            "one proxy and up to N serve peers, this peer "
+                            "always answers the proxy's targeted offer; "
+                            "pair with `proxy --peers N` (env "
+                            "TUNNEL_FABRIC=1; default off = classic 2-peer "
+                            "room)")
+
     proxy = sub.add_parser("proxy", help="consumer peer: local HTTP port")
     common(proxy)
     proxy.add_argument("--listen", default=DEFAULT_LISTEN,
                        help="local HTTP listen addr (env TUNNEL_LISTEN)")
+    proxy.add_argument("--peers", type=int,
+                       default=int(_env("TUNNEL_PEERS", "1")),
+                       help="multi-peer fabric (ISSUE 8): fan requests "
+                            "across up to this many serve peers joined to "
+                            "the room with `serve --fabric` — health-routed "
+                            "least-loaded dispatch, per-peer circuit "
+                            "breakers, transparent re-dispatch of "
+                            "not-yet-streaming requests when a peer dies "
+                            "(1 = classic single-peer tunnel, byte-"
+                            "identical to before; env TUNNEL_PEERS)")
+    proxy.add_argument("--peer-probe-s", type=float,
+                       default=float(_env("TUNNEL_PEER_PROBE_S", "15")),
+                       help="fabric health probing: tunneled GET /healthz "
+                            "per peer at this interval feeds the "
+                            "live/degraded/draining routing states "
+                            "(0 = RTT-only health; applies with "
+                            "--peers > 1; env TUNNEL_PEER_PROBE_S)")
     proxy.add_argument("--trust-tenant-header",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_TRUST_TENANT_HEADER", "") == "1",
@@ -365,6 +394,10 @@ async def run_with_retry(name: str, attempt_fn, *, max_attempts: int = 0,
         if max_attempts and attempt >= max_attempts:
             raise RuntimeError(f"{name}: giving up after {attempt} attempts")
         backoff = min(INITIAL_BACKOFF * (2 ** (attempt - 1)), MAX_BACKOFF)
+        # Jitter (ISSUE 8 / tunnelcheck TC11): a fleet of serve peers
+        # killed by the same fault must not re-dial the signal server in
+        # lockstep — the reference's bare exponential synchronizes herds.
+        backoff *= 1.0 + random.uniform(0.0, 0.25)
         log.info("%s: reconnecting in %.0fs", name, backoff)
         if stop is None:
             await asyncio.sleep(backoff)  # CancelledError propagates → Ctrl+C
@@ -391,9 +424,14 @@ async def _serve_once(args, drain: "Optional[asyncio.Event]" = None) -> None:
             # Multi-host follower rank: the replay loop above ran to
             # completion (leader stopped); nothing to serve here.
             return
-    channel, signaling = await connect(args.signal, args.room, args.transport,
-                                       stun_server=args.stun, relay=args.relay,
-                                       relay_secret=args.relay_secret)
+    channel, signaling = await connect(
+        args.signal, args.room, args.transport,
+        stun_server=args.stun, relay=args.relay,
+        relay_secret=args.relay_secret,
+        # --fabric: join role-tagged as one of N serve peers (ISSUE 8);
+        # this peer always answers the proxy's targeted offer.
+        role="serve" if getattr(args, "fabric", False) else None,
+    )
     try:
         kwargs = dict(
             max_inflight=getattr(args, "max_inflight", 0), drain=drain
@@ -567,6 +605,9 @@ async def _proxy_once(args) -> None:
     from p2p_llm_tunnel_tpu.transport import connect
 
     host, _, port = args.listen.rpartition(":")
+    if args.peers > 1:
+        await _proxy_fabric_once(args, host or "127.0.0.1", int(port))
+        return
     channel, signaling = await connect(args.signal, args.room, args.transport,
                                        stun_server=args.stun, relay=args.relay,
                                        relay_secret=args.relay_secret)
@@ -579,6 +620,46 @@ async def _proxy_once(args) -> None:
     finally:
         channel.close()
         await signaling.close()
+
+
+async def _proxy_fabric_once(args, host: str, port: int) -> None:
+    """One fabric session (ISSUE 8): a role-tagged proxy fanning requests
+    across up to ``--peers`` serve peers.
+
+    Supervision split: each serve peer's own ``run_with_retry`` redials the
+    room when its channel dies (a fresh peer-joined re-admits it here), so
+    a single peer death does NOT end this session — only the signaling
+    socket's death does, raising out to the caller's retry loop.
+    """
+    from p2p_llm_tunnel_tpu.endpoints.proxy import ProxyState, run_proxy_fabric
+    from p2p_llm_tunnel_tpu.transport.fabric import run_fabric_dialer
+
+    state = ProxyState(
+        tenant_fallback=args.room or "",
+        trust_tenant_header=args.trust_tenant_header,
+        probe_interval=args.peer_probe_s,
+        fabric=True,
+    )
+    dialer = asyncio.create_task(run_fabric_dialer(
+        args.signal, args.room, args.transport, state,
+        max_peers=args.peers, stun_server=args.stun,
+        relay=args.relay, relay_secret=args.relay_secret,
+    ))
+    try:
+        await run_proxy_fabric(state, host, port)
+    finally:
+        dialer.cancel()
+        try:
+            await dialer
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            # The dialer's own failure IS the root cause (e.g. signaling
+            # refused the join: "room is full: a proxy peer is already
+            # present") — surface it to the retry supervisor instead of
+            # the generic "fabric supervision ended".
+            log.warning("proxy fabric dialer failed: %s", e)
+            raise
 
 
 async def _amain(args) -> None:

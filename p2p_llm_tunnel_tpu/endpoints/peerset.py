@@ -1,0 +1,567 @@
+"""Supervised multi-peer fabric for the proxy endpoint (ISSUE 8).
+
+The proxy used to own exactly ONE :class:`Channel`; a serve-peer death
+aborted every in-flight stream and the only recovery was the supervisor
+tearing down and re-dialing the whole tunnel.  This module replaces that
+single channel with a :class:`PeerSet`: per-peer links, each with its own
+handshake, response reader, keepalive (RTT-measuring) and optional tunneled
+``/healthz`` probe, plus health-aware least-loaded dispatch and a per-peer
+circuit breaker.  A 1-peer PeerSet degenerates to the old behavior — the
+single-peer wire exchange is byte-identical.
+
+Health states per link:
+
+- ``live``      — handshake done, answering keepalives, healthz says ok
+- ``degraded``  — keepalive RTT above threshold or healthz says degraded;
+                  still dispatchable, but only when no live peer exists
+- ``draining``  — the peer reported draining (healthz or a typed
+                  ``draining`` error frame); NOT dispatchable — it will
+                  finish its in-flight streams and die
+- ``dead``      — channel closed; pending streams were aborted with a typed
+                  ``peer_lost`` event (the proxy re-dispatches the ones that
+                  had not yet streamed)
+
+The circuit breaker guards against a peer that stays CONNECTED but keeps
+failing dispatches (dead backend, endless header timeouts): after
+``CB_THRESHOLD`` consecutive failures the link is skipped for a cooldown,
+then a single half-open probe dispatch decides between closing the breaker
+and doubling the cooldown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Union
+
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    Agree,
+    Hello,
+    MessageType,
+    ProtocolError,
+    RequestHeaders,
+    ResponseHeaders,
+    TunnelMessage,
+)
+from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.utils.logging import get_logger
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+log = get_logger(__name__)
+
+HANDSHAKE_TIMEOUT = 300.0  # proxy.rs:72-78
+PING_INTERVAL = 10.0  # proxy.rs:93
+
+#: Keepalive RTT above which a live link is marked degraded (and below
+#: which a degraded link recovers, health permitting).
+DEGRADED_RTT_MS = 2000.0
+#: Budget for one tunneled GET /healthz probe.
+PROBE_TIMEOUT = 5.0
+
+#: Consecutive dispatch failures that open a link's circuit breaker.
+CB_THRESHOLD = 3
+#: Initial breaker cooldown; doubles per re-opening, capped.
+CB_COOLDOWN_S = 5.0
+CB_COOLDOWN_MAX_S = 60.0
+
+PEER_LIVE = "live"
+PEER_DEGRADED = "degraded"
+PEER_DRAINING = "draining"
+PEER_DEAD = "dead"
+
+
+# -- per-stream demux events (formerly proxy-module-private) ----------------
+
+@dataclass
+class _Headers:
+    headers: ResponseHeaders
+
+
+@dataclass
+class _Body:
+    data: bytes
+
+
+@dataclass
+class _Error:
+    message: str
+    #: Typed ``[code]`` parsed from the payload (or stamped locally by the
+    #: abort path) — None for plain reference-style text.
+    code: Optional[str] = None
+
+
+class _End:
+    pass
+
+
+_StreamEvent = Union[_Headers, _Body, _Error, _End]
+
+
+class PeerLink:
+    """One serve peer: its channel, demux state, and health bookkeeping."""
+
+    def __init__(self, peer_id: str, channel: Channel):
+        self.peer_id = peer_id
+        self.channel = channel
+        self.state = PEER_LIVE
+        self.ready = False  # set once HELLO/AGREE completes
+        self.flow_enabled = False
+        self.pending: Dict[int, "asyncio.Queue[_StreamEvent]"] = {}
+        self.rtt_ms: Optional[float] = None
+        self.health = ""  # last /healthz status string ("" = never probed)
+        self.consec_failures = 0
+        self.breaker_until = 0.0
+        self.breaker_level = 0
+        self.half_open_inflight = False
+        self.admitted_at = time.monotonic()
+        self._ping_sent_at: Optional[float] = None
+        self._tasks: List[asyncio.Task] = []
+
+    @property
+    def inflight(self) -> int:
+        return len(self.pending)
+
+    def breaker_open(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None else time.monotonic()) < self.breaker_until
+
+    def dispatchable(self, now: float, enforce_breaker: bool = True) -> bool:
+        """Can this link take a new dispatch right now?
+
+        ``enforce_breaker=False`` is the classic single-peer proxy: with
+        nowhere else to send, skipping the only channel would turn a slow
+        backend into instant 503s — the old proxy forwarded everything,
+        and the 1-peer PeerSet must keep doing so.
+        """
+        if not self.ready or self.state in (PEER_DRAINING, PEER_DEAD):
+            return False
+        if enforce_breaker and self.breaker_open(now):
+            return False
+        if (enforce_breaker and self.consec_failures >= CB_THRESHOLD
+                and self.half_open_inflight):
+            # Breaker cooldown elapsed: exactly one half-open probe at a
+            # time decides whether it closes.
+            return False
+        return True
+
+    def describe(self, now: float) -> dict:
+        return {
+            "state": self.state,
+            "inflight": self.inflight,
+            "rtt_ms": round(self.rtt_ms, 1) if self.rtt_ms is not None else None,
+            "health": self.health or None,
+            "consec_failures": self.consec_failures,
+            "breaker_open_for_s": round(max(0.0, self.breaker_until - now), 1),
+        }
+
+
+class PeerSet:
+    """Supervised set of serve-peer links with health-aware dispatch.
+
+    ``probe_interval`` > 0 starts a tunneled GET /healthz probe task per
+    admitted link (the fabric default); 0 keeps the wire byte-identical to
+    the classic single-peer proxy (RTT still rides the existing keepalive
+    PINGs, which cost nothing new).
+    """
+
+    def __init__(self, probe_interval: float = 0.0, fabric: bool = False):
+        self.peers: Dict[str, PeerLink] = {}
+        self.probe_interval = probe_interval
+        #: Fabric mode (N-peer): health signals may take a peer OUT of the
+        #: dispatch set.  Off in the classic single-peer proxy, where e.g.
+        #: passively observing a ``draining`` error must NOT stop the proxy
+        #: from tunneling to its only peer (the serve side answers drain
+        #: sheds itself — byte-identical legacy behavior).
+        self.fabric = fabric
+        #: Set once ANY peer ever completed its handshake — the "Tunnel not
+        #: ready" 503 (pre-handshake) vs "no live serve peer" 503 split.
+        self.ever_ready = False
+        #: Resolves when the fabric supervisor wants the listener down
+        #: (signaling death / shutdown); run_proxy_fabric awaits it.
+        self.closed = asyncio.Event()
+        self._rr = 0
+        self._next_stream_id = 1
+        self._id_seq = 0
+
+    # -- stream ids (proxy is the sole allocator, proxy.rs:52) ------------
+
+    def alloc_stream_id(self) -> int:
+        sid = self._next_stream_id
+        self._next_stream_id += 1
+        return sid
+
+    # -- membership -------------------------------------------------------
+
+    def any_ready(self) -> bool:
+        return any(l.ready and l.state != PEER_DEAD for l in self.peers.values())
+
+    def live_count(self) -> int:
+        return sum(
+            1 for l in self.peers.values()
+            if l.ready and l.state in (PEER_LIVE, PEER_DEGRADED)
+        )
+
+    def total_pending(self) -> int:
+        return sum(l.inflight for l in self.peers.values())
+
+    def _publish_gauges(self) -> None:
+        global_metrics.set_gauge("proxy_peers_live", self.live_count())
+        global_metrics.set_gauge("proxy_streams_in_flight", self.total_pending())
+
+    async def admit(self, channel: Channel, peer_id: Optional[str] = None) -> PeerLink:
+        """Handshake ``channel`` and add it as a dispatchable link.
+
+        Raises RuntimeError on handshake failure — the per-peer supervisor
+        (or run_proxy's retry loop) owns the redial.
+        """
+        if peer_id is None:
+            peer_id = f"peer-{self._id_seq}"
+            self._id_seq += 1
+        link = PeerLink(peer_id, channel)
+        if not channel.connected.is_set():
+            log.info("waiting for channel to be ready...")
+            await channel.connected.wait()
+        log.info("channel ready, performing handshake...")
+        await channel.send(TunnelMessage.hello(Hello()).encode())
+        try:
+            raw = await asyncio.wait_for(channel.recv(), HANDSHAKE_TIMEOUT)
+        except asyncio.TimeoutError:
+            raise RuntimeError(
+                "handshake timeout: no AGREE received within 5 minutes"
+            )
+        except ChannelClosed:
+            raise RuntimeError("channel closed before handshake")
+        agree_msg = TunnelMessage.decode(raw)
+        if agree_msg.msg_type != MessageType.AGREE:
+            raise RuntimeError(f"expected AGREE, got {agree_msg.msg_type.name}")
+        agree = Agree.from_json(agree_msg.payload)
+        log.info("received AGREE: version=%d features=%s",
+                 agree.version, agree.features)
+        link.flow_enabled = "flow" in agree.features
+        link.ready = True
+        self.peers[peer_id] = link
+        self.ever_ready = True
+        link._tasks.append(asyncio.create_task(self._reader(link)))
+        link._tasks.append(asyncio.create_task(self._keepalive(link)))
+        if self.probe_interval > 0:
+            link._tasks.append(asyncio.create_task(self._prober(link)))
+        self._publish_gauges()
+        return link
+
+    # -- dispatch policy (ReplicaRouter's pick, proxy-side) ---------------
+
+    def pick(self, exclude: Iterable[str] = ()) -> Optional[PeerLink]:
+        """Health-aware least-loaded link, round-robin tiebreak.
+
+        Live peers win over degraded ones; draining/dead/breaker-open links
+        are skipped.  A link whose breaker cooldown just elapsed is
+        admitted as the single half-open probe.
+        """
+        now = time.monotonic()
+        excluded = set(exclude)
+        candidates = [
+            l for l in self.peers.values()
+            if l.peer_id not in excluded
+            and l.dispatchable(now, enforce_breaker=self.fabric)
+        ]
+        if not candidates:
+            return None
+        key = lambda l: (0 if l.state == PEER_LIVE else 1, l.inflight)
+        low = min(key(l) for l in candidates)
+        lowest = [l for l in candidates if key(l) == low]
+        self._rr = (self._rr + 1) % len(lowest)
+        chosen = lowest[self._rr % len(lowest)]
+        if self.fabric and chosen.consec_failures >= CB_THRESHOLD:
+            # Past-cooldown pick of a tripped link IS the half-open probe.
+            chosen.half_open_inflight = True
+        return chosen
+
+    # -- circuit breaker --------------------------------------------------
+
+    def record_failure(self, link: PeerLink) -> None:
+        """One dispatch-level failure (send died, upstream error/timeout
+        before headers, peer death mid-dispatch)."""
+        link.half_open_inflight = False
+        link.consec_failures += 1
+        if (self.fabric
+                and link.consec_failures >= CB_THRESHOLD
+                and not link.breaker_open()
+                and link.state != PEER_DEAD):
+            cooldown = min(
+                CB_COOLDOWN_S * (2 ** link.breaker_level), CB_COOLDOWN_MAX_S
+            )
+            # Jitter so a fleet of proxies doesn't re-probe in lockstep.
+            cooldown *= 1.0 + random.uniform(0.0, 0.25)
+            link.breaker_until = time.monotonic() + cooldown
+            link.breaker_level += 1
+            global_metrics.inc("proxy_circuit_open_total")
+            log.warning(
+                "peer %s circuit breaker OPEN for %.1fs after %d consecutive "
+                "failures", link.peer_id, cooldown, link.consec_failures,
+            )
+
+    def record_success(self, link: PeerLink) -> None:
+        if link.consec_failures >= CB_THRESHOLD:
+            log.info("peer %s circuit breaker closed (half-open probe ok)",
+                     link.peer_id)
+        link.consec_failures = 0
+        link.breaker_level = 0
+        link.breaker_until = 0.0
+        link.half_open_inflight = False
+
+    # -- death / teardown -------------------------------------------------
+
+    def _abort_link(self, link: PeerLink, err: TunnelMessage) -> None:
+        """Wake every stream pending on ``link`` with a typed error event
+        so no handler hangs (the old module-global ``_abort_pending``,
+        scoped per peer and typed per the ERROR_CODES registry)."""
+        text = err.payload.decode("utf-8", "replace")
+        code = err.error_code()
+        for sid, q in list(link.pending.items()):
+            q.put_nowait(_Error(text, code))
+        link.pending.clear()
+        self._publish_gauges()
+
+    def mark_dead(self, link: PeerLink, err: TunnelMessage) -> None:
+        """Transition a link to dead: abort its streams (typed), drop it
+        from the dispatchable set, cancel its tasks."""
+        if link.state == PEER_DEAD:
+            return
+        link.state = PEER_DEAD
+        log.warning("serve peer %s lost (%d stream(s) in flight)",
+                    link.peer_id, link.inflight)
+        self._abort_link(link, err)
+        self.peers.pop(link.peer_id, None)
+        self._publish_gauges()
+        current = asyncio.current_task()
+        for t in link._tasks:
+            if t is not current:
+                t.cancel()
+
+    def remove(self, peer_id: str, err: TunnelMessage) -> None:
+        """External removal (signaling peer-left, fabric teardown)."""
+        link = self.peers.get(peer_id)
+        if link is not None:
+            link.channel.close()
+            self.mark_dead(link, err)
+
+    def close(self, err: TunnelMessage) -> None:
+        """Tear every link down (proxy shutdown / full reconnect)."""
+        for link in list(self.peers.values()):
+            link.channel.close()
+            self.mark_dead(link, err)
+        self.closed.set()
+
+    # -- per-link tasks ----------------------------------------------------
+
+    async def _reader(self, link: PeerLink) -> None:
+        """Demux one link's frames into its per-stream event queues
+        (proxy.rs:105-172, scoped per peer)."""
+        channel = link.channel
+        while True:
+            try:
+                raw = await channel.recv()
+            except ChannelClosed:
+                log.debug("response reader ended: channel closed (%s)",
+                          link.peer_id)
+                self.mark_dead(link, TunnelMessage.typed_error(
+                    0, "peer_lost", "tunnel closed"))
+                return
+            try:
+                msg = TunnelMessage.decode(raw)
+            except ProtocolError as e:
+                log.warning("failed to decode tunnel message: %s", e)
+                continue
+
+            if msg.msg_type == MessageType.RES_HEADERS:
+                try:
+                    headers = ResponseHeaders.from_json(msg.payload)
+                except ProtocolError as e:
+                    log.error("failed to parse response headers: %s", e)
+                    continue
+                q = link.pending.get(headers.stream_id)
+                if q is not None:
+                    q.put_nowait(_Headers(headers))
+            elif msg.msg_type == MessageType.RES_BODY:
+                q = link.pending.get(msg.stream_id)
+                if q is not None:
+                    q.put_nowait(_Body(msg.payload))
+            elif msg.msg_type == MessageType.RES_END:
+                q = link.pending.pop(msg.stream_id, None)
+                if q is not None:
+                    q.put_nowait(_End())
+                    self._publish_gauges()
+            elif msg.msg_type == MessageType.ERROR:
+                text = msg.payload.decode("utf-8", "replace")
+                code = msg.error_code()
+                if (self.fabric and code == "draining"
+                        and link.state != PEER_DEAD):
+                    # The peer told us it is draining — stop dispatching to
+                    # it before the drain finishes and the channel dies.
+                    # Fabric-only: the single-peer proxy keeps tunneling to
+                    # its draining peer so clients see the serve side's own
+                    # 503 [draining] answers, exactly as before.
+                    if link.state != PEER_DRAINING:
+                        log.info("peer %s reported draining", link.peer_id)
+                    link.state = PEER_DRAINING
+                    self._publish_gauges()
+                q = link.pending.pop(msg.stream_id, None)
+                if q is not None:
+                    log.error("tunnel error for stream %d: %s",
+                              msg.stream_id, text)
+                    q.put_nowait(_Error(text, code))
+                    self._publish_gauges()
+                else:
+                    # Expected, not an anomaly: serve relays a backend
+                    # shed's typed code ([busy]/[tenant_overlimit]) AFTER
+                    # RES_END, by which point this demux has already
+                    # forgotten the stream.  Error-level here would emit
+                    # one misleading line per shed — exactly under the
+                    # overload the typed codes exist for.
+                    log.debug("post-stream tunnel error for %d: %s",
+                              msg.stream_id, text)
+            elif msg.msg_type == MessageType.PING:
+                try:
+                    await channel.send(TunnelMessage.pong().encode())
+                except ChannelClosed:
+                    self.mark_dead(link, TunnelMessage.typed_error(
+                        0, "peer_lost", "tunnel closed"))
+                    return
+            elif msg.msg_type == MessageType.PONG:
+                log.debug("received pong")
+                self._note_pong(link)
+            else:
+                log.debug("proxy ignoring message type %s", msg.msg_type.name)
+
+    def _note_pong(self, link: PeerLink) -> None:
+        """Keepalive RTT sample → live/degraded transitions."""
+        if link._ping_sent_at is None:
+            return
+        link.rtt_ms = (time.monotonic() - link._ping_sent_at) * 1000.0
+        link._ping_sent_at = None
+        if link.state == PEER_LIVE and link.rtt_ms > DEGRADED_RTT_MS:
+            log.warning("peer %s degraded: keepalive RTT %.0fms",
+                        link.peer_id, link.rtt_ms)
+            link.state = PEER_DEGRADED
+        elif (link.state == PEER_DEGRADED
+              and link.rtt_ms <= DEGRADED_RTT_MS
+              and link.health in ("", "ok")):
+            log.info("peer %s recovered: keepalive RTT %.0fms",
+                     link.peer_id, link.rtt_ms)
+            link.state = PEER_LIVE
+        self._publish_gauges()
+
+    async def _keepalive(self, link: PeerLink) -> None:
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            link._ping_sent_at = time.monotonic()
+            try:
+                await link.channel.send(TunnelMessage.ping().encode())
+            except ChannelClosed:
+                return
+
+    async def _prober(self, link: PeerLink) -> None:
+        while True:
+            await asyncio.sleep(self.probe_interval)
+            if link.state == PEER_DEAD:
+                return
+            try:
+                await self.probe(link)
+            except ChannelClosed:
+                return
+
+    async def probe(self, link: PeerLink) -> Optional[str]:
+        """One tunneled GET /healthz; applies the reported status to the
+        link's health state.  Returns the status string, or None when the
+        probe timed out (which marks the link degraded)."""
+        sid = self.alloc_stream_id()
+        q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded by the probe's own /healthz response (a few frames); the stream is torn down at PROBE_TIMEOUT
+        link.pending[sid] = q
+        try:
+            await link.channel.send(TunnelMessage.req_headers(
+                RequestHeaders(sid, "GET", "/healthz", {})
+            ).encode())
+            await link.channel.send(TunnelMessage.req_end(sid).encode())
+            body = bytearray()
+            deadline = time.monotonic() + PROBE_TIMEOUT
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                ev = await asyncio.wait_for(q.get(), remaining)
+                if isinstance(ev, _Body):
+                    body.extend(ev.data)
+                elif isinstance(ev, _End):
+                    break
+                elif isinstance(ev, _Error):
+                    raise asyncio.TimeoutError
+        except asyncio.TimeoutError:
+            if link.state == PEER_LIVE:
+                log.warning("peer %s degraded: healthz probe failed",
+                            link.peer_id)
+                link.state = PEER_DEGRADED
+                self._publish_gauges()
+            return None
+        finally:
+            link.pending.pop(sid, None)
+        try:
+            status = str(json.loads(bytes(body)).get("status", ""))
+        except (json.JSONDecodeError, ValueError):
+            status = ""
+        self.apply_health(link, status)
+        return status
+
+    def apply_health(self, link: PeerLink, status: str) -> None:
+        """Fold a /healthz-reported status into the link state."""
+        if link.state == PEER_DEAD:
+            return
+        link.health = status
+        if status == "draining":
+            if link.state != PEER_DRAINING:
+                log.info("peer %s reported draining", link.peer_id)
+            link.state = PEER_DRAINING
+        elif status == "degraded":
+            if link.state == PEER_LIVE:
+                log.warning("peer %s degraded (healthz)", link.peer_id)
+                link.state = PEER_DEGRADED
+        elif status == "ok":
+            if (link.state == PEER_DEGRADED
+                    and (link.rtt_ms is None
+                         or link.rtt_ms <= DEGRADED_RTT_MS)):
+                log.info("peer %s recovered (healthz ok)", link.peer_id)
+                link.state = PEER_LIVE
+        self._publish_gauges()
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The fabric-health JSON served at GET /healthz?local=1."""
+        now = time.monotonic()
+        live = self.live_count()
+        if live and any(
+            l.state == PEER_LIVE for l in self.peers.values() if l.ready
+        ):
+            status = "ok"
+        elif live:
+            status = "degraded"
+        else:
+            status = "down"
+        return {
+            "status": status,
+            "peers_live": live,
+            "streams_in_flight": self.total_pending(),
+            "redispatch_total": int(
+                global_metrics.counter("proxy_redispatch_total")
+            ),
+            "circuit_open_total": int(
+                global_metrics.counter("proxy_circuit_open_total")
+            ),
+            "failover_p50_ms": round(
+                global_metrics.percentile("proxy_failover_ms", 50), 1
+            ),
+            "peers": {
+                pid: link.describe(now) for pid, link in self.peers.items()
+            },
+        }

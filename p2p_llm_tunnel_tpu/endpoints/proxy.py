@@ -14,14 +14,25 @@ Reference behavior being matched (tunnel/src/proxy.rs):
   error before headers (:360-366); hop-by-hop headers stripped from the
   rebuilt response (:379-388)
 - mid-stream ERROR truncates the body without an HTTP error (:408-412)
+
+Beyond the reference (ISSUE 8): the proxy's single channel is a supervised
+:class:`~p2p_llm_tunnel_tpu.endpoints.peerset.PeerSet` — N serve peers with
+independent lifecycles, health-routed least-loaded dispatch, per-peer
+circuit breakers, and transparent re-dispatch: a request whose serve peer
+dies BEFORE it started streaming is retried on a surviving peer (bounded
+attempts, capped backoff + jitter, deadline budget respected); a request
+already streaming fails fast with a typed ``peer_lost`` error.  A 1-peer
+PeerSet — the classic ``run_proxy`` path — is byte-identical to the old
+single-channel proxy, except that abort errors now carry typed
+``[peer_lost]`` / ``[tunnel_reset]`` codes.
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
 import time
-from dataclasses import dataclass
-from typing import AsyncIterator, Dict, Optional, Union
+from typing import AsyncIterator, Dict, Optional
 
 from p2p_llm_tunnel_tpu.endpoints.http11 import (
     HttpRequest,
@@ -29,17 +40,25 @@ from p2p_llm_tunnel_tpu.endpoints.http11 import (
     query_flags,
     start_http_server,
 )
+from p2p_llm_tunnel_tpu.endpoints.peerset import (  # noqa: F401  (re-exported)
+    HANDSHAKE_TIMEOUT,
+    PING_INTERVAL,
+    PeerLink,
+    PeerSet,
+    _Body,
+    _End,
+    _Error,
+    _Headers,
+    _StreamEvent,
+)
 from p2p_llm_tunnel_tpu.protocol.frames import (
     CREDIT_BATCH,
     TENANT_HEADER,
-    Agree,
-    Hello,
     MessageType,
-    ProtocolError,
     RequestHeaders,
-    ResponseHeaders,
     TunnelMessage,
     encode_body_frames,
+    parse_deadline_ms,
     parse_tenant,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
@@ -55,43 +74,38 @@ from p2p_llm_tunnel_tpu.utils.tracing import (
 
 log = get_logger(__name__)
 
-HANDSHAKE_TIMEOUT = 300.0  # proxy.rs:72-78
 RESPONSE_HEADER_TIMEOUT = 60.0  # proxy.rs:339-341
-PING_INTERVAL = 10.0  # proxy.rs:93
+
+#: Re-dispatch policy after a peer death (ISSUE 8): at most this many total
+#: dispatch attempts per request (1 initial + N-1 failovers), with capped
+#: exponential backoff + jitter between attempts.
+MAX_DISPATCH_ATTEMPTS = 4
+REDISPATCH_BACKOFF_S = 0.05
+REDISPATCH_BACKOFF_MAX_S = 1.0
+#: Advisory Retry-After attached to terminal peer_lost/no-peer failures —
+#: the serve peers' supervisors redial on this order of backoff.
+PEER_LOST_RETRY_AFTER_S = 2
 
 _HOP_BY_HOP_RESPONSE = {"transfer-encoding", "connection"}
 
 
-@dataclass
-class _Headers:
-    headers: ResponseHeaders
+class ProxyState(PeerSet):
+    """Shared state between the HTTP handler and the per-peer readers.
 
+    The old single-channel ProxyState, generalized: it IS the PeerSet.
+    Constructing it with a channel prepares (but does not handshake) the
+    classic single-peer link — ``handle_proxy_request`` answers 503 until a
+    handshake completes, exactly as before.
+    """
 
-@dataclass
-class _Body:
-    data: bytes
-
-
-@dataclass
-class _Error:
-    message: str
-
-
-class _End:
-    pass
-
-
-_StreamEvent = Union[_Headers, _Body, _Error, _End]
-
-
-class ProxyState:
-    """Shared state between the HTTP handler and the response-reader task."""
-
-    def __init__(self, channel: Channel, tenant_fallback: str = "",
-                 trust_tenant_header: bool = False):
+    def __init__(self, channel: Optional[Channel] = None,
+                 tenant_fallback: str = "",
+                 trust_tenant_header: bool = False,
+                 probe_interval: float = 0.0,
+                 fabric: bool = False):
+        super().__init__(probe_interval=probe_interval, fabric=fabric)
+        #: The classic single-peer channel (None in fabric mode).
         self.channel = channel
-        self.tunnel_ready = False
-        self.flow_enabled = False  # set from the AGREE feature list
         #: Tenant identity stamped on requests that carry neither an
         #: x-api-key nor an x-tunnel-tenant header — typically the room
         #: name, so one proxy connection is one accountable tenant.
@@ -103,87 +117,51 @@ class ProxyState:
         #: floor of 1 (see frames.parse_tenant).  Opt in only when a
         #: trusted edge stamps the header.
         self.trust_tenant_header = trust_tenant_header
-        self._next_stream_id = 1
-        self.pending: Dict[int, asyncio.Queue[_StreamEvent]] = {}
 
-    def alloc_stream_id(self) -> int:
-        sid = self._next_stream_id
-        self._next_stream_id += 1
-        return sid
+    @property
+    def tunnel_ready(self) -> bool:
+        return self.any_ready()
 
 
-def _abort_pending(state: ProxyState, reason: str) -> None:
-    """Wake every in-flight stream with an error so no handler hangs."""
-    for sid, q in list(state.pending.items()):
-        q.put_nowait(_Error(reason))
-    state.pending.clear()
+def _plain(status: int, text: str,
+           headers: Optional[Dict[str, str]] = None) -> HttpResponse:
+    h = {"content-type": "text/plain"}
+    if headers:
+        h.update(headers)
+    return HttpResponse(status, h, text.encode())
 
 
-async def _response_reader(state: ProxyState) -> None:
-    """Demux incoming frames into per-stream event queues (proxy.rs:105-172)."""
-    channel = state.channel
-    while True:
-        try:
-            raw = await channel.recv()
-        except ChannelClosed:
-            log.debug("response reader ended: channel closed")
-            _abort_pending(state, "tunnel closed")
-            return
-        try:
-            msg = TunnelMessage.decode(raw)
-        except ProtocolError as e:
-            log.warning("failed to decode tunnel message: %s", e)
-            continue
-
-        if msg.msg_type == MessageType.RES_HEADERS:
-            try:
-                headers = ResponseHeaders.from_json(msg.payload)
-            except ProtocolError as e:
-                log.error("failed to parse response headers: %s", e)
-                continue
-            q = state.pending.get(headers.stream_id)
-            if q is not None:
-                q.put_nowait(_Headers(headers))
-        elif msg.msg_type == MessageType.RES_BODY:
-            q = state.pending.get(msg.stream_id)
-            if q is not None:
-                q.put_nowait(_Body(msg.payload))
-        elif msg.msg_type == MessageType.RES_END:
-            q = state.pending.pop(msg.stream_id, None)
-            if q is not None:
-                q.put_nowait(_End())
-        elif msg.msg_type == MessageType.ERROR:
-            text = msg.payload.decode("utf-8", "replace")
-            q = state.pending.pop(msg.stream_id, None)
-            if q is not None:
-                log.error("tunnel error for stream %d: %s", msg.stream_id, text)
-                q.put_nowait(_Error(text))
-            else:
-                # Expected, not an anomaly: serve relays a backend shed's
-                # typed code ([busy]/[tenant_overlimit]) AFTER RES_END, by
-                # which point this demux has already forgotten the stream.
-                # Error-level here would emit one misleading line per shed
-                # — exactly under the overload the typed codes exist for.
-                log.debug("post-stream tunnel error for %d: %s",
-                          msg.stream_id, text)
-        elif msg.msg_type == MessageType.PING:
-            try:
-                await channel.send(TunnelMessage.pong().encode())
-            except ChannelClosed:
-                _abort_pending(state, "tunnel closed")
-                return
-        elif msg.msg_type == MessageType.PONG:
-            log.debug("received pong")
-        else:
-            log.debug("proxy ignoring message type %s", msg.msg_type.name)
+#: Methods the failover loop may replay after the request was FULLY SENT
+#: to a peer that then died pre-headers (RFC 9110 §9.2.2 idempotent set).
+#: A non-idempotent request in that window may already have executed on
+#: the dead peer's backend — replaying it would double the side effects —
+#: so it surfaces the typed peer_lost error instead, unless the client
+#: opted in via the x-tunnel-idempotent header.
+IDEMPOTENT_METHODS = frozenset(
+    {"GET", "HEAD", "OPTIONS", "PUT", "DELETE", "TRACE"}
+)
+#: Client opt-in: "x-tunnel-idempotent: 1" marks a POST safe to replay
+#: across peer failover (the client deduplicates, or the work is pure).
+IDEMPOTENT_HEADER = "x-tunnel-idempotent"
 
 
-def _plain(status: int, text: str) -> HttpResponse:
-    return HttpResponse(status, {"content-type": "text/plain"}, text.encode())
+class _DispatchFailed:
+    """One dispatch attempt died retryably (peer lost / send failed).
+
+    ``retry_safe`` is False when the request reached the peer whole and
+    is not idempotent — the failover loop must surface the typed error
+    instead of silently re-executing it.
+    """
+
+    def __init__(self, message: str, t_fail: float, retry_safe: bool = True):
+        self.message = message
+        self.t_fail = t_fail
+        self.retry_safe = retry_safe
 
 
 async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
-    """One HTTP request through the tunnel (proxy.rs:249-426)."""
+    """One HTTP request through the tunnel (proxy.rs:249-426), with
+    health-routed dispatch and transparent failover across the PeerSet."""
     if (req.method.upper() == "GET"
             and req.path.split("?")[0] == "/metrics"
             and "local=1" in query_flags(req.path)):
@@ -199,7 +177,8 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
             global_metrics.prometheus_text().encode(),
         )
     if req.method.upper() == "GET" and req.path.split("?")[0] == "/healthz":
-        if {"trace=1", "local=1"} <= query_flags(req.path):
+        flags = query_flags(req.path)
+        if {"trace=1", "local=1"} <= flags:
             # GET /healthz?trace=1&local=1: THIS process's span journal —
             # in the two-process topology the proxy's ingress spans
             # (proxy.request/frame_send/first_byte) live in this ring
@@ -212,12 +191,32 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                 200, {"content-type": "application/json"},
                 _json.dumps(global_tracer.chrome_trace()).encode(),
             )
+        if "local=1" in flags:
+            # GET /healthz?local=1: the proxy's OWN fabric health — peer
+            # states, per-peer RTT/breaker/inflight, failover counters
+            # (ISSUE 8).  Answered locally: it must work while every serve
+            # peer is down (that is exactly when an operator needs it).
+            import json as _json
 
-    if not state.tunnel_ready:
+            snap = state.snapshot()
+            return HttpResponse(
+                200 if snap["status"] == "ok" else 503,
+                {"content-type": "application/json"},
+                _json.dumps(snap).encode(),
+            )
+
+    if not state.any_ready():
+        if state.ever_ready:
+            # The tunnel WAS up and every serve peer has since died — a
+            # different operator story than "still handshaking", and a
+            # retryable one (peer supervisors are redialing on this order
+            # of backoff).
+            return _plain(
+                503, "Tunnel error: [peer_lost] no live serve peer",
+                {"retry-after": str(PEER_LOST_RETRY_AFTER_S)},
+            )
         return _plain(503, "Tunnel not ready")
 
-    channel = state.channel
-    stream_id = state.alloc_stream_id()
     t_start = time.monotonic()
     global_metrics.inc("proxy_requests_total")
     # Tenant identity (ISSUE 7): normalized HERE, at the tunnel's ingress —
@@ -231,7 +230,7 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
     # engine fair-admit and account per tenant without re-deriving.
     tenant = parse_tenant(req.headers, state.tenant_fallback,
                           trust_label=state.trust_tenant_header)
-    log.debug("proxying %s %s (stream %d)", req.method, req.path, stream_id)
+    log.debug("proxying %s %s", req.method, req.path)
 
     # Trace context (ISSUE 6): accept the client's x-tunnel-trace or mint a
     # fresh trace id here — the proxy is the tunnel's ingress, so this is
@@ -250,15 +249,18 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         root_span = new_span_id() if global_tracer.on(trace_id) else None
     span_done = False
 
-    def finish_span(status: int) -> None:
+    def finish_span(status: int, peer_id: str = "", attempts: int = 0) -> None:
         nonlocal span_done
         if root_span is None or span_done:
             return
         span_done = True
-        attrs = {"method": req.method, "path": req.path,
-                 "stream_id": stream_id, "status": status}
+        attrs = {"method": req.method, "path": req.path, "status": status}
         if tenant:
             attrs["tenant"] = tenant
+        if peer_id:
+            attrs["peer"] = peer_id
+        if attempts:
+            attrs["redispatches"] = attempts
         global_tracer.add_span(
             "proxy.request", trace_id=trace_id, span_id=root_span,
             parent_id=(inbound.span_id or None) if inbound else None,
@@ -280,12 +282,112 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         headers_out_tunnel[TENANT_HEADER] = tenant
     if root_span is not None:
         headers_out_tunnel[TRACE_HEADER] = f"{trace_id}/{root_span}"
+    # The SAME identity + trace headers ride every dispatch attempt, so
+    # tenant-fair accounting and the span chain survive a failover intact.
+
+    # The client's deadline budget bounds the RETRY loop only — the serve
+    # peer still enforces it per attempt (the proxy re-dispatching past the
+    # budget would waste a surviving peer's slot on a lost cause).
+    dl_ms = parse_deadline_ms(req.headers)
+    overall_deadline = t_start + dl_ms / 1000.0 if dl_ms is not None else None
+
+    idempotent = req.method.upper() in IDEMPOTENT_METHODS or any(
+        k.lower() == IDEMPOTENT_HEADER and v.strip() == "1"
+        for k, v in req.headers.items()
+    )
+
+    failures = 0
+    tried: set = set()
+    first_fail_t: Optional[float] = None
+    while True:
+        link = state.pick(exclude=tried)
+        if link is None and tried:
+            # Every untried peer is gone; a previously-tried one may have
+            # recovered (or be the only one left) — better than failing.
+            link = state.pick()
+        if link is None:
+            finish_span(503, attempts=failures)
+            return _plain(
+                503, "Tunnel error: [peer_lost] no live serve peer",
+                {"retry-after": str(PEER_LOST_RETRY_AFTER_S)},
+            )
+        outcome = await _dispatch_once(
+            state, link, req, headers_out_tunnel, t_start, first_fail_t,
+            trace_id, root_span, finish_span, failures, idempotent,
+        )
+        if not isinstance(outcome, _DispatchFailed):
+            return outcome
+        failures += 1
+        if not outcome.retry_safe:
+            # The dead peer received the whole non-idempotent request and
+            # may have executed it — replaying could double the side
+            # effects, so the client gets the typed error and decides.
+            finish_span(502, peer_id=link.peer_id, attempts=failures)
+            return _plain(
+                502, f"Tunnel error: {outcome.message} "
+                     "(not replayed: non-idempotent request may have "
+                     f"executed; retry or send {IDEMPOTENT_HEADER}: 1)",
+                {"retry-after": str(PEER_LOST_RETRY_AFTER_S)},
+            )
+        tried.add(link.peer_id)
+        if first_fail_t is None:
+            first_fail_t = outcome.t_fail
+        now = time.monotonic()
+        if failures >= MAX_DISPATCH_ATTEMPTS or (
+                overall_deadline is not None and now >= overall_deadline):
+            finish_span(502, peer_id=link.peer_id, attempts=failures)
+            return _plain(
+                502, f"Tunnel error: {outcome.message}",
+                {"retry-after": str(PEER_LOST_RETRY_AFTER_S)},
+            )
+        global_metrics.inc("proxy_redispatch_total")
+        # Capped exponential backoff + jitter before the next peer — a
+        # herd of re-dispatched streams must not stampede the survivor.
+        backoff = min(REDISPATCH_BACKOFF_S * (2 ** (failures - 1)),
+                      REDISPATCH_BACKOFF_MAX_S)
+        backoff *= 1.0 + random.uniform(0.0, 0.5)
+        if overall_deadline is not None:
+            backoff = min(backoff, max(0.0, overall_deadline - now))
+        await asyncio.sleep(backoff)
+        log.info("re-dispatching %s %s after peer loss (attempt %d)",
+                 req.method, req.path, failures + 1)
+
+
+async def _dispatch_once(
+    state: ProxyState,
+    link: PeerLink,
+    req: HttpRequest,
+    headers_out_tunnel: Dict[str, str],
+    t_start: float,
+    first_fail_t: Optional[float],
+    trace_id: str,
+    root_span: Optional[str],
+    finish_span,
+    prior_failures: int,
+    idempotent: bool = True,
+) -> "HttpResponse | _DispatchFailed":
+    """One dispatch attempt on one peer link.
+
+    Returns the HttpResponse (success OR a terminal error response), or a
+    :class:`_DispatchFailed` when the peer died before this request started
+    streaming — the caller's failover loop re-dispatches those.
+    """
+    channel = link.channel
+    stream_id = state.alloc_stream_id()
+    log.debug("dispatching %s %s (stream %d) on peer %s",
+              req.method, req.path, stream_id, link.peer_id)
 
     events: asyncio.Queue[_StreamEvent] = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in BYTES by FLOW credit: the serve peer stops emitting at INITIAL_CREDIT unacked bytes until body_stream() below grants more; against a no-"flow" reference peer the bound is the upstream's own response pacing (documented reference behavior)
-    state.pending[stream_id] = events
-    global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
+    link.pending[stream_id] = events
+    global_metrics.set_gauge("proxy_streams_in_flight", state.total_pending())
+
+    def drop_stream() -> None:
+        link.pending.pop(stream_id, None)
+        global_metrics.set_gauge(
+            "proxy_streams_in_flight", state.total_pending())
 
     t_send = time.monotonic()
+    sent_any = False
     try:
         await channel.send(
             TunnelMessage.req_headers(
@@ -293,53 +395,98 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                                headers_out_tunnel)
             ).encode()
         )
+        sent_any = True
         for frame in encode_body_frames(MessageType.REQ_BODY, stream_id, req.body):
             await channel.send(frame)
         await channel.send(TunnelMessage.req_end(stream_id).encode())
     except ChannelClosed:
-        state.pending.pop(stream_id, None)
-        finish_span(502)
-        return _plain(502, "Tunnel send failed")
+        drop_stream()
+        state.record_failure(link)
+        # A request the peer never saw a byte of is always replayable;
+        # a partially/fully sent one only if idempotent.
+        return _DispatchFailed("[peer_lost] tunnel send failed",
+                               time.monotonic(),
+                               retry_safe=idempotent or not sent_any)
     if root_span is not None:
         global_tracer.add_span(
             "proxy.frame_send", trace_id=trace_id, parent_id=root_span,
             track="proxy", t0=t_send,
-            attrs={"body_bytes": len(req.body)},
+            attrs={"body_bytes": len(req.body), "peer": link.peer_id},
         )
 
     # Wait for response headers (proxy.rs:338-376).
-    res_headers: Optional[ResponseHeaders] = None
+    res_headers = None
     deadline = time.monotonic() + RESPONSE_HEADER_TIMEOUT
     while res_headers is None:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            state.pending.pop(stream_id, None)
-            finish_span(504)
+            drop_stream()
+            state.record_failure(link)
+            finish_span(504, peer_id=link.peer_id)
             return _plain(504, "Tunnel response timeout")
         try:
             event = await asyncio.wait_for(events.get(), remaining)
         except asyncio.TimeoutError:
-            state.pending.pop(stream_id, None)
-            finish_span(504)
+            drop_stream()
+            state.record_failure(link)
+            finish_span(504, peer_id=link.peer_id)
             return _plain(504, "Tunnel response timeout")
         if isinstance(event, _Headers):
             res_headers = event.headers
         elif isinstance(event, _Error):
-            state.pending.pop(stream_id, None)
-            finish_span(502)
+            drop_stream()
+            if event.code == "peer_lost":
+                # The peer died before this request streamed a byte —
+                # the one case failover can transparently absorb (the
+                # whole request reached the peer, so non-idempotent ones
+                # surface the typed error instead of re-executing).
+                state.record_failure(link)
+                return _DispatchFailed(event.message, time.monotonic(),
+                                       retry_safe=idempotent)
+            if event.code in ("busy", "draining", "timeout",
+                              "tenant_overlimit"):
+                # A typed shed IS the peer's serve loop answering: the
+                # link works (this must clear a half-open probe rather
+                # than wedge it); the shed itself is a load or
+                # client-budget signal, not a peer fault.
+                state.record_success(link)
+            else:
+                # Untyped, upstream, tunnel_reset, or unknown-prefix
+                # errors count toward the peer's breaker.
+                state.record_failure(link)
+            finish_span(502, peer_id=link.peer_id)
             return _plain(502, f"Tunnel error: {event.message}")
         elif isinstance(event, _End):
-            state.pending.pop(stream_id, None)
-            finish_span(502)
+            drop_stream()
+            state.record_failure(link)
+            finish_span(502, peer_id=link.peer_id)
             return _plain(502, "Tunnel error: response ended before headers")
         else:
-            log.warning("received body chunk before headers for stream %d", stream_id)
+            log.warning("received body chunk before headers for stream %d",
+                        stream_id)
+
+    # Headers arrived: the dispatch succeeded (whatever the HTTP status —
+    # a 429/503 is the backend answering, not the peer failing).
+    state.record_success(link)
+    if first_fail_t is not None:
+        # This request survived a peer death via re-dispatch: the gap from
+        # the ORIGINAL failure to streaming again is the measured failover
+        # recovery time.
+        global_metrics.observe(
+            "proxy_failover_ms", (time.monotonic() - first_fail_t) * 1000.0
+        )
 
     headers_out = {
         k: v
         for k, v in res_headers.headers.items()
         if k.lower() not in _HOP_BY_HOP_RESPONSE
     }
+    ctype = res_headers.headers.get(
+        "content-type", res_headers.headers.get("Content-Type", "")).lower()
+    is_sse = "text/event-stream" in ctype
+    # The ollama-style /api/generate //api/chat stream: one JSON object per
+    # line — the OTHER streaming vocabulary a typed terminal error can ride.
+    is_ndjson = "ndjson" in ctype
 
     async def body_stream() -> AsyncIterator[bytes]:
         first = True
@@ -363,7 +510,7 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                     # The chunk reached the HTTP client (yield resumes after
                     # the writer drains) — replenish the serve side's credit
                     # in CREDIT_BATCH steps.
-                    if state.flow_enabled:
+                    if link.flow_enabled:
                         ungranted += len(event.data)
                         if ungranted >= CREDIT_BATCH:
                             try:
@@ -380,13 +527,36 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
                         log.warning(
                             "tunnel error mid-stream for %d: %s", stream_id, event.message
                         )
+                        if ((is_sse or is_ndjson) and not first
+                                and event.code in ("peer_lost",
+                                                   "tunnel_reset")):
+                            # A streaming request cannot be re-dispatched
+                            # (bytes already reached the client); give it a
+                            # TYPED terminal event instead of a silent
+                            # truncation, so protocol-aware consumers can
+                            # distinguish "peer died" from "stream done" —
+                            # framed as an SSE event or an NDJSON line to
+                            # match the stream's own vocabulary.  Chunked
+                            # transfer only (http11 strips content-length
+                            # for streamed bodies), and only for the
+                            # proxy-minted codes that cannot occur on the
+                            # reference wire.
+                            import json as _json
+
+                            payload = _json.dumps({"error": {
+                                "code": event.code,
+                                "message": event.message,
+                                "retry_after_s": PEER_LOST_RETRY_AFTER_S,
+                            }})
+                            yield ((f"data: {payload}\n\n" if is_sse
+                                    else payload + "\n").encode())
                     return
                 else:
                     log.warning("unexpected duplicate headers for stream %d", stream_id)
         finally:
-            state.pending.pop(stream_id, None)
-            global_metrics.set_gauge("proxy_streams_in_flight", len(state.pending))
-            finish_span(res_headers.status)
+            drop_stream()
+            finish_span(res_headers.status, peer_id=link.peer_id,
+                        attempts=prior_failures)
 
     return HttpResponse(res_headers.status, headers_out, body_stream())
 
@@ -400,6 +570,10 @@ async def run_proxy(
     trust_tenant_header: bool = False,
 ) -> None:
     """Run the consumer side until the tunnel dies; raises to trigger retry.
+
+    The classic single-peer entry point: a 1-link PeerSet over ``channel``,
+    byte-identical to the pre-fabric proxy.  ``run_proxy_fabric`` is the
+    N-peer twin.
 
     ``ready`` (optional) resolves to the bound port once the listener is up —
     the programmatic readiness signal (the reference greps logs instead,
@@ -416,36 +590,8 @@ async def run_proxy(
     state = ProxyState(channel, tenant_fallback=tenant_fallback,
                        trust_tenant_header=trust_tenant_header)
 
-    if not channel.connected.is_set():
-        log.info("waiting for channel to be ready...")
-        await channel.connected.wait()
-    log.info("channel ready, performing handshake...")
+    await state.admit(channel)
 
-    await channel.send(TunnelMessage.hello(Hello()).encode())
-    try:
-        raw = await asyncio.wait_for(channel.recv(), HANDSHAKE_TIMEOUT)
-    except asyncio.TimeoutError:
-        raise RuntimeError("handshake timeout: no AGREE received within 5 minutes")
-    except ChannelClosed:
-        raise RuntimeError("channel closed before handshake")
-    agree_msg = TunnelMessage.decode(raw)
-    if agree_msg.msg_type != MessageType.AGREE:
-        raise RuntimeError(f"expected AGREE, got {agree_msg.msg_type.name}")
-    agree = Agree.from_json(agree_msg.payload)
-    log.info("received AGREE: version=%d features=%s", agree.version, agree.features)
-    state.flow_enabled = "flow" in agree.features
-    state.tunnel_ready = True
-
-    async def keepalive() -> None:
-        while True:
-            await asyncio.sleep(PING_INTERVAL)
-            try:
-                await channel.send(TunnelMessage.ping().encode())
-            except ChannelClosed:
-                return
-
-    ping_task = asyncio.create_task(keepalive())
-    reader_task = asyncio.create_task(_response_reader(state))
     server = None
     try:
         async def handler(req: HttpRequest) -> HttpResponse:
@@ -459,9 +605,46 @@ async def run_proxy(
         await channel.disconnected.wait()
         raise RuntimeError("tunnel connection failed, exiting proxy to trigger reconnect")
     finally:
-        ping_task.cancel()
-        reader_task.cancel()
-        _abort_pending(state, "proxy shutting down")
+        state.close(TunnelMessage.typed_error(
+            0, "tunnel_reset", "proxy shutting down"))
+        if server is not None:
+            server.close()
+            try:
+                await asyncio.wait_for(server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                log.warning("proxy listener did not close cleanly within 5s")
+
+
+async def run_proxy_fabric(
+    state: ProxyState,
+    listen_host: str = "127.0.0.1",
+    listen_port: int = 8000,
+    ready: Optional["asyncio.Future[int]"] = None,
+) -> None:
+    """HTTP listener over an externally-supervised PeerSet (ISSUE 8).
+
+    Unlike ``run_proxy``, a single peer death does NOT end the listener —
+    peers come and go under their own supervision (``transport/fabric.py``
+    admits and removes them); the listener runs until ``state.closed`` is
+    set (signaling death or shutdown), then aborts what remains.
+    """
+    server = None
+    try:
+        async def handler(req: HttpRequest) -> HttpResponse:
+            return await handle_proxy_request(state, req)
+
+        server = await start_http_server(handler, listen_host, listen_port)
+        bound_port = server.sockets[0].getsockname()[1]
+        log.info("proxy fabric listening on http://%s:%d",
+                 listen_host, bound_port)
+        if ready is not None and not ready.done():
+            ready.set_result(bound_port)
+        await state.closed.wait()
+        raise RuntimeError(
+            "fabric supervision ended, exiting proxy to trigger reconnect")
+    finally:
+        state.close(TunnelMessage.typed_error(
+            0, "tunnel_reset", "proxy shutting down"))
         if server is not None:
             server.close()
             try:

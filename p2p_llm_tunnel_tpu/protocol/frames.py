@@ -67,8 +67,16 @@ CREDIT_BATCH = 64 * 1024
 #:     its weighted share of a contended ingress (other tenants are not);
 #:     backing off helps, switching API keys is the attack the code exists
 #:     to make visible
+#:   peer_lost — the serve peer carrying this stream died mid-flight and no
+#:     surviving peer could transparently absorb it (requests that had not
+#:     yet streamed are re-dispatched instead of surfacing this); safe to
+#:     retry after the advertised Retry-After
+#:   tunnel_reset — the proxy itself is tearing the tunnel down (shutdown
+#:     or full reconnect); unlike peer_lost there is no surviving peer to
+#:     absorb anything — retry against the listener once it returns
 ERROR_CODES = frozenset(
-    {"timeout", "busy", "draining", "upstream", "tenant_overlimit"}
+    {"timeout", "busy", "draining", "upstream", "tenant_overlimit",
+     "peer_lost", "tunnel_reset"}
 )
 
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE

@@ -40,16 +40,21 @@ class Joined:
     peer_id: str
     peers: List[str]
     observed: Optional[List[Any]] = None  # server's view of our [ip, port]
+    #: Fabric extension (ISSUE 8): role of each already-present peer
+    #: ({peer_id: "proxy"|"serve"|""}); empty against a reference server.
+    roles: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
 class PeerJoined:
     peer_id: str
+    role: str = ""  # fabric extension; "" against a reference server
 
 
 @dataclass
 class PeerLeft:
     peer_id: str
+    role: str = ""
 
 
 @dataclass
@@ -87,12 +92,13 @@ def _parse(raw: str) -> Optional[Incoming]:
     t = msg.get("type")
     if t == "joined":
         return Joined(
-            msg.get("peerId", ""), list(msg.get("peers", [])), msg.get("observed")
+            msg.get("peerId", ""), list(msg.get("peers", [])),
+            msg.get("observed"), dict(msg.get("roles") or {}),
         )
     if t == "peer-joined":
-        return PeerJoined(msg.get("peerId", ""))
+        return PeerJoined(msg.get("peerId", ""), msg.get("role", ""))
     if t == "peer-left":
-        return PeerLeft(msg.get("peerId", ""))
+        return PeerLeft(msg.get("peerId", ""), msg.get("role", ""))
     if t == "offer":
         return Offer(msg.get("sdp", {}), msg.get("from", ""))
     if t == "answer":
@@ -114,10 +120,18 @@ class SignalingClient:
     _rx: "asyncio.Queue[Optional[Incoming]]" = field(default_factory=asyncio.Queue)
     _reader: Optional[asyncio.Task] = None
     _closed: bool = False
+    #: Fabric role this session joined with ("" = legacy untagged).
+    role: str = ""
+    #: Default relay target: when set, outgoing offer/answer/candidate
+    #: carry ``to=<peer>`` unless the caller passed one — in an N-peer
+    #: room an untargeted relay is ambiguous, so an answerer pins this to
+    #: the offer's sender (transport/connect.py).
+    reply_to: str = ""
 
     @classmethod
     async def connect(
-        cls, signal_url: str, room: str, timeout: float = 15.0
+        cls, signal_url: str, room: str, timeout: float = 15.0,
+        role: str = "",
     ) -> "SignalingClient":
         if ws_connect is None:
             raise RuntimeError(
@@ -125,9 +139,14 @@ class SignalingClient:
                 "(pip install websockets)"
             )
         ws = await asyncio.wait_for(ws_connect(signal_url), timeout)
-        client = cls(room=room, _ws=ws)
-        # join-on-connect (signaling.rs:94-99)
-        await ws.send(json.dumps({"type": "join", "room": room}))
+        client = cls(room=room, _ws=ws, role=role)
+        # join-on-connect (signaling.rs:94-99); a role tag opts into the
+        # fabric's per-role room caps (ISSUE 8) — absent, the legacy
+        # 2-peer contract applies and a reference server is none the wiser.
+        join = {"type": "join", "room": room}
+        if role:
+            join["role"] = role
+        await ws.send(json.dumps(join))
         client._reader = asyncio.create_task(client._read_loop())
         return client
 
@@ -144,16 +163,22 @@ class SignalingClient:
 
     # -- sending ----------------------------------------------------------
 
-    async def send_offer(self, sdp: Dict[str, Any]) -> None:
-        await self._send({"type": "offer", "sdp": sdp})
+    async def send_offer(self, sdp: Dict[str, Any],
+                         to: Optional[str] = None) -> None:
+        await self._send({"type": "offer", "sdp": sdp}, to)
 
-    async def send_answer(self, sdp: Dict[str, Any]) -> None:
-        await self._send({"type": "answer", "sdp": sdp})
+    async def send_answer(self, sdp: Dict[str, Any],
+                          to: Optional[str] = None) -> None:
+        await self._send({"type": "answer", "sdp": sdp}, to)
 
-    async def send_candidate(self, candidate: Dict[str, Any]) -> None:
-        await self._send({"type": "candidate", "candidate": candidate})
+    async def send_candidate(self, candidate: Dict[str, Any],
+                             to: Optional[str] = None) -> None:
+        await self._send({"type": "candidate", "candidate": candidate}, to)
 
-    async def _send(self, obj: dict) -> None:
+    async def _send(self, obj: dict, to: Optional[str] = None) -> None:
+        to = to or self.reply_to
+        if to:
+            obj = {**obj, "to": to}
         try:
             await self._ws.send(json.dumps(obj))
         except websockets.ConnectionClosed:

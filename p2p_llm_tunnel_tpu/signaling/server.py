@@ -1,17 +1,27 @@
-"""The rendezvous server: WebSocket rooms of two, relaying handshake JSON.
+"""The rendezvous server: WebSocket rooms relaying handshake JSON.
 
 Behavioral contract from the reference signal server
 (signal-server/src/index.ts):
 - ``join {room}`` → assigns a UUID peer id, replies ``joined {peerId, peers}``
   with the ids already present, and notifies the existing peer with
   ``peer-joined {peerId}`` (index.ts:112-154)
-- rooms hold at most TWO peers; a third join gets ``error "room is full"``
-  (index.ts:35, :126-129)
+- untagged rooms hold at most TWO peers; a third join gets ``error "room is
+  full"`` (index.ts:35, :126-129)
 - ``offer`` / ``answer`` / ``candidate`` are relayed VERBATIM to the other
   peer in the room, with ``from`` set (index.ts:156-193)
 - ``bye``, socket close, or socket error → remove the peer and send
-  ``peer-left`` to the survivor (index.ts:56-78, :195-220)
+  ``peer-left`` to the survivors (index.ts:56-78, :195-220)
 - the server never carries tunnel traffic — handshake metadata only
+
+Beyond the reference (ISSUE 8): a join may carry a ``role`` —
+``"proxy"`` or ``"serve"`` — lifting the 2-peer cap into PER-ROLE caps:
+one proxy, up to ``max_serve_peers`` serve peers.  Role-tagged relays
+target a specific peer via ``to`` (required once a room can hold more than
+two occupants); ``joined`` answers include a ``roles`` map and
+``peer-joined``/``peer-left`` fan out to EVERY other occupant with the
+joiner's role.  Untagged joins keep the exact legacy contract, and the
+extension fields ride unknown-key-tolerant JSON, so reference peers
+interoperate unchanged in 2-peer rooms.
 
 Run standalone: ``python -m p2p_llm_tunnel_tpu.signaling.server --port 8787``.
 """
@@ -22,7 +32,7 @@ import asyncio
 import json
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 try:
     import websockets
@@ -38,9 +48,13 @@ from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
-MAX_ROOM_SIZE = 2  # index.ts:35
+MAX_ROOM_SIZE = 2  # index.ts:35 (untagged legacy rooms)
+#: Per-role cap for role-tagged rooms: at most one proxy fans requests
+#: across up to this many serve peers (ISSUE 8).
+MAX_SERVE_PEERS = 32
 
 RELAYED_TYPES = {"offer", "answer", "candidate"}
+ROLES = {"proxy", "serve"}
 
 
 @dataclass
@@ -48,6 +62,7 @@ class _Peer:
     peer_id: str
     room: str
     ws: ServerConnection
+    role: str = ""  # "" = legacy untagged join
 
 
 @dataclass
@@ -56,6 +71,7 @@ class SignalServer:
 
     host: str = "127.0.0.1"
     port: int = 8787
+    max_serve_peers: int = MAX_SERVE_PEERS
     rooms: Dict[str, Set[str]] = field(default_factory=dict)
     peers: Dict[str, _Peer] = field(default_factory=dict)
 
@@ -88,11 +104,39 @@ class SignalServer:
 
     # -- helpers ----------------------------------------------------------
 
-    def _other_peer(self, peer: _Peer) -> Optional[_Peer]:
-        """The other occupant of the peer's room (index.ts:45-54)."""
-        for pid in self.rooms.get(peer.room, ()):  # at most 2 entries
-            if pid != peer.peer_id:
-                return self.peers.get(pid)
+    def _occupants(self, room: str) -> List[_Peer]:
+        return [
+            p for p in (self.peers.get(pid) for pid in self.rooms.get(room, ()))
+            if p is not None
+        ]
+
+    def _others(self, peer: _Peer) -> List[_Peer]:
+        """Every other occupant of the peer's room (index.ts:45-54,
+        generalized past two)."""
+        return [p for p in self._occupants(peer.room) if p.peer_id != peer.peer_id]
+
+    def _join_refusal(self, room: str, role: str) -> Optional[str]:
+        """Why a join must be refused, or None.  Untagged joins keep the
+        legacy total-2 cap; tagged joins get per-role caps.  Tagged and
+        untagged peers never mix: a fabric peer slipping into a legacy
+        2-peer room (typo'd room name) would overfill it and break the
+        legacy pair's UNtargeted relay with 'ambiguous relay target' —
+        the old server would simply have said 'room is full'."""
+        occ = self._occupants(room)
+        if not role:
+            if any(p.role for p in occ):
+                return "room is full: fabric room (role-tagged peers)"
+            return "room is full" if len(occ) >= MAX_ROOM_SIZE else None
+        if role not in ROLES:
+            return f"unknown role {role!r}"
+        if any(not p.role for p in occ):
+            return "room is full: legacy 2-peer room (untagged peers)"
+        if role == "proxy":
+            if any(p.role == "proxy" for p in occ):
+                return "room is full: a proxy peer is already present"
+            return None
+        if sum(1 for p in occ if p.role == "serve") >= self.max_serve_peers:
+            return f"room is full: {self.max_serve_peers} serve peers"
         return None
 
     async def _send(self, peer: _Peer, obj: dict) -> None:
@@ -102,7 +146,7 @@ class SignalServer:
             pass
 
     async def _remove_peer(self, peer: _Peer) -> None:
-        """Drop a peer and tell the survivor (index.ts:56-78)."""
+        """Drop a peer and tell the survivors (index.ts:56-78)."""
         if self.peers.pop(peer.peer_id, None) is None:
             return
         room = self.rooms.get(peer.room)
@@ -110,9 +154,11 @@ class SignalServer:
             room.discard(peer.peer_id)
             if not room:
                 del self.rooms[peer.room]
-        other = self._other_peer(peer)
-        if other is not None:
-            await self._send(other, {"type": "peer-left", "peerId": peer.peer_id})
+        for other in self._occupants(peer.room):
+            await self._send(other, {
+                "type": "peer-left", "peerId": peer.peer_id,
+                "role": peer.role,
+            })
         log.info("[signal] peer %s left room %r", peer.peer_id[:8], peer.room)
 
     # -- connection handler ------------------------------------------------
@@ -138,47 +184,74 @@ class SignalServer:
                         await ws.send(json.dumps(
                             {"type": "error", "message": "room required"}))
                         continue
-                    occupants = self.rooms.setdefault(room_name, set())
-                    if len(occupants) >= MAX_ROOM_SIZE:
-                        # index.ts:126-129
+                    role = msg.get("role") or ""
+                    refusal = self._join_refusal(room_name, role)
+                    if refusal is not None:
+                        # index.ts:126-129 (per-role caps for tagged joins)
                         await ws.send(json.dumps(
-                            {"type": "error", "message": "room is full"}))
+                            {"type": "error", "message": refusal}))
                         continue
-                    peer = _Peer(str(uuid.uuid4()), room_name, ws)
-                    existing = list(occupants)
-                    occupants.add(peer.peer_id)
+                    peer = _Peer(str(uuid.uuid4()), room_name, ws, role)
+                    existing = self._occupants(room_name)
+                    self.rooms.setdefault(room_name, set()).add(peer.peer_id)
                     self.peers[peer.peer_id] = peer
                     # ``observed`` is this server's view of the peer's address
                     # — a built-in STUN-lite so peers can advertise their
                     # NAT-external IP as a candidate (extension field; the
-                    # reference schema ignores unknown keys).
+                    # reference schema ignores unknown keys).  ``roles``
+                    # likewise: who already holds which fabric role.
                     remote = ws.remote_address
                     await self._send(peer, {
-                        "type": "joined", "peerId": peer.peer_id, "peers": existing,
+                        "type": "joined", "peerId": peer.peer_id,
+                        "peers": [p.peer_id for p in existing],
+                        "roles": {p.peer_id: p.role for p in existing},
                         "observed": list(remote[:2]) if remote else None,
                     })
-                    for pid in existing:
-                        other = self.peers.get(pid)
-                        if other is not None:
-                            await self._send(other, {
-                                "type": "peer-joined", "peerId": peer.peer_id,
-                            })
-                    log.info("[signal] peer %s joined room %r (%d occupant(s))",
-                             peer.peer_id[:8], room_name, len(occupants))
+                    for other in existing:
+                        await self._send(other, {
+                            "type": "peer-joined", "peerId": peer.peer_id,
+                            "role": peer.role,
+                        })
+                    log.info(
+                        "[signal] peer %s%s joined room %r (%d occupant(s))",
+                        peer.peer_id[:8],
+                        f" [{role}]" if role else "",
+                        room_name, len(self.rooms[room_name]),
+                    )
 
                 elif mtype in RELAYED_TYPES:
                     if peer is None:
                         await ws.send(json.dumps(
                             {"type": "error", "message": "join a room first"}))
                         continue
-                    other = self._other_peer(peer)
-                    if other is None:
-                        await self._send(peer, {
-                            "type": "error", "message": "no peer in room"})
-                        continue
+                    to = msg.get("to")
+                    if to is not None:
+                        # Targeted relay (fabric rooms): the recipient must
+                        # share the room — the proxy addresses one serve
+                        # peer per offer, answers go back to the offerer.
+                        target = self.peers.get(to)
+                        if target is None or target.room != peer.room:
+                            await self._send(peer, {
+                                "type": "error",
+                                "message": f"no such peer in room: {to}"})
+                            continue
+                    else:
+                        others = self._others(peer)
+                        if not others:
+                            await self._send(peer, {
+                                "type": "error", "message": "no peer in room"})
+                            continue
+                        if len(others) > 1:
+                            await self._send(peer, {
+                                "type": "error",
+                                "message": "ambiguous relay target: "
+                                           "specify to=<peerId>"})
+                            continue
+                        target = others[0]
                     relay = dict(msg)
                     relay["from"] = peer.peer_id
-                    await self._send(other, relay)
+                    relay.pop("to", None)
+                    await self._send(target, relay)
 
                 elif mtype == "bye":
                     if peer is not None:
@@ -201,12 +274,16 @@ def main(argv: Optional[list] = None) -> None:
     ap = argparse.ArgumentParser(description="tunnel signal server")
     ap.add_argument("--listen", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8787)
+    ap.add_argument("--max-serve-peers", type=int, default=MAX_SERVE_PEERS,
+                    help="serve peers allowed per role-tagged room")
     args = ap.parse_args(argv)
     from p2p_llm_tunnel_tpu.utils.logging import init_logging
 
     init_logging()
     try:
-        asyncio.run(SignalServer(args.listen, args.port).serve_forever())
+        asyncio.run(SignalServer(
+            args.listen, args.port, max_serve_peers=args.max_serve_peers,
+        ).serve_forever())
     except KeyboardInterrupt:
         pass
 

@@ -27,6 +27,11 @@ Spec grammar (comma-separated ``key=value``):
                       slow-reader/bandwidth-cap fault of ISSUE 7: a WAN
                       client draining at modem speed; exercises FLOW-credit
                       backpressure without losing a single frame)
+- ``kill=N``        — peer death: after N messages, CLOSE the channel (both
+                      directions, like a process kill — ISSUE 8's per-peer
+                      failover fault; deterministic in message count like
+                      partition, so a seeded multi-peer run murders the
+                      same peer at the same frame every time)
 - ``seed=N``        — RNG seed for the schedule (default 0)
 
 Faults apply on the SEND side only; ``recv``/lifecycle delegate to the
@@ -41,7 +46,7 @@ import random
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-from p2p_llm_tunnel_tpu.transport.base import Channel
+from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -71,6 +76,10 @@ class ChaosSpec:
     #: draw — so the schedule part of the determinism contract holds (the
     #: DELAY is wall-clock, like stall durations).
     bw_bytes_per_s: float = 0.0
+    #: Kill the channel outright after this many messages (0 = off).  The
+    #: send that would be message N closes the channel instead — the
+    #: ChannelClosed every layer above must survive (ISSUE 8 failover).
+    kill_after: int = 0
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosSpec":
@@ -102,6 +111,12 @@ class ChaosSpec:
                     if kw["bw_bytes_per_s"] <= 0:
                         raise ChaosSpecError(
                             f"bw must be > 0 bytes/s, got {val!r}"
+                        )
+                elif key == "kill":
+                    kw["kill_after"] = int(val)
+                    if kw["kill_after"] <= 0:
+                        raise ChaosSpecError(
+                            f"kill must be > 0 messages, got {val!r}"
                         )
                 else:
                     raise ChaosSpecError(f"unknown chaos key {key!r}")
@@ -153,6 +168,14 @@ class ChaosChannel(Channel):
         idx = self._sent
         self._sent += 1
         spec = self.spec
+        if spec.kill_after and idx >= spec.kill_after:
+            # Peer death: the channel closes under the sender (both
+            # directions — close() cascades to the inner transport, which
+            # a loopback pair propagates to the peer).  Checked BEFORE the
+            # RNG draws: no message after the kill exists to schedule.
+            self.faults.append((idx, "kill"))
+            self.close()
+            raise ChannelClosed("chaos kill schedule fired")
         # One RNG draw per independent fault, ALWAYS consumed in the same
         # order regardless of which faults fire — the schedule for message
         # n never depends on what happened to messages < n.

@@ -76,6 +76,7 @@ async def connect(
     stun_server: Optional[str] = None,
     relay: Optional[str] = None,
     relay_secret: Optional[str] = None,
+    role: Optional[str] = None,
 ) -> Tuple[Channel, SignalingClient]:
     """Rendezvous in ``room`` and return an established data channel.
 
@@ -84,13 +85,20 @@ async def connect(
     ``relay`` ('host[:port]') names the encrypted-blind relay both peers
     fall back to when direct punching times out (rtc.rs:55-63 equivalent).
 
+    ``role`` opts into the fabric's role-tagged rooms (ISSUE 8):
+    ``"serve"`` joins as one of N provider peers and ALWAYS answers (the
+    proxy is the fabric's sole offerer), ignoring other serve peers'
+    comings and goings.  ``None`` keeps the legacy arrival-order election
+    in 2-peer rooms.  (The proxy side of a fabric room dials through
+    ``transport.fabric``, not here.)
+
     The caller owns both returned objects; close the signaling client once
     the channel is up if trickle candidates are no longer needed.
     """
     try:
         return await asyncio.wait_for(
             _connect_inner(signal_url, room, transport, stun_server, relay,
-                           relay_secret),
+                           relay_secret, role),
             timeout,
         )
     except asyncio.TimeoutError:
@@ -101,6 +109,7 @@ async def _connect_inner(
     signal_url: str, room: str, transport: str,
     stun_server: Optional[str], relay: Optional[str],
     relay_secret: Optional[str] = None,
+    role: Optional[str] = None,
 ) -> Tuple[Channel, SignalingClient]:
     # Validate any TUNNEL_CHAOS spec BEFORE any resource exists: a typo'd
     # spec must fail fast, not leak an established channel per retry.
@@ -108,13 +117,26 @@ async def _connect_inner(
 
     ChaosSpec.parse(os.environ.get(ENV_VAR, ""))
 
-    signaling = await SignalingClient.connect(signal_url, room)
+    signaling = await SignalingClient.connect(signal_url, room,
+                                              role=role or "")
     try:
         joined = await _expect(signaling, Joined)
         observed_ip: Optional[str] = (
             joined.observed[0] if joined.observed else None
         )
-        if not joined.peers:
+        if role == "serve":
+            # Fabric serve peer: wait for the proxy's targeted offer; a
+            # DIFFERENT serve peer leaving must not abort this dance, so
+            # establishment runs tolerant of unrelated peer-left events
+            # (the outer connect() timeout still bounds the wait).
+            log.info("room %r joined as serve peer; awaiting proxy offer",
+                     room)
+            channel = await _establish(signaling, room, observed_ip,
+                                       transport, offerer=False,
+                                       stun_server=stun_server, relay=relay,
+                                       relay_secret=relay_secret,
+                                       tolerant=True)
+        elif not joined.peers:
             log.info("room %r empty; waiting for a peer (offerer role)", room)
             await _expect(signaling, PeerJoined)
             channel = await _establish(signaling, room, observed_ip, transport,
@@ -135,8 +157,13 @@ async def _connect_inner(
         raise
 
 
-async def _expect(signaling: SignalingClient, kind):
-    """Wait for one message of ``kind``; error/peer-left/EOF raise."""
+async def _expect(signaling: SignalingClient, kind, tolerant: bool = False):
+    """Wait for one message of ``kind``; error/peer-left/EOF raise.
+
+    ``tolerant`` ignores peer-left events instead of raising — fabric
+    rooms see unrelated serve peers leave mid-establishment; the caller's
+    timeout bounds the wait when the RELEVANT peer is the one that left.
+    """
     while True:
         msg = await signaling.recv()
         if msg is None:
@@ -145,7 +172,7 @@ async def _expect(signaling: SignalingClient, kind):
             return msg
         if isinstance(msg, SignalError):
             raise ConnectError(f"signaling error: {msg.message}")
-        if isinstance(msg, PeerLeft):
+        if isinstance(msg, PeerLeft) and not tolerant:
             raise ConnectError("peer left during establishment")
         log.debug("ignoring %s while waiting for %s", type(msg).__name__, kind.__name__)
 
@@ -176,6 +203,7 @@ async def _establish(
     stun_server: Optional[str] = None,
     relay: Optional[str] = None,
     relay_secret: Optional[str] = None,
+    tolerant: bool = False,
 ) -> Channel:
     keys = HandshakeKeys()
     channel: Optional[UdpChannel] = None
@@ -251,11 +279,16 @@ async def _establish(
         # -- SDP exchange --------------------------------------------------
         if offerer:
             await signaling.send_offer(sdp)
-            answer = await _expect(signaling, Answer)
+            answer = await _expect(signaling, Answer, tolerant)
             remote = answer.sdp
         else:
-            offer = await _expect(signaling, Offer)
+            offer = await _expect(signaling, Offer, tolerant)
             remote = offer.sdp
+            if offer.sender and getattr(signaling, "reply_to", None) is not None:
+                # N-peer rooms: the answer (and any trickled candidates)
+                # must target the offerer — an untargeted relay is
+                # ambiguous once the room holds more than two peers.
+                signaling.reply_to = offer.sender
             await signaling.send_answer(sdp)
 
         if remote.get("kind") != transport:
@@ -384,6 +417,10 @@ async def _accept_trickle(
         if msg is None:
             return
         if isinstance(msg, Candidate):
+            expected = getattr(signaling, "reply_to", "")
+            if expected and msg.sender and msg.sender != expected:
+                # Fabric rooms: another peer's trickle is not ours to punch.
+                continue
             c = msg.candidate
             if c.get("ip") is None or c.get("port") is None:
                 continue

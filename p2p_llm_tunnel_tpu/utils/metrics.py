@@ -74,6 +74,25 @@ METRICS_CATALOG: Dict[str, str] = {
     "proxy_body_bytes_total": "response body bytes relayed to clients (counter)",
     "proxy_streams_in_flight": "open tunnel streams (gauge)",
     "proxy_ttfb_ms": "first response byte per proxied request (histogram, ms)",
+    # -- multi-peer fabric (ISSUE 8) -------------------------------------
+    "proxy_peers_live": (
+        "serve peers currently dispatchable (live + degraded) in the "
+        "proxy's PeerSet (gauge; 0 means every request 503s)"
+    ),
+    "proxy_failover_ms": (
+        "peer-death -> re-dispatched request streaming again on a "
+        "surviving peer (histogram, ms; the measured recovery time of a "
+        "failover, one sample per re-dispatched request)"
+    ),
+    "proxy_redispatch_total": (
+        "requests transparently re-dispatched to a surviving peer after "
+        "their serve peer died before streaming (counter)"
+    ),
+    "proxy_circuit_open_total": (
+        "per-peer circuit-breaker openings after consecutive dispatch "
+        "failures (counter; an open breaker sheds dispatches until its "
+        "half-open probe succeeds)"
+    ),
     # -- transport -------------------------------------------------------
     "transport_cwnd": "ARQ congestion window, packets (gauge)",
     "transport_in_flight": "unacked ARQ packets (gauge)",

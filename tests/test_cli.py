@@ -50,8 +50,12 @@ def test_run_with_retry_backoff_and_recovery():
 
     asyncio.run(main())
     assert len(calls) == 3
-    # backoff = 2*2^(attempt-1): 2s then 4s (main.rs:142)
-    assert sleeps == [2.0, 4.0]
+    # backoff = 2*2^(attempt-1) (main.rs:142) times a [1, 1.25) jitter
+    # factor (ISSUE 8: a fleet killed by one fault must not redial the
+    # signal server in lockstep).
+    assert len(sleeps) == 2
+    for base, got in zip([2.0, 4.0], sleeps):
+        assert base <= got < base * 1.25
 
 
 def test_run_with_retry_caps_at_60s():
@@ -73,8 +77,10 @@ def test_run_with_retry_caps_at_60s():
             asyncio.sleep = real_sleep
 
     asyncio.run(main())
-    assert sleeps[-1] == 60.0  # capped (main.rs:16)
-    assert sleeps[:3] == [2.0, 4.0, 8.0]
+    # Capped at 60 s (main.rs:16) BEFORE the [1, 1.25) jitter factor.
+    assert 60.0 <= sleeps[-1] < 60.0 * 1.25
+    for base, got in zip([2.0, 4.0, 8.0], sleeps[:3]):
+        assert base <= got < base * 1.25
 
 
 def test_run_with_retry_cancellable_during_backoff():

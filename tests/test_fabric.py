@@ -1,0 +1,910 @@
+"""Multi-peer tunnel fabric (ISSUE 8): PeerSet policy units + loopback e2e.
+
+The proxy's single channel became a supervised PeerSet — these tests pin
+the dispatch policy (health-aware least-loaded, circuit breaker, typed
+aborts) at the unit level and the failover contract end to end over
+loopback channels: a request whose serve peer dies BEFORE streaming is
+transparently re-dispatched to a survivor; one already streaming gets a
+typed ``peer_lost`` terminal event instead of a silent truncation.
+"""
+
+import asyncio
+import contextlib
+import json
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints import http11
+from p2p_llm_tunnel_tpu.endpoints.peerset import (
+    CB_THRESHOLD,
+    PEER_DEAD,
+    PEER_DEGRADED,
+    PEER_DRAINING,
+    PEER_LIVE,
+    PeerLink,
+    PeerSet,
+    _Error,
+)
+from p2p_llm_tunnel_tpu.endpoints.proxy import (
+    PEER_LOST_RETRY_AFTER_S,
+    ProxyState,
+    run_proxy_fabric,
+)
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+from p2p_llm_tunnel_tpu.protocol.frames import TunnelMessage
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+# ---------------------------------------------------------------------------
+# PeerSet policy units (no tunnel, stub links)
+# ---------------------------------------------------------------------------
+
+
+def _stub_link(ps: PeerSet, pid: str, state: str = PEER_LIVE,
+               inflight: int = 0) -> PeerLink:
+    ch, _ = loopback_pair()
+    link = PeerLink(pid, ch)
+    link.ready = True
+    link.state = state
+    for i in range(inflight):
+        link.pending[i] = asyncio.Queue()  # tunnelcheck: disable=TC10  test stub: fixed-size fake inflight set
+    ps.peers[pid] = link
+    return link
+
+
+def test_pick_prefers_live_then_least_loaded():
+    ps = PeerSet()
+    _stub_link(ps, "a", inflight=2)
+    b = _stub_link(ps, "b", inflight=1)
+    _stub_link(ps, "c", PEER_DEGRADED, inflight=0)
+    # Live beats degraded even at higher load; among live, least-loaded.
+    assert ps.pick() is b
+
+
+def test_pick_uses_degraded_only_without_live():
+    ps = PeerSet()
+    _stub_link(ps, "a", PEER_DRAINING)
+    b = _stub_link(ps, "b", PEER_DEGRADED)
+    assert ps.pick() is b
+    b.state = PEER_DEAD
+    assert ps.pick() is None
+
+
+def test_pick_respects_exclusions():
+    ps = PeerSet()
+    a = _stub_link(ps, "a")
+    b = _stub_link(ps, "b", inflight=3)
+    assert ps.pick(exclude=("a",)) is b
+    assert ps.pick(exclude=("a", "b")) is None
+    # The failover loop's fallback: a full exclusion set re-picks from
+    # everyone rather than failing while a peer still lives.
+    assert ps.pick() in (a, b)
+
+
+def test_circuit_breaker_opens_after_threshold_and_half_opens():
+    ps = PeerSet(fabric=True)
+    link = _stub_link(ps, "a")
+    for _ in range(CB_THRESHOLD):
+        ps.record_failure(link)
+    assert link.breaker_open()
+    assert ps.pick() is None  # cooldown: not dispatchable
+    # Cooldown elapsed -> exactly one half-open probe.
+    link.breaker_until = 0.0
+    probe = ps.pick()
+    assert probe is link and link.half_open_inflight
+    assert ps.pick() is None  # a second pick must NOT pile onto the probe
+    ps.record_success(link)
+    assert link.consec_failures == 0 and not link.breaker_open()
+    assert ps.pick() is link
+
+
+def test_breaker_reopen_doubles_cooldown_and_counts():
+    ps = PeerSet(fabric=True)
+    link = _stub_link(ps, "a")
+    before = global_metrics.counter("proxy_circuit_open_total")
+    for _ in range(CB_THRESHOLD):
+        ps.record_failure(link)
+    first_level = link.breaker_level
+    # Half-open probe fails -> breaker re-opens at the next level.
+    link.breaker_until = 0.0
+    assert ps.pick() is link
+    for _ in range(1):
+        ps.record_failure(link)
+    assert link.breaker_level == first_level + 1
+    assert global_metrics.counter("proxy_circuit_open_total") == before + 2
+
+
+def test_mark_dead_aborts_pending_with_typed_error():
+    async def main():
+        ps = PeerSet()
+        link = _stub_link(ps, "a")
+        q: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test stub
+        link.pending[7] = q
+        ps.mark_dead(link, TunnelMessage.typed_error(
+            0, "peer_lost", "tunnel closed"))
+        ev = q.get_nowait()
+        assert isinstance(ev, _Error) and ev.code == "peer_lost"
+        assert "a" not in ps.peers and link.state == PEER_DEAD
+
+    run(main())
+
+
+def test_apply_health_transitions():
+    ps = PeerSet()
+    link = _stub_link(ps, "a")
+    ps.apply_health(link, "degraded")
+    assert link.state == PEER_DEGRADED
+    ps.apply_health(link, "ok")
+    assert link.state == PEER_LIVE
+    ps.apply_health(link, "draining")
+    assert link.state == PEER_DRAINING
+    # Draining is terminal for dispatch: an "ok" probe later must not
+    # resurrect it (the peer is finishing its in-flight work and dying).
+    ps.apply_health(link, "ok")
+    assert link.state == PEER_DRAINING
+
+
+# ---------------------------------------------------------------------------
+# loopback e2e: failover semantics
+# ---------------------------------------------------------------------------
+
+
+async def _start_peer(state: ProxyState, pid: str, backend):
+    """One serve peer over loopback, admitted into ``state``."""
+    serve_ch, proxy_ch = loopback_pair()
+    task = asyncio.create_task(run_serve(serve_ch, backend=backend))
+    link = await state.admit(proxy_ch, peer_id=pid)
+    return serve_ch, proxy_ch, task, link
+
+
+@contextlib.asynccontextmanager
+async def _fabric_listener(state: ProxyState):
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    task = asyncio.create_task(
+        run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+    port = await asyncio.wait_for(ready, 5)
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+def test_redispatch_before_streaming_survives_peer_death():
+    """A request in-dispatch (no headers yet) on a dying peer lands on the
+    survivor transparently: the client sees ONE 200, never the death."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate_a = asyncio.Event()
+
+        async def backend_a(req, body):
+            await gate_a.wait()  # holds the request pre-headers forever
+
+            async def chunks():
+                yield b"from-A"
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        async def backend_b(req, body):
+            async def chunks():
+                yield b"from-B"
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        async with _fabric_listener(state) as base:
+            _, proxy_a, task_a, link_a = await _start_peer(
+                state, "peer-a", backend_a)
+            redisp0 = global_metrics.counter("proxy_redispatch_total")
+            req = asyncio.create_task(
+                http11.http_request("GET", f"{base}/gen", timeout=10))
+            while link_a.inflight != 1:
+                await asyncio.sleep(0.01)
+            # Survivor joins, then the dispatched-to peer dies.
+            _, _, task_b, _ = await _start_peer(state, "peer-b", backend_b)
+            proxy_a.close()
+            resp = await req
+            assert resp.status == 200
+            assert await resp.read_all() == b"from-B"
+            assert global_metrics.counter(
+                "proxy_redispatch_total") == redisp0 + 1
+            # The failover recovery time was measured.
+            assert global_metrics.percentile("proxy_failover_ms", 50) > 0.0
+            for t in (task_a, task_b):
+                t.cancel()
+            await asyncio.gather(task_a, task_b, return_exceptions=True)
+
+    run(main())
+
+
+def test_midstream_peer_loss_gets_typed_sse_event_then_no_peer_503():
+    """A stream that already reached the client cannot be re-dispatched:
+    it must end with a typed peer_lost SSE event (not a silent truncation),
+    and subsequent requests get the typed no-live-peer 503 + Retry-After
+    (distinct from the pre-handshake 'Tunnel not ready')."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        hold = asyncio.Event()
+
+        async def backend(req, body):
+            async def chunks():
+                yield b"data: start\n\n"
+                await hold.wait()  # killed mid-stream
+                yield b"data: never\n\n"
+
+            return 200, {"content-type": "text/event-stream"}, chunks()
+
+        async with _fabric_listener(state) as base:
+            _, proxy_ch, task, _ = await _start_peer(state, "peer-a", backend)
+            resp = await http11.http_request("GET", f"{base}/sse", timeout=10)
+            assert resp.status == 200
+            chunks = resp.iter_chunks()
+            first = await chunks.__anext__()
+            assert b"start" in first
+            proxy_ch.close()
+            rest = b""
+            async for c in chunks:
+                rest += c
+            event = json.loads(rest.split(b"data: ", 1)[1])
+            assert event["error"]["code"] == "peer_lost"
+            assert event["error"]["retry_after_s"] == PEER_LOST_RETRY_AFTER_S
+
+            # Every peer is gone but the tunnel WAS up: typed 503.
+            r2 = await http11.http_request("GET", f"{base}/x", timeout=5)
+            assert r2.status == 503
+            assert b"[peer_lost]" in await r2.read_all()
+            assert r2.headers.get("retry-after") == str(PEER_LOST_RETRY_AFTER_S)
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_dispatch_balances_least_loaded_across_three_peers():
+    async def main():
+        state = ProxyState(fabric=True)
+        gate = asyncio.Event()
+
+        def make_backend(name):
+            async def backend(req, body):
+                await gate.wait()
+
+                async def chunks():
+                    yield name.encode()
+
+                return 200, {"content-type": "text/plain"}, chunks()
+
+            return backend
+
+        async with _fabric_listener(state) as base:
+            peers = []
+            for i in range(3):
+                peers.append(await _start_peer(
+                    state, f"peer{i}", make_backend(f"peer{i}")))
+            reqs = [
+                asyncio.create_task(
+                    http11.http_request("GET", f"{base}/g", timeout=10))
+                for _ in range(6)
+            ]
+            while state.total_pending() != 6:
+                await asyncio.sleep(0.01)
+            # Least-loaded dispatch: 6 requests over 3 idle peers -> 2 each.
+            assert [link.inflight for (_, _, _, link) in peers] == [2, 2, 2]
+            gate.set()
+            bodies = []
+            for r in reqs:
+                resp = await r
+                assert resp.status == 200
+                bodies.append(await resp.read_all())
+            assert sorted(bodies) == sorted(
+                [b"peer0", b"peer0", b"peer1", b"peer1", b"peer2", b"peer2"])
+            for (_, _, t, _) in peers:
+                t.cancel()
+            await asyncio.gather(
+                *[t for (_, _, t, _) in peers], return_exceptions=True)
+
+    run(main())
+
+
+def test_healthz_local_reports_fabric_snapshot():
+    async def main():
+        state = ProxyState(fabric=True)
+
+        async def backend(req, body):
+            async def chunks():
+                yield b"ok"
+
+            return 200, {}, chunks()
+
+        async with _fabric_listener(state) as base:
+            _, proxy_ch, task, _ = await _start_peer(state, "p0", backend)
+            r = await http11.http_request(
+                "GET", f"{base}/healthz?local=1", timeout=5)
+            snap = json.loads(await r.read_all())
+            assert r.status == 200 and snap["status"] == "ok"
+            assert snap["peers_live"] == 1
+            assert snap["peers"]["p0"]["state"] == "live"
+            assert {"redispatch_total", "circuit_open_total",
+                    "failover_p50_ms"} <= set(snap)
+
+            # Must keep answering when every peer is down — that is
+            # exactly when an operator needs it.
+            proxy_ch.close()
+            while state.peers:
+                await asyncio.sleep(0.01)
+            r = await http11.http_request(
+                "GET", f"{base}/healthz?local=1", timeout=5)
+            snap = json.loads(await r.read_all())
+            assert r.status == 503 and snap["status"] == "down"
+            assert snap["peers_live"] == 0
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    run(main())
+
+
+def test_single_peer_proxystate_keeps_classic_surface():
+    """run_proxy's ProxyState(channel) construction: pre-handshake requests
+    still answer 'Tunnel not ready' (ever_ready False) and the channel
+    attribute survives for callers that poke it."""
+
+    async def main():
+        ch, _peer = loopback_pair()
+        state = ProxyState(ch)
+        assert state.channel is ch
+        assert not state.tunnel_ready
+        from p2p_llm_tunnel_tpu.endpoints.http11 import HttpRequest
+        from p2p_llm_tunnel_tpu.endpoints.proxy import handle_proxy_request
+
+        resp = await handle_proxy_request(
+            state, HttpRequest("GET", "/x", {}, b""))
+        assert resp.status == 503 and resp.body == b"Tunnel not ready"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# role-tagged room logic WITHOUT websockets: the server handler is
+# duck-typed over its socket, so fake sockets exercise the fabric room
+# semantics even where the optional dep is absent (tests/test_signaling.py
+# covers the same contract over real sockets when websockets is installed).
+# ---------------------------------------------------------------------------
+
+
+class _FakeWs:
+    remote_address = ("127.0.0.1", 4242)
+
+    def __init__(self):
+        self.inbox: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test driver: scripted handful of messages
+        self.sent = []
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        m = await self.inbox.get()
+        if m is None:
+            raise StopAsyncIteration
+        return m
+
+    async def send(self, data):
+        self.sent.append(json.loads(data))
+
+    def push(self, obj):
+        self.inbox.put_nowait(json.dumps(obj))
+
+    async def pop(self, timeout=5.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.sent:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.001)
+        return self.sent.pop(0)
+
+
+def _room_server(max_serve_peers=32):
+    from p2p_llm_tunnel_tpu.signaling.server import SignalServer
+
+    return SignalServer(max_serve_peers=max_serve_peers)
+
+
+def test_room_roles_caps_and_fanout_fake_sockets():
+    async def main():
+        server = _room_server(max_serve_peers=2)
+        socks = [_FakeWs() for _ in range(6)]
+        tasks = [asyncio.create_task(server._handle(ws)) for ws in socks]
+        p, s1, s2, p2, s3, x = socks
+
+        p.push({"type": "join", "room": "fab", "role": "proxy"})
+        jp = await p.pop()
+        assert jp["type"] == "joined" and jp["roles"] == {}
+
+        s1.push({"type": "join", "room": "fab", "role": "serve"})
+        js1 = await s1.pop()
+        assert js1["roles"] == {jp["peerId"]: "proxy"}
+        ev = await p.pop()
+        assert ev["type"] == "peer-joined" and ev["role"] == "serve"
+
+        s2.push({"type": "join", "room": "fab", "role": "serve"})
+        js2 = await s2.pop()
+        assert js2["roles"] == {jp["peerId"]: "proxy", js1["peerId"]: "serve"}
+        # peer-joined fans out to EVERY occupant, not just "the other one".
+        assert (await p.pop())["type"] == "peer-joined"
+        assert (await s1.pop())["type"] == "peer-joined"
+
+        # Per-role caps: a second proxy and a third serve are refused.
+        p2.push({"type": "join", "room": "fab", "role": "proxy"})
+        got = await p2.pop()
+        assert got["type"] == "error" and "proxy" in got["message"]
+        s3.push({"type": "join", "room": "fab", "role": "serve"})
+        got = await s3.pop()
+        assert got["type"] == "error" and "full" in got["message"]
+        # Unknown roles are refused loudly, not silently untagged.
+        x.push({"type": "join", "room": "fab", "role": "router"})
+        got = await x.pop()
+        assert got["type"] == "error" and "unknown role" in got["message"]
+
+        # Departure fans out to all survivors with the leaver's role.
+        s1.push({"type": "bye"})
+        for ws in (p, s2):
+            got = await ws.pop()
+            assert got["type"] == "peer-left"
+            assert got["peerId"] == js1["peerId"] and got["role"] == "serve"
+
+        for ws in socks:
+            ws.inbox.put_nowait(None)
+        await asyncio.gather(*tasks)
+
+    run(main())
+
+
+def test_room_targeted_relay_fake_sockets():
+    async def main():
+        server = _room_server()
+        socks = [_FakeWs() for _ in range(3)]
+        tasks = [asyncio.create_task(server._handle(ws)) for ws in socks]
+        p, s1, s2 = socks
+
+        p.push({"type": "join", "room": "fab2", "role": "proxy"})
+        jp = await p.pop()
+        s1.push({"type": "join", "room": "fab2", "role": "serve"})
+        js1 = await s1.pop()
+        await p.pop()  # peer-joined s1
+        s2.push({"type": "join", "room": "fab2", "role": "serve"})
+        js2 = await s2.pop()
+        await p.pop()  # peer-joined s2
+        await s1.pop()  # peer-joined s2
+
+        # Untargeted relay is ambiguous once the room holds 3 peers.
+        p.push({"type": "offer", "sdp": {"kind": "udp"}})
+        got = await p.pop()
+        assert got["type"] == "error" and "ambiguous" in got["message"]
+
+        # Targeted offer reaches exactly the addressee, from= stamped,
+        # to= stripped (the recipient must not see routing internals).
+        p.push({"type": "offer", "sdp": {"n": 2}, "to": js2["peerId"]})
+        got = await s2.pop()
+        assert got == {"type": "offer", "sdp": {"n": 2},
+                       "from": jp["peerId"]}
+        assert not s1.sent  # the other serve peer saw nothing
+
+        # The answer targets the offerer back.
+        s2.push({"type": "answer", "sdp": {"a": 1}, "to": jp["peerId"]})
+        got = await p.pop()
+        assert got["type"] == "answer" and got["from"] == js2["peerId"]
+
+        # Unknown target errors back to the SENDER.
+        p.push({"type": "candidate", "candidate": {}, "to": "nope"})
+        got = await p.pop()
+        assert got["type"] == "error" and "no such peer" in got["message"]
+
+        # Legacy 2-peer rooms: untargeted relay still works (one other).
+        a, b = _FakeWs(), _FakeWs()
+        t2 = [asyncio.create_task(server._handle(ws)) for ws in (a, b)]
+        a.push({"type": "join", "room": "classic"})
+        ja = await a.pop()
+        b.push({"type": "join", "room": "classic"})
+        await b.pop()
+        await a.pop()  # peer-joined
+        b.push({"type": "offer", "sdp": {"kind": "udp"}})
+        got = await a.pop()
+        assert got["type"] == "offer" and got["from"]
+
+        for ws in socks + [a, b]:
+            ws.inbox.put_nowait(None)
+        await asyncio.gather(*tasks, *t2)
+
+    run(main())
+
+
+def test_signaling_client_parse_roles():
+    """The client's wire parser carries the fabric extension fields and
+    tolerates their absence (reference servers)."""
+    from p2p_llm_tunnel_tpu.signaling.client import (
+        Joined,
+        PeerJoined,
+        PeerLeft,
+        _parse,
+    )
+
+    j = _parse(json.dumps({
+        "type": "joined", "peerId": "me", "peers": ["a"],
+        "roles": {"a": "serve"},
+    }))
+    assert isinstance(j, Joined) and j.roles == {"a": "serve"}
+    j = _parse(json.dumps({"type": "joined", "peerId": "me", "peers": []}))
+    assert isinstance(j, Joined) and j.roles == {}
+    pj = _parse(json.dumps(
+        {"type": "peer-joined", "peerId": "a", "role": "serve"}))
+    assert isinstance(pj, PeerJoined) and pj.role == "serve"
+    pj = _parse(json.dumps({"type": "peer-joined", "peerId": "a"}))
+    assert isinstance(pj, PeerJoined) and pj.role == ""
+    pl = _parse(json.dumps(
+        {"type": "peer-left", "peerId": "a", "role": "proxy"}))
+    assert isinstance(pl, PeerLeft) and pl.role == "proxy"
+
+
+# ---------------------------------------------------------------------------
+# fabric dialer (transport/fabric.py) over a FAKE signaling client: the
+# room-watching / scoped-demux / bounded-retry logic is testable without
+# websockets — _establish is stubbed to hand back loopback channels.
+# ---------------------------------------------------------------------------
+
+from p2p_llm_tunnel_tpu.signaling.client import (  # noqa: E402
+    Answer,
+    Joined,
+    PeerJoined,
+    PeerLeft,
+)
+from p2p_llm_tunnel_tpu.transport import fabric as fabric_mod  # noqa: E402
+
+
+class _FakeSignalClient:
+    def __init__(self):
+        self.rx: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test driver: scripted handful of messages
+        self.closed = False
+        self.role = ""
+        self.reply_to = ""
+
+    async def recv(self, timeout=None):
+        return await self.rx.get()
+
+    async def send_offer(self, sdp, to=None):
+        pass
+
+    async def send_answer(self, sdp, to=None):
+        pass
+
+    async def send_candidate(self, c, to=None):
+        pass
+
+    async def close(self):
+        self.closed = True
+
+
+async def _ok_backend(req, body):
+    async def chunks():
+        yield b"ok"
+
+    return 200, {}, chunks()
+
+
+def _patch_fabric(monkeypatch, fake, establish):
+    class _Stub:
+        @staticmethod
+        async def connect(url, room, timeout=15.0, role=""):
+            fake.role = role
+            return fake
+
+    monkeypatch.setattr(fabric_mod, "SignalingClient", _Stub)
+    monkeypatch.setattr(fabric_mod, "_establish", establish)
+    monkeypatch.setattr(fabric_mod, "DIAL_BACKOFF_S", 0.01)
+    monkeypatch.setattr(fabric_mod, "DIAL_BACKOFF_MAX_S", 0.02)
+
+
+async def _until(cond, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.005)
+
+
+def test_fabric_dialer_admits_watches_and_caps(monkeypatch):
+    async def main():
+        state = ProxyState(fabric=True)
+        fake = _FakeSignalClient()
+        serve_tasks = []
+
+        async def establish(scope, room, observed_ip, transport, offerer,
+                            **kw):
+            assert offerer is True  # the proxy is the fabric's sole offerer
+            serve_ch, proxy_ch = loopback_pair()
+            serve_tasks.append(asyncio.create_task(
+                run_serve(serve_ch, backend=_ok_backend)))
+            return proxy_ch
+
+        _patch_fabric(monkeypatch, fake, establish)
+        dialer = asyncio.create_task(fabric_mod.run_fabric_dialer(
+            "ws://fake", "room", "udp", state, max_peers=2))
+        try:
+            # One serve peer already present at join; a second arrives.
+            fake.rx.put_nowait(Joined("me", ["s1"], None, {"s1": "serve"}))
+            await _until(lambda: "s1" in state.peers)
+            assert fake.role == "proxy"
+            fake.rx.put_nowait(PeerJoined("s2", "serve"))
+            await _until(lambda: "s2" in state.peers)
+
+            # --peers cap: a third serve peer is observed but not dialed.
+            fake.rx.put_nowait(PeerJoined("s3", "serve"))
+            await asyncio.sleep(0.05)
+            assert "s3" not in state.peers and len(state.peers) == 2
+
+            # Departure removes the link and aborts it typed.
+            fake.rx.put_nowait(PeerLeft("s1", "serve"))
+            await _until(lambda: "s1" not in state.peers)
+
+            # Signaling death ends the whole fabric session.
+            fake.rx.put_nowait(None)
+            await asyncio.wait_for(dialer, 5)
+            assert state.closed.is_set() and fake.closed
+        finally:
+            dialer.cancel()
+            for t in serve_tasks:
+                t.cancel()
+            await asyncio.gather(dialer, *serve_tasks,
+                                 return_exceptions=True)
+
+    run(main())
+
+
+def test_fabric_dialer_bounded_establish_retries(monkeypatch):
+    """A peer whose dials keep failing is retried DIAL_ATTEMPTS times with
+    backoff, then given up on (it must rejoin) — the dialer never loops
+    forever on one dead peer (tunnelcheck TC11's runtime twin)."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        fake = _FakeSignalClient()
+        attempts = {"s1": 0, "s2": 0}
+        serve_tasks = []
+
+        async def establish(scope, room, observed_ip, transport, offerer,
+                            **kw):
+            attempts[scope.peer_id] += 1
+            if scope.peer_id == "s2" or attempts["s1"] < 3:
+                raise RuntimeError("dial failed")
+            serve_ch, proxy_ch = loopback_pair()
+            serve_tasks.append(asyncio.create_task(
+                run_serve(serve_ch, backend=_ok_backend)))
+            return proxy_ch
+
+        _patch_fabric(monkeypatch, fake, establish)
+        dialer = asyncio.create_task(fabric_mod.run_fabric_dialer(
+            "ws://fake", "room", "udp", state))
+        try:
+            fake.rx.put_nowait(Joined(
+                "me", ["s1", "s2"], None, {"s1": "serve", "s2": "serve"}))
+            # s1 succeeds on its LAST allowed attempt.
+            await _until(lambda: "s1" in state.peers)
+            assert attempts["s1"] == fabric_mod.DIAL_ATTEMPTS
+            # s2 exhausts its attempts and is dropped, not retried forever.
+            await _until(lambda: attempts["s2"] == fabric_mod.DIAL_ATTEMPTS)
+            await asyncio.sleep(0.1)
+            assert attempts["s2"] == fabric_mod.DIAL_ATTEMPTS
+            assert "s2" not in state.peers
+        finally:
+            fake.rx.put_nowait(None)
+            for t in serve_tasks:
+                t.cancel()
+            await asyncio.gather(dialer, *serve_tasks,
+                                 return_exceptions=True)
+
+    run(main())
+
+
+def test_fabric_dialer_scoped_demux_routes_by_sender(monkeypatch):
+    """Signaling traffic is demuxed per dial scope: s1's answer reaches
+    s1's establishment dance; an unknown sender's message is dropped."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        fake = _FakeSignalClient()
+        got = {}
+        serve_tasks = []
+
+        async def establish(scope, room, observed_ip, transport, offerer,
+                            **kw):
+            msg = await scope.recv(timeout=5)
+            got[scope.peer_id] = msg
+            serve_ch, proxy_ch = loopback_pair()
+            serve_tasks.append(asyncio.create_task(
+                run_serve(serve_ch, backend=_ok_backend)))
+            return proxy_ch
+
+        _patch_fabric(monkeypatch, fake, establish)
+        dialer = asyncio.create_task(fabric_mod.run_fabric_dialer(
+            "ws://fake", "room", "udp", state))
+        try:
+            fake.rx.put_nowait(Joined("me", ["s1"], None, {"s1": "serve"}))
+            await asyncio.sleep(0.02)  # scope registered, establish waiting
+            fake.rx.put_nowait(Answer({"sdp": "ghost"}, "nobody"))  # dropped
+            fake.rx.put_nowait(Answer({"sdp": "for-s1"}, "s1"))
+            await _until(lambda: "s1" in state.peers)
+            assert got["s1"].sdp == {"sdp": "for-s1"}
+            assert got["s1"].sender == "s1"
+        finally:
+            fake.rx.put_nowait(None)
+            for t in serve_tasks:
+                t.cancel()
+            await asyncio.gather(dialer, *serve_tasks,
+                                 return_exceptions=True)
+
+    run(main())
+
+
+def test_fabric_metrics_in_catalog_and_exposition():
+    """The failover metrics are CATALOGUED (TC06) and ride the standard
+    Prometheus exposition — zero-valued when unwritten, so dashboards can
+    alert on `proxy_peers_live == 0` before the first failover ever
+    happens."""
+    from p2p_llm_tunnel_tpu.utils.metrics import METRICS_CATALOG
+
+    new = {"proxy_peers_live", "proxy_failover_ms",
+           "proxy_redispatch_total", "proxy_circuit_open_total"}
+    assert new <= set(METRICS_CATALOG)
+    text = global_metrics.prometheus_text()
+    for name in new:
+        assert name in text
+
+
+def test_classic_single_peer_mode_never_trips_the_breaker():
+    """The 1-peer PeerSet (run_proxy) has nowhere else to send: repeated
+    dispatch failures must NOT make it skip its only channel — the old
+    proxy forwarded everything, and that behavior is the contract."""
+    ps = PeerSet()  # fabric=False: the classic construction
+    link = _stub_link(ps, "a")
+    before = global_metrics.counter("proxy_circuit_open_total")
+    for _ in range(CB_THRESHOLD * 2):
+        ps.record_failure(link)
+    assert not link.breaker_open()
+    assert ps.pick() is link
+    assert global_metrics.counter("proxy_circuit_open_total") == before
+
+
+def test_non_idempotent_request_not_replayed_after_full_send():
+    """A POST that reached the dying peer whole may already have executed
+    there: failover must surface the typed peer_lost error instead of
+    silently re-executing it on a survivor — unless the client marked it
+    replay-safe with x-tunnel-idempotent: 1."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate_a = asyncio.Event()
+        b_calls = []
+
+        async def backend_a(req, body):
+            await gate_a.wait()  # holds the POST pre-headers forever
+
+            async def chunks():
+                yield b"from-A"
+
+            return 200, {}, chunks()
+
+        async def backend_b(req, body):
+            b_calls.append(req.path)
+
+            async def chunks():
+                yield b"from-B"
+
+            return 200, {}, chunks()
+
+        async def dispatch_post_and_kill(base, headers):
+            _, proxy_a, task_a, link_a = await _start_peer(
+                state, f"peer-a{len(b_calls)}", backend_a)
+            req = asyncio.create_task(http11.http_request(
+                "POST", f"{base}/gen", headers=headers, body=b"{}",
+                timeout=10))
+            while link_a.inflight != 1:
+                await asyncio.sleep(0.01)
+            _, _, task_b, _ = await _start_peer(
+                state, f"peer-b{len(b_calls)}", backend_b)
+            proxy_a.close()
+            resp = await req
+            task_a.cancel()
+            return resp, task_b
+
+        async with _fabric_listener(state) as base:
+            # Plain POST: fully sent, peer dies -> typed 502, NOT replayed.
+            resp, tb1 = await dispatch_post_and_kill(base, None)
+            body = await resp.read_all()
+            assert resp.status == 502
+            assert b"[peer_lost]" in body and b"non-idempotent" in body
+            assert resp.headers.get("retry-after") == str(
+                PEER_LOST_RETRY_AFTER_S)
+            assert b_calls == []  # the survivor never saw it
+
+            # Same dance with the opt-in header: replayed, one 200.
+            state.peers.clear()  # drop the dead-test leftovers
+            resp, tb2 = await dispatch_post_and_kill(
+                base, {"x-tunnel-idempotent": "1"})
+            assert resp.status == 200
+            assert await resp.read_all() == b"from-B"
+            assert b_calls == ["/gen"]
+            for t in (tb1, tb2):
+                t.cancel()
+            await asyncio.gather(tb1, tb2, return_exceptions=True)
+
+    run(main())
+
+
+def test_room_refuses_mixed_tagged_and_untagged_peers():
+    """A fabric peer must not slip into a legacy 2-peer room (or vice
+    versa): mixing would overfill the legacy pair and break its untargeted
+    relay with 'ambiguous relay target' mid-handshake."""
+
+    async def main():
+        server = _room_server()
+        socks = [_FakeWs() for _ in range(4)]
+        tasks = [asyncio.create_task(server._handle(ws)) for ws in socks]
+        a, fab, p, legacy = socks
+
+        # Legacy room first: a role-tagged join is refused.
+        a.push({"type": "join", "room": "r"})
+        await a.pop()
+        fab.push({"type": "join", "room": "r", "role": "serve"})
+        got = await fab.pop()
+        assert got["type"] == "error" and "legacy" in got["message"]
+
+        # Fabric room: an untagged join is refused.
+        p.push({"type": "join", "room": "f", "role": "proxy"})
+        await p.pop()
+        legacy.push({"type": "join", "room": "f"})
+        got = await legacy.pop()
+        assert got["type"] == "error" and "fabric" in got["message"]
+
+        for ws in socks:
+            ws.inbox.put_nowait(None)
+        await asyncio.gather(*tasks)
+
+    run(main())
+
+
+def test_midstream_peer_loss_typed_ndjson_line():
+    """The ollama-style /api/generate stream is NDJSON, not SSE: a
+    mid-stream peer death must end it with a typed {"error": ...} LINE in
+    the stream's own vocabulary (found via the real-engine verify drive,
+    where the primary generation surface was silently truncated)."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        hold = asyncio.Event()
+
+        async def backend(req, body):
+            async def chunks():
+                yield b'{"response": "a", "done": false}\n'
+                await hold.wait()
+
+            return 200, {"content-type": "application/x-ndjson"}, chunks()
+
+        async with _fabric_listener(state) as base:
+            _, proxy_ch, task, _ = await _start_peer(state, "p0", backend)
+            resp = await http11.http_request("GET", f"{base}/gen", timeout=10)
+            chunks = resp.iter_chunks()
+            first = await chunks.__anext__()
+            assert b'"done": false' in first
+            proxy_ch.close()
+            rest = b""
+            async for c in chunks:
+                rest += c
+            event = json.loads(rest)
+            assert event["error"]["code"] == "peer_lost"
+            assert not rest.startswith(b"data: ")  # NDJSON framing, not SSE
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+
+    run(main())

@@ -141,3 +141,123 @@ def test_relay_without_peer_errors():
         await server.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# role-tagged fabric rooms (ISSUE 8): per-role caps, targeted relay, fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_role_tagged_room_roles_and_caps():
+    async def main():
+        server, url = await _start_server()
+        server.max_serve_peers = 2
+
+        p = await SignalingClient.connect(url, "fab", role="proxy")
+        jp = await p.recv(5)
+        assert isinstance(jp, Joined) and jp.roles == {}
+
+        s1 = await SignalingClient.connect(url, "fab", role="serve")
+        js1 = await s1.recv(5)
+        assert js1.roles == {jp.peer_id: "proxy"}
+        ev = await p.recv(5)
+        assert isinstance(ev, PeerJoined) and ev.role == "serve"
+
+        s2 = await SignalingClient.connect(url, "fab", role="serve")
+        js2 = await s2.recv(5)
+        assert js2.roles == {jp.peer_id: "proxy", js1.peer_id: "serve"}
+        # peer-joined fans out to EVERY occupant, not just "the other one".
+        assert isinstance(await p.recv(5), PeerJoined)
+        assert isinstance(await s1.recv(5), PeerJoined)
+
+        # Per-role caps: a second proxy and a third serve are both refused.
+        p2 = await SignalingClient.connect(url, "fab", role="proxy")
+        got = await p2.recv(5)
+        assert isinstance(got, SignalError) and "proxy" in got.message
+        s3 = await SignalingClient.connect(url, "fab", role="serve")
+        got = await s3.recv(5)
+        assert isinstance(got, SignalError) and "full" in got.message
+
+        # An unknown role is refused loudly, not silently untagged.
+        x = await SignalingClient.connect(url, "fab", role="router")
+        got = await x.recv(5)
+        assert isinstance(got, SignalError) and "unknown role" in got.message
+
+        for cl in (p, s1, s2, p2, s3, x):
+            await cl.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_targeted_relay_in_n_peer_room():
+    async def main():
+        server, url = await _start_server()
+        p = await SignalingClient.connect(url, "fab2", role="proxy")
+        jp = await p.recv(5)
+        s1 = await SignalingClient.connect(url, "fab2", role="serve")
+        js1 = await s1.recv(5)
+        s2 = await SignalingClient.connect(url, "fab2", role="serve")
+        js2 = await s2.recv(5)
+        await p.recv(5)  # peer-joined s1
+        await p.recv(5)  # peer-joined s2
+        await s1.recv(5)  # peer-joined s2
+
+        # Untargeted relay is ambiguous once the room holds 3 peers.
+        await p.send_offer({"sdp": "x"})
+        got = await p.recv(5)
+        assert isinstance(got, SignalError) and "ambiguous" in got.message
+
+        # Targeted offers reach exactly the addressed peer, from= stamped.
+        await p.send_offer({"sdp": "to-s2"}, to=js2.peer_id)
+        got = await s2.recv(5)
+        assert isinstance(got, Offer) and got.sdp == {"sdp": "to-s2"}
+        assert got.sender == jp.peer_id
+
+        # The answerer's reply_to pin targets the offerer without a `to`.
+        s2.reply_to = got.sender
+        await s2.send_answer({"sdp": "reply"})
+        got = await p.recv(5)
+        assert isinstance(got, Answer) and got.sender == js2.peer_id
+
+        # Targeting a peer outside the room errors back to the sender.
+        await p.send_offer({"sdp": "x"}, to="nope")
+        got = await p.recv(5)
+        assert isinstance(got, SignalError) and "no such peer" in got.message
+
+        # s1 must have seen none of the s2-addressed traffic.
+        await s1.send_candidate({"candidate": "c"}, to=jp.peer_id)
+        got = await p.recv(5)
+        assert isinstance(got, Candidate) and got.sender == js1.peer_id
+
+        for cl in (p, s1, s2):
+            await cl.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_peer_left_fans_out_with_role():
+    async def main():
+        server, url = await _start_server()
+        p = await SignalingClient.connect(url, "fab3", role="proxy")
+        await p.recv(5)
+        s1 = await SignalingClient.connect(url, "fab3", role="serve")
+        js1 = await s1.recv(5)
+        s2 = await SignalingClient.connect(url, "fab3", role="serve")
+        await s2.recv(5)
+        await p.recv(5)
+        await p.recv(5)
+        await s1.recv(5)
+
+        await s1.close()  # bye
+        for cl in (p, s2):
+            got = await cl.recv(5)
+            assert isinstance(got, PeerLeft)
+            assert got.peer_id == js1.peer_id and got.role == "serve"
+
+        await p.close()
+        await s2.close()
+        await server.stop()
+
+    run(main())

@@ -39,13 +39,21 @@ REP = list(b"the cat sat on the mat. the cat sat on the mat. the cat")
 
 
 def test_greedy_equivalence_and_acceptance():
+    # Acceptance needs the GREEDY STREAM (not just the prompt) to repeat
+    # its own n-grams: the random tiny model's trajectory settles into a
+    # cycle only after ~3 dozen tokens (the r2-r8 numerics work — int4,
+    # fused decode, mux — shifted where the cycle starts, which is what
+    # silently broke this test at the old 24-token horizon).  96 tokens
+    # reaches the cycle with margin while equivalence still binds every
+    # token.
     async def run(spec):
         engine = InferenceEngine(
-            engine_cfg=_cfg(spec_ngram=3 if spec else 0, spec_k=4))
+            engine_cfg=_cfg(spec_ngram=3 if spec else 0, spec_k=4,
+                            max_seq=256))
         await engine.start()
         try:
             global_metrics.reset()
-            out = await _collect(engine, REP)
+            out = await _collect(engine, REP, max_new=96)
             accepted = global_metrics.counter(
                 "engine_spec_accepted_tokens_total")
             return out, accepted
@@ -55,7 +63,7 @@ def test_greedy_equivalence_and_acceptance():
     plain, _ = asyncio.run(run(False))
     spec, accepted = asyncio.run(run(True))
     assert spec == plain, "speculation changed greedy output"
-    assert accepted > 0, "repetitive prompt never accepted a proposal"
+    assert accepted > 0, "repetitive stream never accepted a proposal"
 
 
 def test_stochastic_rows_identical_under_spec():

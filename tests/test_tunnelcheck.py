@@ -1454,3 +1454,219 @@ def test_tc10_waiver_names_the_backpressure_provider(tmp_path):
     )
     assert active == []
     assert rules_of(waived) == ["TC10"]
+
+
+# ---------------------------------------------------------------------------
+# TC11 — retry/backoff loops bounded + jittered (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_tc11_uncapped_unjittered_retry_loop_flags_both(tmp_path):
+    """The reference's bare exponential: grows without bound AND re-dials
+    a whole fleet in lockstep — one violation for each missing property."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+
+        async def reconnect(attempt_fn):
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    await attempt_fn()
+                    return
+                except Exception:
+                    pass
+                backoff = 2.0 * (2 ** (attempt - 1))
+                await asyncio.sleep(backoff)
+        """,
+        filename="transport/snippet.py",
+        rules=["TC11"],
+    )
+    assert rules_of(active) == ["TC11", "TC11"]
+    assert "without a bound" in active[0].message
+    assert "jitter" in active[1].message
+
+
+def test_tc11_self_doubling_augassign_is_growth(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        import random
+
+        async def redial():
+            backoff = 0.1
+            while True:
+                backoff *= 2
+                backoff *= 1.0 + random.uniform(0.0, 0.25)
+                await asyncio.sleep(backoff)
+        """,
+        filename="endpoints/snippet.py",
+        rules=["TC11"],
+    )
+    # Jittered, but `backoff *= 2` has no cap.
+    assert rules_of(active) == ["TC11"]
+    assert "without a bound" in active[0].message
+
+
+def test_tc11_capped_jittered_loop_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        import random
+
+        async def reconnect(attempt_fn):
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    await attempt_fn()
+                    return
+                except Exception:
+                    pass
+                backoff = min(2.0 * (2 ** (attempt - 1)), 60.0)
+                backoff *= 1.0 + random.uniform(0.0, 0.25)
+                await asyncio.sleep(backoff)
+        """,
+        filename="snippet/cli.py",
+        rules=["TC11"],
+    )
+    assert active == []
+
+
+def test_tc11_bounded_for_range_counts_as_the_attempt_bound(tmp_path):
+    """`for attempt in range(N)` bounds attempts even when the backoff
+    expression itself is a bare exponential — but jitter is still required
+    (and present here via the wait_for timeout spelling)."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+        import random
+
+        async def dial(stop):
+            for attempt in range(1, 4):
+                backoff = 1.0 * (2 ** (attempt - 1))
+                backoff *= 1.0 + random.uniform(0.0, 0.5)
+                try:
+                    await asyncio.wait_for(stop.wait(), backoff)
+                except asyncio.TimeoutError:
+                    pass
+        """,
+        filename="transport/snippet.py",
+        rules=["TC11"],
+    )
+    assert active == []
+
+
+def test_tc11_fixed_interval_loops_are_out_of_scope(tmp_path):
+    """Keepalives and probers sleep a CONSTANT interval — no growth, no
+    retry semantics, no finding."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+
+        PING_INTERVAL = 10.0
+
+        async def keepalive(ch):
+            while True:
+                await asyncio.sleep(PING_INTERVAL)
+                await ch.ping()
+        """,
+        filename="endpoints/snippet.py",
+        rules=["TC11"],
+    )
+    assert active == []
+
+
+def test_tc11_sleep_in_nested_def_does_not_attribute_to_outer_loop(tmp_path):
+    """A callback defined inside a loop runs when called, not per
+    iteration — its sleep belongs to no enclosing retry loop."""
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+
+        async def outer(items):
+            while True:
+                n = 2 ** 3
+
+                async def cb():
+                    await asyncio.sleep(0.1)
+
+                await register(cb)
+        """,
+        filename="transport/snippet.py",
+        rules=["TC11"],
+    )
+    assert active == []
+
+
+def test_tc11_out_of_scope_dirs_are_exempt(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        import asyncio
+
+        async def poll(attempt):
+            while True:
+                attempt += 1
+                backoff = 2 ** attempt
+                await asyncio.sleep(backoff)
+        """,
+        filename="engine/snippet.py",
+        rules=["TC11"],
+    )
+    assert active == []
+
+
+def test_tc11_waiver_names_the_bound(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        import asyncio
+
+        async def rto_loop(tries):
+            while True:
+                tries += 1
+                rto = 0.2 * (2 ** min(tries, 4))
+                await asyncio.sleep(rto)  # tunnelcheck: disable=TC11  exponent clamped at 2^4, jitter-free: pacing follows the measured RTT
+        """,
+        filename="transport/snippet.py",
+        rules=["TC11"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC11", "TC11"]
+
+
+def test_tc11_repo_retry_loops_are_detected_not_just_absent():
+    """Meta-fixture: strip the jitter multiply out of the REAL
+    cli.run_with_retry source and TC11 must fire — proving the shipped
+    loop passes because it satisfies the rule, not because the detector
+    misses it."""
+    import re
+
+    src = (REPO_ROOT / "p2p_llm_tunnel_tpu" / "cli.py").read_text()
+    stripped = re.sub(
+        r"backoff \*= 1\.0 \+ random\.uniform\(0\.0, 0\.25\)", "pass", src
+    )
+    assert stripped != src
+    active, _ = check_path_text(stripped)
+    assert any(
+        v.rule == "TC11" and "jitter" in v.message for v in active
+    ), "de-jittered run_with_retry must trip TC11"
+
+
+def check_path_text(text: str):
+    """Run only TC11 over literal file text named cli.py (scope by name)."""
+    import tempfile
+    from pathlib import Path as _P
+
+    with tempfile.TemporaryDirectory() as d:
+        f = _P(d) / "cli.py"
+        f.write_text(text)
+        return run_paths([f], rules=["TC11"])

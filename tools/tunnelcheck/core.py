@@ -421,6 +421,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_metrics,
         rules_protocol,
         rules_queues,
+        rules_retry,
         rules_tracing,
     )
 
@@ -435,6 +436,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC08": rules_config.check_tc08,
         "TC09": rules_tracing.check_tc09,
         "TC10": rules_queues.check_tc10,
+        "TC11": rules_retry.check_tc11,
     }
 
 
@@ -450,6 +452,7 @@ RULE_SUMMARIES = {
     "TC08": "EngineConfig field not wired to a cli.py flag (config rot)",
     "TC09": "span name not in utils.tracing.SPAN_CATALOG / span emission inside traced fns",
     "TC10": "unbounded Queue/deque in endpoints/transport/protocol without a backpressure waiver",
+    "TC11": "retry/backoff loop in cli.py/endpoints/transport without a cap+attempt bound or jitter",
 }
 
 

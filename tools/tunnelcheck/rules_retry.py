@@ -1,0 +1,254 @@
+"""TC11: every retry/backoff loop must be bounded AND jittered (ISSUE 8).
+
+A reconnect or re-dispatch loop that sleeps on a GROWING backoff is the
+fabric's herd-behavior control surface.  Without a cap it can sleep for
+hours before noticing a healthy peer; without an attempt bound it can court
+a dead peer forever; and without a jitter term a fleet of peers killed by
+the same fault re-dials the signal server in lockstep — the synchronized
+herd the reference's bare ``2·2^(n-1)`` exponential produces at scale.
+
+Detection is by dataflow fingerprint, not naming convention: a ``while`` /
+``for`` loop that sleeps (``asyncio.sleep``, ``time.sleep``, or an
+``asyncio.wait_for`` timeout) on a duration whose assignments *inside the
+loop* grow exponentially (``BASE * 2 ** attempt`` or self-multiplication
+like ``backoff *= 2``).  Fixed-interval loops (keepalives, probers) have no
+growth and are out of scope.  Each detected retry loop must:
+
+- bound its attempts (``for ... in range(N)``) or cap the backoff (the
+  growth expression wrapped in ``min(..., CAP)``), and
+- carry a jitter term (a ``random.*`` draw somewhere in the loop body,
+  e.g. ``backoff *= 1.0 + random.uniform(0.0, 0.25)``).
+
+An intentional exception carries a per-line waiver on the sleep NAMING the
+bound (e.g. ``# tunnelcheck: disable=TC11  RTO deadline capped by RTO_MAX,
+jitter-free by design: pacing follows the measured RTT``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.tunnelcheck.core import (
+    ProjectContext,
+    SourceFile,
+    Violation,
+    resolve_dotted,
+)
+
+#: Directories on the tunnel's reconnect/supervision path; cli.py (the
+#: retry supervisor) is scoped by filename.
+SCOPE_DIRS = frozenset({"endpoints", "transport"})
+
+SLEEP_FNS = frozenset({"asyncio.sleep", "time.sleep"})
+WAIT_FOR_FNS = frozenset({"asyncio.wait_for"})
+RANDOM_PREFIXES = ("random.", "numpy.random.", "secrets.")
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    return bool(SCOPE_DIRS & set(sf.path.parts)) or sf.path.name == "cli.py"
+
+
+def _contains_pow(expr: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.BinOp) and isinstance(n.op, ast.Pow)
+        for n in ast.walk(expr)
+    )
+
+
+def _contains_self_mult(expr: ast.AST, name: str) -> bool:
+    """``expr`` multiplies ``name`` by something (the `backoff *= 2` /
+    ``backoff = backoff * 2`` growth spelling)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Name) and side.id == name:
+                    return True
+    return False
+
+
+def _contains_random(expr: ast.AST, aliases: Dict[str, str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            resolved = resolve_dotted(n.func, aliases)
+            if resolved and resolved.startswith(RANDOM_PREFIXES):
+                return True
+    return False
+
+
+def _growth_inside_min(value: ast.AST, name: str) -> bool:
+    """Is the exponential/self-mult growth wrapped in a ``min(...)`` cap?"""
+    for n in ast.walk(value):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "min"):
+            for sub in ast.walk(n):
+                if _is_growth_node(sub, name):
+                    return True
+    return False
+
+
+def _is_growth_node(n: ast.AST, name: str) -> bool:
+    if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Pow):
+        return True
+    if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+        return any(
+            isinstance(s, ast.Name) and s.id == name
+            for s in (n.left, n.right)
+        )
+    return False
+
+
+@dataclass
+class _LoopInfo:
+    node: ast.AST
+    #: slept-name -> list of (value_expr, is_augassign_mult) assignments
+    assigns: Dict[str, List[Tuple[ast.AST, bool]]] = field(
+        default_factory=dict
+    )
+    has_jitter: bool = False
+    #: (call node, duration expression) for every sleep in THIS loop
+    #: (innermost attribution — a nested loop owns its own sleeps)
+    sleeps: List[Tuple[ast.Call, ast.AST]] = field(default_factory=list)
+
+    def bounded_for(self) -> bool:
+        return (
+            isinstance(self.node, (ast.For, ast.AsyncFor))
+            and isinstance(self.node.iter, ast.Call)
+            and isinstance(self.node.iter.func, ast.Name)
+            and self.node.iter.func.id == "range"
+        )
+
+
+def _duration_expr(call: ast.Call, resolved: str) -> Optional[ast.AST]:
+    if resolved in SLEEP_FNS:
+        return call.args[0] if call.args else None
+    # asyncio.wait_for(aw, timeout): the timeout IS the backoff when a
+    # retry loop waits on a stop/backoff race instead of a bare sleep.
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return kw.value
+    return None
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.stack: List[_LoopInfo] = []
+        self.loops: List[_LoopInfo] = []
+
+    # A nested def's body runs when called, not per iteration — its sleeps
+    # must not attribute to the enclosing loop (and loops inside it are
+    # scanned with a fresh stack).
+    def _visit_def(self, node) -> None:
+        saved, self.stack = self.stack, []
+        self.generic_visit(node)
+        self.stack = saved
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+    visit_Lambda = _visit_def
+
+    def _visit_loop(self, node) -> None:
+        info = _LoopInfo(node)
+        self.loops.append(info)
+        self.stack.append(info)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.stack and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name):
+            for info in self.stack:
+                info.assigns.setdefault(node.targets[0].id, []).append(
+                    (node.value, False))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.stack and isinstance(node.target, ast.Name) and isinstance(
+                node.op, ast.Mult):
+            for info in self.stack:
+                info.assigns.setdefault(node.target.id, []).append(
+                    (node.value, True))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            resolved = resolve_dotted(node.func, self.sf.aliases)
+            if resolved and resolved.startswith(RANDOM_PREFIXES):
+                for info in self.stack:
+                    info.has_jitter = True
+            if resolved in SLEEP_FNS or resolved in WAIT_FOR_FNS:
+                dur = _duration_expr(node, resolved)
+                if dur is not None:
+                    self.stack[-1].sleeps.append((node, dur))
+        self.generic_visit(node)
+
+
+def _analyze_loop(
+    info: _LoopInfo, aliases: Dict[str, str]
+) -> Optional[Tuple[ast.Call, bool]]:
+    """(anchor sleep call, growth_capped) when this is a retry loop whose
+    slept duration grows inside the loop; None otherwise."""
+    for call, dur in info.sleeps:
+        if _contains_pow(dur):
+            return call, _growth_inside_min(dur, "")
+        if not isinstance(dur, ast.Name):
+            continue
+        name = dur.id
+        growth: List[Tuple[ast.AST, bool]] = []
+        for value, is_aug_mult in info.assigns.get(name, ()):
+            if _contains_random(value, aliases):
+                continue  # the jitter multiply, not growth
+            if _contains_pow(value) or _contains_self_mult(value, name):
+                growth.append((value, is_aug_mult))
+            elif is_aug_mult and not (
+                    isinstance(value, ast.Constant)
+                    and isinstance(value.value, (int, float))
+                    and value.value <= 1):
+                # `backoff *= K`: growth unless K is a literal <= 1.
+                growth.append((value, True))
+        if growth:
+            capped = all(
+                not is_aug and _growth_inside_min(value, name)
+                for value, is_aug in growth
+            )
+            return call, capped
+    return None
+
+
+def check_tc11(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if not _in_scope(sf):
+        return iter(())
+    scanner = _Scanner(sf)
+    scanner.visit(sf.tree)
+    out: List[Violation] = []
+    for info in scanner.loops:
+        found = _analyze_loop(info, sf.aliases)
+        if found is None:
+            continue
+        anchor, capped = found
+        if not (info.bounded_for() or capped):
+            out.append(Violation(
+                "TC11", sf.path, anchor.lineno,
+                "retry loop's backoff grows without a bound — cap it with "
+                "min(..., MAX), bound attempts with `for ... in range(N)`, "
+                "or waive naming the bound",
+                end_line=anchor.end_lineno,
+            ))
+        if not info.has_jitter:
+            out.append(Violation(
+                "TC11", sf.path, anchor.lineno,
+                "retry loop sleeps a deterministic backoff — add a jitter "
+                "term (e.g. `backoff *= 1 + random.uniform(0, 0.25)`) so a "
+                "fleet killed by one fault does not re-dial in lockstep, "
+                "or waive explaining why lockstep is safe",
+                end_line=anchor.end_lineno,
+            ))
+    return iter(out)
