@@ -1,7 +1,7 @@
 # Dev commands — the reference uses a Justfile (Justfile:9-61); make is the
 # equivalent available in this toolchain.
 
-.PHONY: native native-san lint test test-unit test-fast test-local test-race chaos bench loadgen serve proxy signal multichip
+.PHONY: native native-san lint test test-unit test-fast test-local test-race chaos bench bench-smoke loadgen serve proxy signal multichip
 
 native:            ## build the C++ frame codec
 	scripts/build-native.sh
@@ -45,7 +45,8 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 		tests/test_spec_decode.py tests/test_multi_choice.py \
 		tests/test_seeded_sampling.py tests/test_logit_bias.py \
 		tests/test_spmd_serve.py tests/test_chaos.py \
-		tests/test_deadlines.py tests/test_fabric.py -q
+		tests/test_deadlines.py tests/test_fabric.py \
+		tests/test_fleet.py -q
 
 # Three fixed seeds: each pins a different deterministic fault schedule
 # (drops land on different frames); the e2e scenario asserts identical
@@ -80,6 +81,12 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_fabric.py -q
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_reconnect.py -k fabric -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_reconnect.py -k fabric -q
+	@# ISSUE 9 matrix row: the fleet observability plane under the same
+	@# seeded kill= fault — federated /metrics staleness markers, the
+	@# stitched two-lane failover trace, and SLO burn verdicts must all be
+	@# identical across two seeded runs (asserted INSIDE the tests).
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_fleet.py -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_fleet.py -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \
@@ -88,6 +95,17 @@ loadgen:           ## out-of-process SSE ingress herd against a spawned loopback
 
 bench:             ## end-to-end tok/s + TTFT through the tunnel
 	python bench.py
+
+# ISSUE 9: a CHEAP row for every CI run — tiny model, forced CPU, 4
+# clients, tight caps — so trend files get a datapoint even in rounds with
+# no chip window.  The row's JSON schema is pinned by RESULT_ROW_KEYS in
+# bench.py and tests/test_bench_smoke.py; a CPU row always carries
+# no_tpu=true + vs_baseline=null (never comparable to the chip target).
+bench-smoke:       ## fast CPU-only bench row (pinned schema)
+	JAX_PLATFORMS=cpu BENCH_MODEL=tiny BENCH_CLIENTS=4 BENCH_MAX_TOKENS=8 \
+	BENCH_SLOTS=4 BENCH_MAX_SEQ=128 BENCH_DECODE_STEPS=4 \
+	BENCH_PROMPT_TOKENS=16 BENCH_SECONDARY=0 \
+	BENCH_BUDGET_S=$${BENCH_BUDGET_S:-600} python bench.py
 
 multichip:         ## harness dryrun: dp+tp train step on a virtual mesh
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 python __graft_entry__.py

@@ -70,6 +70,30 @@ SAFE_OVERRIDES = {
 }
 
 
+#: The bench result-row schema (ISSUE 9): exactly the keys every
+#: successful attempt's JSON row carries, pinned here AND statically
+#: cross-checked against the row-builder dict by tests/test_bench_smoke.py
+#: — CI appends `make bench-smoke` rows to trend files, so a silently
+#: renamed/dropped key would corrupt every downstream reader.  _finalize()
+#: may ADD driver-facing keys (no_tpu, best_banked_tpu, fallback_from,
+#: forced_cpu, platform_probe, secondary); those are optional by contract.
+RESULT_ROW_KEYS = (
+    "platform", "metric", "value", "unit", "vs_baseline",
+    "ttft_p50_ms", "ttft_p99_ms", "ttft_p999_ms",
+    "ttfb_p50_ms", "ttfb_p99_ms", "ttfb_p999_ms",
+    "engine_ttft_p50_ms", "engine_ttft_p99_ms",
+    "queue_wait_p50_ms", "prefill_exec_p50_ms",
+    "prefill_p50_ms", "decode_fetch_p50_ms",
+    "mfu", "model", "quant", "quant_group_size", "prefill_act_quant",
+    "kv_quant", "flash_decode", "flash_sgrid", "fused_decode_layer",
+    "decode_kernels_per_step", "prefix_cache", "spec_ngram",
+    "mux", "mux_budget_tokens", "mux_prefill_chunk",
+    "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
+    "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
+    "wall_s",
+)
+
+
 def _log(msg: str) -> None:
     print(f"bench[{time.monotonic() - T_START:7.1f}s]: {msg}",
           file=sys.stderr, flush=True)
@@ -405,7 +429,7 @@ async def _run_attempt(model: str) -> dict:
     n_params, peak_flops = _model_flops_params(model)
     import jax
 
-    return {
+    row = {
         # The backend the measurement ACTUALLY ran on — _finalize() nulls
         # vs_baseline off this, so a CPU fallback can never masquerade as a
         # TPU datapoint (VERDICT r4 Weak #1).
@@ -479,6 +503,16 @@ async def _run_attempt(model: str) -> dict:
         "visible_tokens": visible_tokens,
         "wall_s": round(wall, 2),
     }
+    drift = set(row).symmetric_difference(RESULT_ROW_KEYS)
+    if drift:
+        # Schema drift is a bug in THIS file: the builder and the pinned
+        # key list must move together (tests/test_bench_smoke.py also
+        # cross-checks them statically).
+        raise RuntimeError(
+            f"bench result-row schema drift: {sorted(drift)} — update "
+            "RESULT_ROW_KEYS and the schema test in lockstep"
+        )
+    return row
 
 
 def _attempt_main(model: str, deadline_s: float) -> None:

@@ -288,6 +288,38 @@ def build_parser() -> argparse.ArgumentParser:
                             "tp/sp/ep stay slice-local on ICI "
                             "(parallel/distributed.py)")
 
+    # SLO burn-rate engine (ISSUE 9, utils/slo.py): declarative objectives
+    # evaluated over multi-window burn rates, published as slo_* series
+    # and the /healthz "slo" section; a burning objective marks the peer
+    # degraded so fabric routing steers around it.
+    serve.add_argument("--slo",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_SLO", "1") == "1",
+                       help="evaluate SLO burn rates (default ON): TTFT "
+                            "and availability objectives over fast (~5 "
+                            "min) / slow (~1 h) windows; verdicts land in "
+                            "/metrics (slo_* labeled series) and the "
+                            "/healthz slo section, and a burning "
+                            "objective degrades the peer's health state "
+                            "(--no-slo or TUNNEL_SLO=0 disables)")
+    serve.add_argument("--slo-ttft-ms", type=float,
+                       default=float(_env("TUNNEL_SLO_TTFT_MS", "2000")),
+                       help="TTFT objective threshold: the ttft objective "
+                            "counts a request good when its engine TTFT "
+                            "is within this many ms (env "
+                            "TUNNEL_SLO_TTFT_MS)")
+    serve.add_argument("--slo-ttft-target", type=float,
+                       default=float(_env("TUNNEL_SLO_TTFT_TARGET",
+                                          "0.99")),
+                       help="required good fraction for the ttft "
+                            "objective (0.99 = TTFT p99 must meet the "
+                            "threshold; env TUNNEL_SLO_TTFT_TARGET)")
+    serve.add_argument("--slo-availability-target", type=float,
+                       default=float(_env("TUNNEL_SLO_AVAIL_TARGET",
+                                          "0.999")),
+                       help="required fraction of requests answered "
+                            "without shed/error (env "
+                            "TUNNEL_SLO_AVAIL_TARGET)")
     serve.add_argument("--fabric",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_FABRIC", "") == "1",
@@ -693,6 +725,25 @@ async def _amain(args) -> None:
             "/healthz?trace=1)", args.trace_buffer, args.trace_sample,
         )
     if args.command == "serve":
+        from p2p_llm_tunnel_tpu.utils.slo import (
+            default_objectives,
+            global_slo,
+        )
+
+        global_slo.configure(
+            enabled=args.slo,
+            objectives=default_objectives(
+                ttft_ms=args.slo_ttft_ms,
+                ttft_target=args.slo_ttft_target,
+                availability_target=args.slo_availability_target,
+            ),
+        )
+        if args.slo:
+            log.info(
+                "slo engine on: ttft p%g <= %gms, availability >= %g%%",
+                args.slo_ttft_target * 100, args.slo_ttft_ms,
+                args.slo_availability_target * 100,
+            )
         # Graceful drain: the FIRST SIGTERM stops admission and lets
         # in-flight streams finish (run_serve returns cleanly, the retry
         # supervisor sees a clean end); a SECOND SIGTERM force-exits via

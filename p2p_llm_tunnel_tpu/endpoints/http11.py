@@ -61,6 +61,26 @@ def query_flags(path: str) -> "set[str]":
     return {tok for tok in path.partition("?")[2].split("&") if tok}
 
 
+def ops_route(method: str, path: str) -> "Optional[Tuple[str, set[str]]]":
+    """``(endpoint, flags)`` when the request targets a loop-served
+    operational endpoint — ``("healthz" | "metrics", query_flags(path))``
+    — else None for ordinary proxied traffic.
+
+    THE shared route parser behind the proxy's and serve loop's
+    ``/healthz`` / ``/metrics`` handling (ISSUE 9 satellite): both sites
+    used to hand-roll the same method-upper + path-split + flag-membership
+    dance, and the ``?fleet=1`` surfaces would have minted a third copy —
+    a divergence in any one of them silently changes which requests reach
+    the backend.
+    """
+    if method.upper() != "GET":
+        return None
+    base = path.partition("?")[0]
+    if base not in ("/healthz", "/metrics"):
+        return None
+    return base[1:], query_flags(path)
+
+
 # ---------------------------------------------------------------------------
 # shared parsing helpers
 # ---------------------------------------------------------------------------

@@ -60,6 +60,17 @@ PING_INTERVAL = 10.0  # proxy.rs:93
 DEGRADED_RTT_MS = 2000.0
 #: Budget for one tunneled GET /healthz probe.
 PROBE_TIMEOUT = 5.0
+#: Per-peer budget for one fleet scrape (/metrics?fleet=1, stitched-trace
+#: pulls): a dead or wedged peer costs AT MOST this much wall time and
+#: yields a staleness marker, never a hang — the scrapes run concurrently,
+#: so the whole fleet answer is bounded by the slowest peer, not the sum.
+FLEET_SCRAPE_TIMEOUT = 2.0
+#: How long a dead peer keeps appearing (as stale) in fleet scrapes after
+#: departure: long enough for a dashboard scraping every minute to notice
+#: the death, bounded so a long-lived proxy's churn doesn't accrete.
+DEPARTED_TTL_S = 600.0
+#: Departed-peer memory bound (oldest evicted beyond it).
+DEPARTED_CAP = 64
 
 #: Consecutive dispatch failures that open a link's circuit breaker.
 CB_THRESHOLD = 3
@@ -181,6 +192,18 @@ class PeerSet:
         #: Resolves when the fabric supervisor wants the listener down
         #: (signaling death / shutdown); run_proxy_fabric awaits it.
         self.closed = asyncio.Event()
+        #: Recently-dead peers (pid -> departure time): fleet scrapes keep
+        #: reporting them — as STALE — for DEPARTED_TTL_S, so a killed
+        #: peer's absence from /metrics?fleet=1 is an explicit marker
+        #: series, never a silently-vanished set of time series.
+        self.departed: Dict[str, float] = {}
+        #: Last-known per-peer shed-counter contribution: a TRANSIENT
+        #: scrape timeout must not make fleet_sheds_summed dip by a whole
+        #: peer's count and snap back — operators rate() that gauge, and
+        #: the dip would read as a huge spurious rate excursion.  Stale
+        #: peers carry their last-known value until they leave the scrape
+        #: set entirely (departed TTL), which IS a peer-set change.
+        self._peer_sheds: Dict[str, float] = {}
         self._rr = 0
         self._next_stream_id = 1
         self._id_seq = 0
@@ -220,11 +243,17 @@ class PeerSet:
             peer_id = f"peer-{self._id_seq}"
             self._id_seq += 1
         link = PeerLink(peer_id, channel)
+        self.departed.pop(peer_id, None)  # a rejoin is no longer departed
         if not channel.connected.is_set():
             log.info("waiting for channel to be ready...")
             await channel.connected.wait()
         log.info("channel ready, performing handshake...")
-        await channel.send(TunnelMessage.hello(Hello()).encode())
+        # Fabric handshakes stamp the assigned peer id into HELLO (the
+        # Hello.peer extension) so the serve side can tag its spans and
+        # /healthz with the identity this proxy's fleet surfaces use; the
+        # classic single-peer handshake stays byte-identical.
+        hello = Hello(peer=peer_id) if self.fabric else Hello()
+        await channel.send(TunnelMessage.hello(hello).encode())
         try:
             raw = await asyncio.wait_for(channel.recv(), HANDSHAKE_TIMEOUT)
         except asyncio.TimeoutError:
@@ -334,6 +363,10 @@ class PeerSet:
                     link.peer_id, link.inflight)
         self._abort_link(link, err)
         self.peers.pop(link.peer_id, None)
+        self.departed.pop(link.peer_id, None)  # re-insert at newest
+        self.departed[link.peer_id] = time.monotonic()
+        while len(self.departed) > DEPARTED_CAP:
+            self.departed.pop(next(iter(self.departed)))
         self._publish_gauges()
         current = asyncio.current_task()
         for t in link._tasks:
@@ -472,46 +505,147 @@ class PeerSet:
             except ChannelClosed:
                 return
 
+    async def fetch(self, link: PeerLink, path: str,
+                    timeout: float = PROBE_TIMEOUT) -> Optional[bytes]:
+        """One tunneled GET on ``link``: the full response body (whatever
+        the HTTP status) within ``timeout``, else None — a dead, wedged,
+        or erroring peer costs bounded wall time, never a hang.  The
+        timeout covers the SENDS too: a peer that stopped reading (full
+        TCP buffer, stalled ARQ window) blocks ``channel.send`` itself,
+        and an unbounded send would hang the whole fleet scrape.  The
+        transport machinery every tunneled ops pull shares (health probes,
+        /metrics?fleet=1 scrapes, stitched-trace journal pulls).
+        ChannelClosed from the sends propagates to the caller."""
+        sid = self.alloc_stream_id()
+        q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded by the ops endpoint's own response (a handful of frames); the stream is torn down at `timeout`
+        link.pending[sid] = q
+        try:
+            return await asyncio.wait_for(
+                self._fetch_inner(link, sid, path, q), timeout
+            )
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            link.pending.pop(sid, None)
+
+    async def _fetch_inner(
+        self, link: PeerLink, sid: int, path: str,
+        q: "asyncio.Queue[_StreamEvent]",
+    ) -> Optional[bytes]:
+        await link.channel.send(TunnelMessage.req_headers(
+            RequestHeaders(sid, "GET", path, {})
+        ).encode())
+        await link.channel.send(TunnelMessage.req_end(sid).encode())
+        body = bytearray()
+        while True:
+            ev = await q.get()
+            if isinstance(ev, _Body):
+                body.extend(ev.data)
+            elif isinstance(ev, _End):
+                return bytes(body)
+            elif isinstance(ev, _Error):
+                return None
+
     async def probe(self, link: PeerLink) -> Optional[str]:
         """One tunneled GET /healthz; applies the reported status to the
         link's health state.  Returns the status string, or None when the
         probe timed out (which marks the link degraded)."""
-        sid = self.alloc_stream_id()
-        q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded by the probe's own /healthz response (a few frames); the stream is torn down at PROBE_TIMEOUT
-        link.pending[sid] = q
-        try:
-            await link.channel.send(TunnelMessage.req_headers(
-                RequestHeaders(sid, "GET", "/healthz", {})
-            ).encode())
-            await link.channel.send(TunnelMessage.req_end(sid).encode())
-            body = bytearray()
-            deadline = time.monotonic() + PROBE_TIMEOUT
-            while True:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise asyncio.TimeoutError
-                ev = await asyncio.wait_for(q.get(), remaining)
-                if isinstance(ev, _Body):
-                    body.extend(ev.data)
-                elif isinstance(ev, _End):
-                    break
-                elif isinstance(ev, _Error):
-                    raise asyncio.TimeoutError
-        except asyncio.TimeoutError:
+        body = await self.fetch(link, "/healthz", PROBE_TIMEOUT)
+        if body is None:
             if link.state == PEER_LIVE:
                 log.warning("peer %s degraded: healthz probe failed",
                             link.peer_id)
                 link.state = PEER_DEGRADED
                 self._publish_gauges()
             return None
-        finally:
-            link.pending.pop(sid, None)
         try:
-            status = str(json.loads(bytes(body)).get("status", ""))
+            status = str(json.loads(body).get("status", ""))
         except (json.JSONDecodeError, ValueError):
             status = ""
         self.apply_health(link, status)
         return status
+
+    # -- fleet scraping (ISSUE 9) -----------------------------------------
+
+    async def scrape_fleet(
+        self, path: str, timeout: float = FLEET_SCRAPE_TIMEOUT
+    ) -> Dict[str, Optional[bytes]]:
+        """Concurrently GET ``path`` from every admitted peer.
+
+        Returns ``{peer_id: body | None}`` — None marks a STALE peer (the
+        scrape failed, timed out, or the peer recently died: recently-
+        departed peers are included so their staleness is an explicit
+        series, not a vanished one).  Wall time is bounded by ``timeout``
+        (scrapes run concurrently; a dead peer can never hang the fleet
+        answer).
+        """
+        links = [
+            l for l in list(self.peers.values())
+            if l.ready and l.state != PEER_DEAD
+        ]
+
+        async def one(link: PeerLink) -> Optional[bytes]:
+            try:
+                return await self.fetch(link, path, timeout)
+            except ChannelClosed:
+                return None
+
+        bodies = await asyncio.gather(*(one(l) for l in links))
+        out: Dict[str, Optional[bytes]] = {
+            l.peer_id: b for l, b in zip(links, bodies)
+        }
+        now = time.monotonic()
+        for pid, t_dead in list(self.departed.items()):
+            if now - t_dead > DEPARTED_TTL_S:
+                self.departed.pop(pid, None)
+            else:
+                out.setdefault(pid, None)
+        return out
+
+    def publish_fleet_gauges(
+        self, texts: "Dict[str, Optional[str]]"
+    ) -> None:
+        """Fold a fleet scrape into the catalogued ``fleet_*`` aggregates
+        (the proxy-process registry): peers live/degraded, summed
+        in-flight, fleet-wide shed total and redispatch rate, and the
+        per-peer staleness markers — the same numbers /healthz?local=1
+        serves as its ``fleet`` section."""
+        from p2p_llm_tunnel_tpu.utils.metrics import sum_counter_samples
+
+        global_metrics.set_gauge("fleet_peers_live", self.live_count())
+        global_metrics.set_gauge("fleet_peers_degraded", sum(
+            1 for l in self.peers.values()
+            if l.ready and l.state == PEER_DEGRADED
+        ))
+        global_metrics.set_gauge(
+            "fleet_streams_in_flight", self.total_pending()
+        )
+        for pid, text in texts.items():
+            if text is not None:
+                one = {pid: text}
+                self._peer_sheds[pid] = (
+                    sum_counter_samples(one, "serve_shed_total")
+                    + sum_counter_samples(one, "engine_tenant_sheds_total")
+                )
+        for pid in [p for p in self._peer_sheds if p not in texts]:
+            del self._peer_sheds[pid]
+        global_metrics.set_gauge(
+            "fleet_sheds_summed", sum(self._peer_sheds.values())
+        )
+        global_metrics.set_gauge(
+            "fleet_redispatch_per_s",
+            global_metrics.rate("proxy_redispatch_total", window_s=60.0),
+        )
+        for pid, text in texts.items():
+            global_metrics.set_labeled_gauge(
+                "fleet_peer_scrape_stale", "peer", pid,
+                0.0 if text is not None else 1.0,
+            )
+        # A departed peer past DEPARTED_TTL_S leaves the scrape set — its
+        # marker must leave the exposition with it, not read 1 forever.
+        global_metrics.prune_labeled_gauge(
+            "fleet_peer_scrape_stale", set(texts)
+        )
 
     def apply_health(self, link: PeerLink, status: str) -> None:
         """Fold a /healthz-reported status into the link state."""
@@ -563,5 +697,30 @@ class PeerSet:
             ),
             "peers": {
                 pid: link.describe(now) for pid, link in self.peers.items()
+            },
+            # Fleet aggregates (ISSUE 9): the /metrics?fleet=1 numbers as
+            # a JSON section.  Live membership counts are computed HERE
+            # (current); the scrape-derived ones (sheds_summed, staleness)
+            # are the last fleet scrape's — zero/empty before the first —
+            # because this surface must answer instantly with every peer
+            # down, never scrape.
+            "fleet": {
+                "peers_live": live,
+                "peers_degraded": sum(
+                    1 for l in self.peers.values()
+                    if l.ready and l.state == PEER_DEGRADED
+                ),
+                "streams_in_flight": self.total_pending(),
+                "sheds_summed": int(
+                    global_metrics.gauge("fleet_sheds_summed")
+                ),
+                "redispatch_per_s": round(
+                    global_metrics.gauge("fleet_redispatch_per_s"), 3
+                ),
+                "stale_peers": sorted(
+                    pid for pid, v in global_metrics.labeled_gauge(
+                        "fleet_peer_scrape_stale"
+                    ).items() if v > 0
+                ),
             },
         }

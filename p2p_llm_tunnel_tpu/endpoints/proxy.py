@@ -37,7 +37,7 @@ from typing import AsyncIterator, Dict, Optional
 from p2p_llm_tunnel_tpu.endpoints.http11 import (
     HttpRequest,
     HttpResponse,
-    query_flags,
+    ops_route,
     start_http_server,
 )
 from p2p_llm_tunnel_tpu.endpoints.peerset import (  # noqa: F401  (re-exported)
@@ -63,7 +63,11 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
-from p2p_llm_tunnel_tpu.utils.metrics import Metrics, global_metrics
+from p2p_llm_tunnel_tpu.utils.metrics import (
+    Metrics,
+    federate_prometheus_texts,
+    global_metrics,
+)
 from p2p_llm_tunnel_tpu.utils.tracing import (
     TRACE_HEADER,
     global_tracer,
@@ -159,32 +163,92 @@ class _DispatchFailed:
         self.retry_safe = retry_safe
 
 
+async def _fleet_metrics_response(state: ProxyState) -> HttpResponse:
+    """GET /metrics?fleet=1 (ISSUE 9): every live peer's /metrics scraped
+    concurrently over the tunnel (bounded per-peer timeout — a dead or
+    slow peer yields a ``fleet_peer_scrape_stale`` marker, never a hang),
+    merged with a ``peer="..."`` label on every serve/engine-side series,
+    plus the proxy's own proxy_* series and the ``fleet_*`` aggregates."""
+    scrapes = await state.scrape_fleet("/metrics")
+    texts = {
+        pid: (body.decode("utf-8", "replace") if body is not None else None)
+        for pid, body in scrapes.items()
+    }
+    # Aggregates + staleness markers land in THIS registry first, so the
+    # local exposition section below (and /healthz?local=1's fleet
+    # section) carries them.
+    state.publish_fleet_gauges(texts)
+    return HttpResponse(
+        200, {"content-type": Metrics.PROM_CONTENT_TYPE},
+        federate_prometheus_texts(
+            texts, global_metrics.prometheus_text()
+        ).encode(),
+    )
+
+
+async def _fleet_trace_response(state: ProxyState) -> HttpResponse:
+    """GET /healthz?trace=1&fleet=1 (ISSUE 9): pull every live peer's span
+    journal over the tunnel and stitch them — with this process's own
+    ingress journal — into ONE Chrome trace with per-peer process lanes,
+    so a failed-over request shows sibling serve.dispatch spans on two
+    peer lanes under a single trace id.  Peers whose journal could not be
+    pulled (dead, slow, evicted) are flagged in the ``stitch`` summary;
+    partial chains are flagged, never an error."""
+    import json as _json
+
+    from p2p_llm_tunnel_tpu.utils.tracing import stitch_chrome_traces
+
+    scrapes = await state.scrape_fleet("/healthz?trace=1")
+    sources: Dict[str, Optional[dict]] = {
+        "proxy": global_tracer.chrome_trace()
+    }
+    for pid, body in scrapes.items():
+        if body is None:
+            sources[pid] = None
+            continue
+        try:
+            obj = _json.loads(body)
+            sources[pid] = obj if isinstance(obj, dict) else None
+        except ValueError:
+            sources[pid] = None
+    return HttpResponse(
+        200, {"content-type": "application/json"},
+        _json.dumps(stitch_chrome_traces(sources)).encode(),
+    )
+
+
 async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
     """One HTTP request through the tunnel (proxy.rs:249-426), with
     health-routed dispatch and transparent failover across the PeerSet."""
-    if (req.method.upper() == "GET"
-            and req.path.split("?")[0] == "/metrics"
-            and "local=1" in query_flags(req.path)):
-        # GET /metrics?local=1: THIS process's registry (the proxy-side
-        # proxy_*/transport_* series live here, not behind the tunnel),
-        # answered locally so it works even while the tunnel is down.
-        # Bare /metrics tunnels through to the serve peer like /healthz —
-        # in the deployed two-process topology the proxy listener is the
-        # only HTTP surface, and a local answer there would render the
-        # engine_*/serve_* series as silent zeros (the TC06 bug class).
-        return HttpResponse(
-            200, {"content-type": Metrics.PROM_CONTENT_TYPE},
-            global_metrics.prometheus_text().encode(),
-        )
-    if req.method.upper() == "GET" and req.path.split("?")[0] == "/healthz":
-        flags = query_flags(req.path)
+    route = ops_route(req.method, req.path)
+    if route is not None and route[0] == "metrics":
+        flags = route[1]
+        if "fleet=1" in flags:
+            return await _fleet_metrics_response(state)
+        if "local=1" in flags:
+            # GET /metrics?local=1: THIS process's registry (the proxy-side
+            # proxy_*/transport_* series live here, not behind the tunnel),
+            # answered locally so it works even while the tunnel is down.
+            # Bare /metrics tunnels through to the serve peer like /healthz —
+            # in the deployed two-process topology the proxy listener is the
+            # only HTTP surface, and a local answer there would render the
+            # engine_*/serve_* series as silent zeros (the TC06 bug class).
+            return HttpResponse(
+                200, {"content-type": Metrics.PROM_CONTENT_TYPE},
+                global_metrics.prometheus_text().encode(),
+            )
+    if route is not None and route[0] == "healthz":
+        flags = route[1]
+        if {"trace=1", "fleet=1"} <= flags:
+            return await _fleet_trace_response(state)
         if {"trace=1", "local=1"} <= flags:
             # GET /healthz?trace=1&local=1: THIS process's span journal —
             # in the two-process topology the proxy's ingress spans
             # (proxy.request/frame_send/first_byte) live in this ring
             # buffer, not the serve peer's; without this escape the
             # documented capture flow would silently lose the proxy layer.
-            # Bare ?trace=1 tunnels through to the serve+engine journal.
+            # Bare ?trace=1 tunnels through to the serve+engine journal;
+            # ?trace=1&fleet=1 stitches ALL the journals (above).
             import json as _json
 
             return HttpResponse(
@@ -194,8 +258,9 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         if "local=1" in flags:
             # GET /healthz?local=1: the proxy's OWN fabric health — peer
             # states, per-peer RTT/breaker/inflight, failover counters
-            # (ISSUE 8).  Answered locally: it must work while every serve
-            # peer is down (that is exactly when an operator needs it).
+            # (ISSUE 8) plus the fleet aggregates section (ISSUE 9).
+            # Answered locally: it must work while every serve peer is
+            # down (that is exactly when an operator needs it).
             import json as _json
 
             snap = state.snapshot()

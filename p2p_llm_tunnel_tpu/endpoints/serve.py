@@ -46,6 +46,7 @@ from p2p_llm_tunnel_tpu.utils.metrics import (
     derived_retry_after_s,
     global_metrics,
 )
+from p2p_llm_tunnel_tpu.utils.slo import global_slo
 from p2p_llm_tunnel_tpu.utils.tracing import (
     TRACE_HEADER,
     global_tracer,
@@ -230,7 +231,7 @@ async def _coalesce(
 
 async def _handle_request(
     channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
-    flow: FlowControl,
+    flow: FlowControl, peer_label: str = "",
 ) -> None:
     t0 = time.monotonic()
     ctx = parse_trace_context(req.headers)
@@ -252,10 +253,20 @@ async def _handle_request(
     finally:
         flow.close(req.stream_id)
         if span is not None:
+            attrs: Dict[str, object] = {
+                "stream_id": req.stream_id, "path": req.path,
+            }
+            if peer_label:
+                # The fabric identity this serve peer learned at handshake
+                # (Hello.peer): the stitched fleet trace assigns this span
+                # — and, via parent linkage, the engine spans under it —
+                # to the right per-peer process lane, so a failover shows
+                # sibling serve.dispatch spans on two lanes.
+                attrs["peer"] = peer_label
             global_tracer.add_span(
                 "serve.dispatch", trace_id=ctx.trace_id, span_id=span,
                 parent_id=ctx.span_id or None, track="serve", t0=t0,
-                attrs={"stream_id": req.stream_id, "path": req.path},
+                attrs=attrs,
             )
 
 
@@ -298,6 +309,7 @@ async def _handle_request_inner(
             # the upstream-errors counter, not the timeouts one.
             log.error("upstream request timed out for stream %d", stream_id)
             global_metrics.inc("serve_upstream_errors_total")
+            global_slo.record("availability", False)
             await _send_simple(
                 channel, stream_id, 502, b"Bad Gateway: upstream timeout"
             )
@@ -305,6 +317,15 @@ async def _handle_request_inner(
         log.warning("stream %d hit its %.0fms deadline before headers",
                     stream_id, dl_ms)
         global_metrics.inc("serve_timeouts_total")
+        global_slo.record("availability", False)
+        # A request that timed out before ANY response byte never fed the
+        # engine's TTFT sample — count it as a bad ttft event here, or the
+        # latency objective would have pure survivorship bias: a wedged
+        # engine whose every request deadlines out would read ttft-ok
+        # exactly when TTFT is at its worst.  (Deadline-less requests that
+        # hang are still invisible to this objective — availability and
+        # the decode watchdog carry that case.)
+        global_slo.record("ttft", False)
         trace_timeout("before-headers")
         await _send_simple(
             channel, stream_id, 504, b"Gateway Timeout: deadline exceeded"
@@ -313,6 +334,7 @@ async def _handle_request_inner(
     except Exception as e:
         log.error("upstream request failed for stream %d: %s", stream_id, e)
         global_metrics.inc("serve_upstream_errors_total")
+        global_slo.record("availability", False)
         await _send_simple(
             channel, stream_id, 502, f"Bad Gateway: {e}".encode()
         )
@@ -349,6 +371,7 @@ async def _handle_request_inner(
             raise asyncio.TimeoutError
         return await asyncio.wait_for(awaitable, remaining)
 
+    served_ok = True  # flipped by any mid-stream failure below
     try:
         while True:
             try:
@@ -359,6 +382,7 @@ async def _handle_request_inner(
             for frame in encode_body_frames(MessageType.RES_BODY, stream_id, chunk):
                 await channel.send(frame)
     except asyncio.TimeoutError:
+        served_ok = False
         if deadline is None:
             # A backend-internal timeout mid-stream (no client budget set):
             # report it as the upstream failure it is.
@@ -393,6 +417,7 @@ async def _handle_request_inner(
         # typed frame from the deadline branch above when the client sent
         # x-tunnel-deadline-ms, and engine_deadline_timeouts_total counts
         # every engine-side eviction regardless of which layer noticed.
+        served_ok = False
         log.error("upstream stream error for stream %d: %s", stream_id, e)
         code = getattr(e, "tunnel_code", None)
         if code == "timeout":
@@ -411,6 +436,14 @@ async def _handle_request_inner(
         await channel.send(TunnelMessage.typed_error(
             stream_id, shed_code, f"shed by backend admission ({status})",
         ).encode())
+    # Availability objective (ISSUE 9): one event per dispatched request —
+    # good iff it was relayed without a shed, a server error, or a
+    # mid-stream failure.  (A stream an engine displaces AFTER admission
+    # ends in-band with a typed finish_reason on a 200 — those count good
+    # here; the engine's own shed counters carry that signal.)
+    global_slo.record(
+        "availability", served_ok and shed_code is None and status < 500
+    )
     log.debug("response %d complete: status=%d", stream_id, status)
 
 
@@ -445,14 +478,27 @@ def _retry_after_s(inflight: int) -> float:
 
 async def _send_healthz(
     channel: Channel, stream_id: int, draining: bool, inflight: int,
+    peer_label: str = "",
 ) -> None:
     """/healthz: ok|degraded|draining + queue/occupancy from the metrics
     registry (engine gauges; zeros under the plain HTTP backend).  200 only
     when fully healthy, 503 otherwise — the load-balancer convention."""
-    degraded = global_metrics.gauge("engine_degraded") > 0
+    # SLO verdicts (ISSUE 9): a burning/breached objective marks this peer
+    # DEGRADED — the same signal a stalled decode watchdog raises — so the
+    # fabric's health routing steers new dispatches away from a peer that
+    # is consuming its error budget unsustainably, before the objective is
+    # lost fleet-wide.  (Inert while the SLO engine is disabled.)
+    slo_section = global_slo.section()
+    degraded = (global_metrics.gauge("engine_degraded") > 0
+                or bool(slo_section["alerting"]))
     state = "draining" if draining else ("degraded" if degraded else "ok")
     payload = {
         "status": state,
+        # The fabric identity this peer learned at handshake ("" when
+        # joined untagged): lets an operator match a tunneled /healthz
+        # answer to the proxy's per-peer fabric snapshot.
+        "peer": peer_label or None,
+        "slo": slo_section,
         "queue_depth": int(global_metrics.gauge("engine_queue_depth")),
         "slot_occupancy": global_metrics.gauge("engine_batch_occupancy"),
         "inflight_requests": inflight,
@@ -569,8 +615,14 @@ async def run_serve(
     agree = Agree.from_hello(hello)
     await channel.send(TunnelMessage.agree(agree).encode())
     flow = FlowControl("flow" in agree.features)
-    log.info("sent AGREE, tunnel ready (flow control %s)",
-             "on" if flow.enabled else "off")
+    # Fabric identity (ISSUE 9): a fabric proxy stamps the peer id it
+    # assigned this link into HELLO; serve-side spans carry it so the
+    # stitched fleet trace can attribute them to the right process lane.
+    # Empty for classic 2-peer rooms and reference peers (wire unchanged).
+    peer_label = hello.peer
+    log.info("sent AGREE, tunnel ready (flow control %s%s)",
+             "on" if flow.enabled else "off",
+             f", fabric peer id {peer_label!r}" if peer_label else "")
 
     pending: Dict[int, Tuple[RequestHeaders, bytearray]] = {}
     request_tasks: set[asyncio.Task] = set()
@@ -619,7 +671,7 @@ async def run_serve(
             try:
                 await _serve_dispatch(
                     channel, backend, flow, pending, request_tasks,
-                    max_inflight, drain, msg,
+                    max_inflight, drain, msg, peer_label,
                 )
             except ChannelClosed:
                 # The drainer can close the channel between our recv and a
@@ -646,6 +698,7 @@ async def _serve_dispatch(
     max_inflight: int,
     drain: Optional[asyncio.Event],
     msg: TunnelMessage,
+    peer_label: str = "",
 ) -> None:
     """Handle one decoded inbound frame for the serve loop.
 
@@ -678,10 +731,11 @@ async def _serve_dispatch(
                     parent_id=tctx.span_id or None, track="serve",
                     attrs={"stream_id": req.stream_id, "path": path},
                 )
-            if req.method.upper() == "GET" and path == "/healthz":
+            route = http11.ops_route(req.method, req.path)
+            if route is not None and route[0] == "healthz":
                 # Answered by the serve loop itself (not the backend) so
                 # health works identically for the HTTP and TPU backends.
-                if "trace=1" in http11.query_flags(req.path):
+                if "trace=1" in route[1]:
                     # The span journal as Chrome trace-event JSON — load
                     # in chrome://tracing / Perfetto, or summarize with
                     # scripts/traceview.py.
@@ -695,12 +749,16 @@ async def _serve_dispatch(
                     channel, req.stream_id,
                     draining=drain is not None and drain.is_set(),
                     inflight=len(request_tasks),
+                    peer_label=peer_label,
                 )
                 return
-            if req.method.upper() == "GET" and path == "/metrics":
+            if route is not None and route[0] == "metrics":
                 # Prometheus text exposition for the full catalog — also
                 # answered by the serve loop itself, so the HTTP and TPU
-                # backends expose identical scrape surfaces.
+                # backends expose identical scrape surfaces.  SLO verdicts
+                # are refreshed first so the slo_* labeled series a fleet
+                # scrape relabels are current at every scrape.
+                global_slo.publish()
                 await _send_simple(
                     channel, req.stream_id, 200,
                     global_metrics.prometheus_text().encode(),
@@ -709,6 +767,7 @@ async def _serve_dispatch(
                 return
             if drain is not None and drain.is_set():
                 global_metrics.inc("serve_shed_total")
+                global_slo.record("availability", False)
                 if tctx is not None:
                     global_tracer.add_event(
                         "serve.drain_reject", trace_id=tctx.trace_id,
@@ -731,6 +790,7 @@ async def _serve_dispatch(
                 # RES_END, so the proxy — which forgets the stream at
                 # RES_END — is unaffected.
                 global_metrics.inc("serve_shed_total")
+                global_slo.record("availability", False)
                 if tctx is not None:
                     global_tracer.add_event(
                         "serve.shed", trace_id=tctx.trace_id,
@@ -751,7 +811,8 @@ async def _serve_dispatch(
                 ).encode())
                 return
             task = asyncio.create_task(
-                _handle_request(channel, backend, req, bytes(body), flow)
+                _handle_request(channel, backend, req, bytes(body), flow,
+                                peer_label)
             )
             request_tasks.add(task)
             task.add_done_callback(request_tasks.discard)

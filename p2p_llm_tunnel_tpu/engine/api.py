@@ -847,7 +847,9 @@ class EngineAPI:
                 Metrics,
                 global_metrics,
             )
+            from p2p_llm_tunnel_tpu.utils.slo import global_slo
 
+            global_slo.publish()  # slo_* series current at every scrape
             return (
                 200,
                 {"content-type": Metrics.PROM_CONTENT_TYPE},

@@ -46,6 +46,7 @@ from p2p_llm_tunnel_tpu.utils.metrics import (
     derived_retry_after_s,
     global_metrics,
 )
+from p2p_llm_tunnel_tpu.utils.slo import global_slo
 from p2p_llm_tunnel_tpu.utils.tracing import (
     TraceContext,
     global_tracer,
@@ -1573,6 +1574,14 @@ class InferenceEngine:
             self._requests.pop(rid, None)
             self.scheduler.cancel(rid)
             global_metrics.tenant_end(tenant)
+            if state.first_token_at is None and state.finish:
+                # The request ended SERVER-SIDE (timeout/shed — finish is
+                # set; a consumer cancel leaves it None) without ever
+                # producing a first token: a bad TTFT event.  Without this,
+                # the ttft objective only sees requests that answered —
+                # survivorship bias that reads "ok" exactly when a wedged
+                # engine makes TTFT unbounded.
+                global_slo.record("ttft", False)
             if state.trace is not None:
                 # Exactly one engine.request span per generation — this
                 # finally runs once on every exit path (finish, deadline,
@@ -1618,9 +1627,11 @@ class InferenceEngine:
             return  # consumer went away; scheduler cancel happens in generate()
         if state.first_token_at is None:
             state.first_token_at = time.monotonic()
-            global_metrics.observe(
-                "engine_ttft_ms", (state.first_token_at - state.t_submit) * 1000.0
-            )
+            ttft_ms = (state.first_token_at - state.t_submit) * 1000.0
+            global_metrics.observe("engine_ttft_ms", ttft_ms)
+            # SLO feed (ISSUE 9): the same sample scored against the ttft
+            # objective's threshold — a no-op while the engine is disabled.
+            global_slo.record_latency("ttft", ttft_ms)
             if state.t_admitted is not None:
                 # The execution half of the TTFT decomposition (includes
                 # any prefix-dedup park time; queue_wait is the other half).

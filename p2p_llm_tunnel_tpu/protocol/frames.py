@@ -117,22 +117,29 @@ class MessageType(enum.IntEnum):
 @dataclass
 class Hello:
     """Handshake opener (reference protocol.rs:17-38). JSON keys: proto,
-    min_version, max_version, features."""
+    min_version, max_version, features — plus the OPTIONAL fabric
+    extension key ``peer`` (ISSUE 9): a fabric proxy stamps the peer id it
+    assigned this link, so the serve side can tag its spans and /healthz
+    with the identity the proxy's fleet surfaces know it by.  Omitted from
+    the wire when empty, so classic 2-peer handshakes stay byte-identical
+    to the reference; unknown-key-tolerant peers ignore it."""
 
     proto: str = PROTOCOL_NAME
     min_version: int = 1
     max_version: int = PROTOCOL_VERSION
     features: List[str] = field(default_factory=lambda: list(SUPPORTED_FEATURES))
+    peer: str = ""
 
     def to_json(self) -> bytes:
-        return json.dumps(
-            {
-                "proto": self.proto,
-                "min_version": self.min_version,
-                "max_version": self.max_version,
-                "features": self.features,
-            }
-        ).encode()
+        obj = {
+            "proto": self.proto,
+            "min_version": self.min_version,
+            "max_version": self.max_version,
+            "features": self.features,
+        }
+        if self.peer:
+            obj["peer"] = self.peer
+        return json.dumps(obj).encode()
 
     @classmethod
     def from_json(cls, data: bytes) -> "Hello":
@@ -143,6 +150,7 @@ class Hello:
                 min_version=int(obj["min_version"]),
                 max_version=int(obj["max_version"]),
                 features=list(obj["features"]),
+                peer=str(obj.get("peer", "")),
             )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             raise ProtocolError(f"bad HELLO payload: {e}") from e

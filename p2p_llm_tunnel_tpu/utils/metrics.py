@@ -9,6 +9,7 @@ engine, and ``bench.py`` all share.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from collections import defaultdict, deque
@@ -150,6 +151,61 @@ METRICS_CATALOG: Dict[str, str] = {
         "kv_quant mode — int8/int4 pools store proportionally fewer bytes "
         "per block)"
     ),
+    # -- fleet observability plane (ISSUE 9) ------------------------------
+    # The fleet_* names live in the PROXY process: aggregates over its
+    # PeerSet, refreshed by /metrics?fleet=1 scrapes and the PeerSet's
+    # gauge publishing.  Serve peers render them zero-valued (full-catalog
+    # contract) and the federation merger drops them from the per-peer
+    # relabeled sections, so the fleet exposition carries exactly one copy.
+    "fleet_peers_live": (
+        "serve peers currently dispatchable (live + degraded) in the "
+        "proxy's PeerSet (gauge; the fleet twin of proxy_peers_live, "
+        "refreshed alongside the fleet aggregates)"
+    ),
+    "fleet_peers_degraded": (
+        "serve peers in the degraded routing state — dispatchable only "
+        "when no live peer exists (gauge)"
+    ),
+    "fleet_streams_in_flight": (
+        "tunnel streams open across every peer at the last fleet "
+        "snapshot (gauge)"
+    ),
+    "fleet_sheds_summed": (
+        "serve_shed_total + engine_tenant_sheds_total summed per peer at "
+        "the last /metrics?fleet=1, with a STALE peer carrying its "
+        "last-known value until it leaves the scrape set (gauge; rate() "
+        "this for the fleet-wide shed rate — a transient scrape timeout "
+        "never dips the sum, so it is monotone while the peer set is "
+        "stable)"
+    ),
+    "fleet_redispatch_per_s": (
+        "sliding-window rate of proxy_redispatch_total at the last fleet "
+        "snapshot (gauge; the fleet-wide failover pressure signal)"
+    ),
+    "fleet_peer_scrape_stale": (
+        "1 when the peer's last fleet scrape failed, timed out, or the "
+        "peer recently died — its series in the federated exposition are "
+        "absent or stale, never silently zero (gauge, labeled {peer}; 0 "
+        "for freshly-scraped peers)"
+    ),
+    # -- SLO burn-rate engine (ISSUE 9, utils/slo.py) ---------------------
+    "slo_burn_fast": (
+        "error-budget burn rate over the fast (~5 min) window per "
+        "objective: error rate divided by the objective's budget, 1.0 = "
+        "consuming exactly the sustainable budget (gauge, labeled "
+        "{objective})"
+    ),
+    "slo_burn_slow": (
+        "error-budget burn rate over the slow (~1 h) window per "
+        "objective (gauge, labeled {objective}; the sustained-violation "
+        "signal behind the breached verdict)"
+    ),
+    "slo_state": (
+        "objective verdict: 0 ok, 1 burning (fast window consuming "
+        "budget at >= the alert threshold), 2 breached (slow window "
+        "too) (gauge, labeled {objective}; burning wires into the "
+        "/healthz degraded signal)"
+    ),
 }
 
 #: Default reservoir size per histogram.  Sized for tail quantiles: p999
@@ -180,6 +236,31 @@ def nearest_rank(values: List[float], p: float) -> float:
 TENANT_CAP = 512
 #: Aggregation bucket for tenants beyond TENANT_CAP.
 TENANT_OVERFLOW = "~other"
+
+#: Ceiling on distinct label values per labeled-gauge family (the
+#: fleet/slo ``{peer=...}`` / ``{objective=...}`` series).  At the cap the
+#: least-recently-set label is evicted — same rationale as TENANT_CAP:
+#: per-label accounting must never be an unbounded-cardinality vector
+#: (tunnelcheck TC12 exists so NO labeled series is ever produced outside
+#: these bounded helpers).
+LABELED_CAP = 256
+
+
+def prom_label_escape(v: str) -> str:
+    """Escape a label VALUE for the Prometheus text exposition."""
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prom_sample(name: str, labels: "Dict[str, str]", value: float) -> str:
+    """One exposition sample line with properly-escaped labels — the ONE
+    place label syntax is interpolated (tunnelcheck TC12 forbids hand-
+    rolled ``{key="..."}`` f-strings everywhere outside this module)."""
+    if not labels:
+        return f"{name} {value:.6g}"
+    inner = ",".join(
+        f'{k}="{prom_label_escape(str(v))}"' for k, v in labels.items()
+    )
+    return f"{name}{{{inner}}} {value:.6g}"
 
 
 class _TenantStats:
@@ -268,6 +349,10 @@ class Metrics:
         self._rate_hist: Dict[str, Deque[Tuple[float, float]]] = {}
         #: Per-tenant ingress accounting (ISSUE 7), bounded at TENANT_CAP.
         self._tenants: Dict[str, _TenantStats] = {}
+        #: Labeled-gauge families (ISSUE 9): name -> (label key,
+        #: {label value: (gauge value, last-set time)}), bounded at
+        #: LABELED_CAP labels per family.
+        self._labeled: Dict[str, Tuple[str, Dict[str, Tuple[float, float]]]] = {}
         self._t0 = time.monotonic()
 
     def inc(self, name: str, amount: float = 1.0) -> None:
@@ -281,6 +366,46 @@ class Metrics:
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._hists[name].observe(value)
+
+    def set_labeled_gauge(self, name: str, key: str, label: str,
+                          value: float) -> None:
+        """Set one sample of a labeled-gauge family (``name{key="label"}``).
+
+        THE bounded write path for labeled series (tunnelcheck TC12): at
+        LABELED_CAP distinct labels per family, the least-recently-set
+        label is evicted, so adversarial label minting cannot explode
+        exposition cardinality.  Values are escaped at render time."""
+        with self._lock:
+            fam = self._labeled.get(name)
+            if fam is None or fam[0] != key:
+                fam = (key, {})
+                self._labeled[name] = fam
+            samples = fam[1]
+            if label not in samples and len(samples) >= LABELED_CAP:
+                victim = min(samples, key=lambda l: samples[l][1])
+                del samples[victim]
+            samples[label] = (value, time.monotonic())
+
+    def labeled_gauge(self, name: str) -> Dict[str, float]:
+        """Current samples of one labeled-gauge family: {label: value}."""
+        with self._lock:
+            fam = self._labeled.get(name)
+            return {} if fam is None else {
+                l: v for l, (v, _t) in fam[1].items()
+            }
+
+    def prune_labeled_gauge(self, name: str, keep) -> None:
+        """Drop every label of family ``name`` not in ``keep`` — the
+        lifecycle half of the bounded-labels contract: a label whose
+        subject is GONE (a departed peer past its staleness TTL) must
+        leave the exposition, not report its last value forever."""
+        keep = set(keep)
+        with self._lock:
+            fam = self._labeled.get(name)
+            if fam is None:
+                return
+            for label in [l for l in fam[1] if l not in keep]:
+                del fam[1][label]
 
     def counter(self, name: str) -> float:
         with self._lock:
@@ -483,6 +608,10 @@ class Metrics:
                 )
                 for name, h in self._hists.items()
             }
+            labeled = {
+                name: (key, {l: v for l, (v, _t) in samples.items()})
+                for name, (key, samples) in self._labeled.items()
+            }
         tenants = self.tenant_snapshot()
         tenant_field = {
             "tenant_in_flight": "in_flight",
@@ -499,11 +628,18 @@ class Metrics:
                 kind = "counter" if name.endswith("_total") else "gauge"
                 lines.append(f"# TYPE {name} {kind}")
                 for t, row in tenants.items():
-                    label = t.replace("\\", "\\\\").replace('"', '\\"')
-                    lines.append(
-                        f'{name}{{tenant="{label}"}} '
-                        f'{row[tenant_field[name]]:.6g}'
-                    )
+                    lines.append(prom_sample(
+                        name, {"tenant": t}, row[tenant_field[name]]
+                    ))
+                continue
+            if "labeled {" in desc:
+                # Generic labeled-gauge families (fleet_*/slo_*): one
+                # sample per tracked label from the bounded store, none
+                # before the first write (the tenant_* convention).
+                lines.append(f"# TYPE {name} gauge")
+                key, samples = labeled.get(name, ("", {}))
+                for l in sorted(samples):
+                    lines.append(prom_sample(name, {key: l}, samples[l]))
                 continue
             if "(histogram" in desc:
                 lines.append(f"# TYPE {name} summary")
@@ -526,11 +662,137 @@ class Metrics:
             self._hists.clear()
             self._rate_hist.clear()
             self._tenants.clear()
+            self._labeled.clear()
             self._t0 = time.monotonic()
 
 
 #: Process-wide default registry.
 global_metrics = Metrics()
+
+
+# ---------------------------------------------------------------------------
+# federated exposition (ISSUE 9): the proxy's /metrics?fleet=1 merger
+# ---------------------------------------------------------------------------
+
+#: Metric-family prefixes that belong to a SERVE peer's process: the
+#: federation merger relabels these with ``peer="..."`` from each scraped
+#: exposition, and drops them from the proxy's local section (the proxy's
+#: own zero-valued copies of engine_*/serve_* series would otherwise sit
+#: unlabeled next to the real labeled ones — the TC06 silent-zero class,
+#: fleet edition).
+PEER_SCOPED_PREFIXES = ("engine_", "serve_", "tenant_", "transport_",
+                        "slo_")
+
+#: The subset the PROXY process actually writes: its lane in the fleet
+#: exposition carries only these (the proxy-side ARQ path) — relabeling
+#: its full-catalog zero-valued engine_*/serve_* copies would plant a
+#: phantom always-zero "proxy" engine peer in every by-peer dashboard
+#: aggregation.
+PROXY_LANE_PREFIXES = ("transport_",)
+
+#: A sample line: ``name{labels} value`` or ``name value`` (timestamps are
+#: never emitted by this registry and are not merged).  The label group is
+#: quote-aware: a ``}`` INSIDE a quoted label value (tenant ids are
+#: client-controlled strings) must not end the group early, or that
+#: series would be silently dropped from the fleet exposition.
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)"
+    r"(\{(?:[^\"}]|\"(?:[^\"\\]|\\.)*\")*\})?"
+    r"\s+(\S+)\s*$"
+)
+
+
+def sum_counter_samples(texts: "Dict[str, Optional[str]]", name: str) -> float:
+    """Sum one UNLABELED counter/gauge family across scraped expositions
+    (stale peers — None — contribute nothing).  The fleet aggregate
+    helper: e.g. serve_shed_total summed over every fresh peer."""
+    total = 0.0
+    for text in texts.values():
+        if not text:
+            continue
+        for line in text.splitlines():
+            m = _SAMPLE_RE.match(line)
+            if m and m.group(1) == name and not m.group(2):
+                try:
+                    total += float(m.group(3))
+                except ValueError:
+                    pass
+    return total
+
+
+def federate_prometheus_texts(
+    peer_texts: "Dict[str, Optional[str]]", local_text: str
+) -> str:
+    """Merge per-peer /metrics expositions into ONE fleet exposition.
+
+    Every sample of a peer-scoped family (PEER_SCOPED_PREFIXES) gains a
+    leading ``peer="<id>"`` label — existing labels (``{tenant=...}``,
+    ``{quantile=...}``, ``{objective=...}``) are preserved after it, so
+    per-tenant and summary series stay distinguishable per peer.  The
+    PROXY process is a lane too, restricted to the families it actually
+    writes (PROXY_LANE_PREFIXES — the live ``transport_*`` series of the
+    proxy-side ARQ path): those ride relabeled as ``peer="proxy"`` —
+    dropping them would blind a fleet dashboard to proxy-side retransmit
+    storms, while relabeling the proxy's full-catalog zero-valued
+    engine_*/serve_* copies would plant a phantom always-zero engine peer
+    in every by-peer aggregation.  HELP/TYPE metadata is
+    emitted once per family.  A peer whose scrape failed (value None)
+    contributes no samples — its absence is marked by the
+    ``fleet_peer_scrape_stale{peer=...}`` series the caller publishes into
+    the LOCAL registry before rendering ``local_text``.  The local
+    exposition additionally contributes the non-peer-scoped families
+    (proxy_*, fleet_*), unlabeled.
+
+    Label syntax interpolation is confined to this module (tunnelcheck
+    TC12); values pass through :func:`prom_label_escape`.
+    """
+    lines: List[str] = []
+    seen_meta: set = set()
+    sources = [
+        (pid, peer_texts[pid], PEER_SCOPED_PREFIXES)
+        for pid in sorted(peer_texts)
+    ]
+    sources.append(("proxy", local_text, PROXY_LANE_PREFIXES))
+    for pid, text, prefixes in sources:
+        if text is None:
+            continue
+        peer_prefix = f'peer="{prom_label_escape(pid)}"'
+        for line in text.splitlines():
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                    continue
+                fam = parts[2]
+                if not fam.startswith(prefixes):
+                    continue
+                meta_key = (parts[1], fam)
+                if meta_key in seen_meta:
+                    continue
+                seen_meta.add(meta_key)
+                lines.append(line)
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name, labels, value = m.groups()
+            if not name.startswith(prefixes):
+                continue
+            existing = labels[1:-1] if labels else ""
+            inner = f"{peer_prefix},{existing}" if existing else peer_prefix
+            lines.append(f"{name}{{{inner}}} {value}")
+    for line in local_text.splitlines():
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if (len(parts) >= 3 and parts[1] in ("HELP", "TYPE")
+                    and parts[2].startswith(PEER_SCOPED_PREFIXES)):
+                continue
+            lines.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is not None and m.group(1).startswith(PEER_SCOPED_PREFIXES):
+            continue
+        lines.append(line)
+    return "\n".join(lines) + "\n"
 
 
 def derived_retry_after_s(backlog: int, rate_name: str, gauge: str) -> float:

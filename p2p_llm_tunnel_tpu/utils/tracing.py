@@ -348,6 +348,161 @@ class TraceRecorder:
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+def stitch_chrome_traces(
+    sources: "Dict[str, Optional[dict]]",
+) -> Dict[str, object]:
+    """Merge per-process Chrome trace captures into ONE fleet trace with
+    per-peer process lanes (ISSUE 9).
+
+    ``sources`` maps a lane name (``"proxy"`` or a fabric peer id) to that
+    process's ``/healthz?trace=1`` capture, or None for a stale source
+    (scrape failed, peer dead).  Events are assigned to lanes:
+
+    1. ``proxy``-track events belong to the proxy lane (the ingress
+       process emitted them, whatever journal they were pulled from);
+    2. ``serve``-track events carrying a ``peer`` attr (stamped from the
+       Hello.peer handshake identity) belong to that peer's lane — this is
+       what puts a failover's sibling ``serve.dispatch`` spans on TWO
+       lanes under one trace id;
+    3. everything else inherits its parent span's lane (the engine chain
+       under a serve.dispatch), falling back to the journal it came from.
+
+    Duplicate records — the same span pulled via several journals, which
+    single-process loopback fabrics produce because every peer shares one
+    recorder — are merged by identity ``(span_id, name, ph, ts, dur)``;
+    cross-process captures whose counter-allocated span ids collide differ
+    in ``ts`` and are correctly kept distinct.
+
+    The result is a valid Chrome trace-event object (per-lane ``pid`` +
+    ``process_name`` metadata) plus a ``stitch`` summary: the sources
+    merged, the stale ones, and ``partial_traces`` — trace ids whose chain
+    is incomplete (an orphaned ``parent_id``, or a ``proxy.request`` that
+    names a serving peer contributing no spans: the peer's ring buffer
+    evicted the trace, or the peer died unscraped).  Partial chains are
+    FLAGGED, never an error — a fleet capture races eviction by design.
+    """
+    order = [s for s in sources if s == "proxy"] + sorted(
+        s for s in sources if s != "proxy"
+    )
+    stale = [s for s in order if not isinstance(sources[s], dict)]
+
+    # -- collect + dedupe -------------------------------------------------
+    records: List[dict] = []  # each: {"ev": ..., "src": lane}
+    seen: Dict[tuple, int] = {}
+    for src in order:
+        obj = sources[src]
+        if not isinstance(obj, dict):
+            continue
+        for ev in obj.get("traceEvents", ()):
+            if not isinstance(ev, dict) or ev.get("ph") == "M":
+                continue
+            args = ev.get("args", {})
+            key = (args.get("span_id"), ev.get("name"), ev.get("ph"),
+                   ev.get("ts"), ev.get("dur"))
+            if key in seen:
+                continue
+            seen[key] = len(records)
+            records.append({"ev": ev, "src": src})
+
+    # -- lane assignment --------------------------------------------------
+    span_lane: Dict[str, str] = {}
+    lanes: Dict[int, Optional[str]] = {}
+    for i, rec in enumerate(records):
+        ev = rec["ev"]
+        args = ev.get("args", {})
+        lane: Optional[str] = None
+        if ev.get("cat") == "proxy":
+            lane = "proxy"
+        elif ev.get("cat") == "serve" and args.get("peer"):
+            lane = str(args["peer"])
+        lanes[i] = lane
+        if lane is not None and args.get("span_id"):
+            span_lane[str(args["span_id"])] = lane
+    for _pass in range(8):  # parent chains are short; bounded propagation
+        changed = False
+        for i, rec in enumerate(records):
+            if lanes[i] is not None:
+                continue
+            parent = rec["ev"].get("args", {}).get("parent_id")
+            if parent and str(parent) in span_lane:
+                lanes[i] = span_lane[str(parent)]
+                sid = rec["ev"].get("args", {}).get("span_id")
+                if sid:
+                    span_lane[str(sid)] = lanes[i]
+                changed = True
+        if not changed:
+            break
+    for i, rec in enumerate(records):
+        if lanes[i] is None:
+            lanes[i] = rec["src"]
+
+    # -- partial-chain detection -----------------------------------------
+    known_spans = {
+        str(r["ev"]["args"]["span_id"])
+        for r in records
+        if r["ev"].get("args", {}).get("span_id")
+    }
+    trace_lanes: Dict[str, set] = {}
+    for i, rec in enumerate(records):
+        tid = rec["ev"].get("args", {}).get("trace_id")
+        if tid:
+            trace_lanes.setdefault(str(tid), set()).add(lanes[i])
+    partial: set = set()
+    for i, rec in enumerate(records):
+        args = rec["ev"].get("args", {})
+        tid = args.get("trace_id")
+        if not tid:
+            continue
+        parent = args.get("parent_id")
+        if (parent and str(parent) not in known_spans
+                and rec["ev"].get("name") != "proxy.request"):
+            # proxy.request may legitimately parent to an uncaptured
+            # client-sent span; everything else orphaned = missing link.
+            partial.add(str(tid))
+        if (rec["ev"].get("name") == "proxy.request" and args.get("peer")
+                and str(args["peer"]) not in trace_lanes.get(str(tid), ())):
+            partial.add(str(tid))
+
+    # -- emit with per-lane pids ------------------------------------------
+    all_lanes = set(order) | {l for l in lanes.values() if l}
+    lane_order = (["proxy"] if "proxy" in all_lanes else []) + sorted(
+        all_lanes - {"proxy"}
+    )
+    pid_of = {lane: i + 1 for i, lane in enumerate(lane_order)}
+    tids: Dict[tuple, int] = {}
+    events: List[Dict[str, object]] = []
+    for i, rec in enumerate(records):
+        lane = lanes[i]
+        ev = dict(rec["ev"])
+        ev["pid"] = pid_of[lane]
+        ev["tid"] = tids.setdefault(
+            (lane, ev.get("cat", "")), len(
+                [1 for (l, _c) in tids if l == lane]
+            ) + 1,
+        )
+        events.append(ev)
+    meta: List[Dict[str, object]] = []
+    for lane in lane_order:
+        name = lane if lane == "proxy" else f"peer:{lane}"
+        if lane in stale:
+            name += " (stale)"
+        meta.append({"ph": "M", "name": "process_name",
+                     "pid": pid_of[lane], "tid": 0, "args": {"name": name}})
+    for (lane, cat), tid in tids.items():
+        meta.append({"ph": "M", "name": "thread_name",
+                     "pid": pid_of[lane], "tid": tid,
+                     "args": {"name": cat or "events"}})
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "stitch": {
+            "sources": order,
+            "stale": stale,
+            "partial_traces": sorted(partial),
+        },
+    }
+
+
 def validate_chrome_trace(obj: object) -> bool:
     """Validate an exported trace against the Chrome trace-event schema
     subset this recorder emits; raises ValueError on the first problem.

@@ -236,6 +236,110 @@ def tenant_rows(per_tenant: Dict[str, List[ReqResult]]) -> List[dict]:
     return rows
 
 
+#: Unlabeled families sampled by --metrics-poll (sheds, queue pressure,
+#: token throughput, ingress volume), plus summary quantiles from
+#: POLL_QUANTILES — picked so a PERF.md round can plot sheds/TTFT over the
+#: run instead of only the end-state row.
+POLL_KEYS = (
+    "engine_tokens_total",
+    "serve_shed_total",
+    "engine_tenant_sheds_total",
+    "engine_queue_depth",
+    "engine_batch_occupancy",
+    "proxy_requests_total",
+)
+POLL_QUANTILES = {
+    "engine_ttft_ms": ("0.5", "0.99"),
+    "proxy_ttfb_ms": ("0.5", "0.99"),
+}
+
+
+def parse_metrics_sample(text: str) -> Dict[str, float]:
+    """Pull the POLL_KEYS/POLL_QUANTILES samples out of one Prometheus
+    text exposition (quantile keys land as ``<name>_q<q>``)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition(" ")
+        base, _, label = name.partition("{")
+        try:
+            value = float(rest.strip())
+        except ValueError:
+            continue
+        if not label and base in POLL_KEYS:
+            out[base] = value
+        elif label and base in POLL_QUANTILES:
+            for q in POLL_QUANTILES[base]:
+                if f'quantile="{q}"' in label:
+                    out[f"{base}_q{q}"] = value
+    return out
+
+
+async def fetch_metrics(host: str, port: int,
+                        path: str = "/metrics",
+                        timeout: float = 5.0) -> Optional[str]:
+    """One GET ``path`` as raw text, bounded by ``timeout``; None when
+    unreachable OR when the server accepts but never finishes the
+    response — a wedged stack (exactly what the stuck-task accounting
+    exists to surface) must yield an error row, not freeze the poller."""
+
+    async def inner() -> str:
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write((f"GET {path} HTTP/1.1\r\nhost: {host}\r\n"
+                      "connection: close\r\n\r\n").encode())
+        await writer.drain()
+        _status, headers = await _read_headers(reader)
+        body = b""
+        async for chunk in _iter_body(reader, headers):
+            body += chunk
+        writer.close()
+        return body.decode("utf-8", "replace")
+
+    try:
+        return await asyncio.wait_for(inner(), timeout)
+    except (ConnectionError, OSError, ValueError,
+            asyncio.IncompleteReadError, asyncio.TimeoutError):
+        return None
+
+
+async def metrics_poller(host: str, port: int, interval: float,
+                         t0: float, rows: List[dict]) -> None:
+    """Sample the stack's metrics every ``interval`` seconds for the
+    duration of the herd (--metrics-poll); each row is timestamped
+    relative to the run start.  TWO scrapes per tick: bare ``/metrics``
+    tunnels to the SERVE peer's registry (the engine_*/serve_* keys),
+    while ``/metrics?local=1`` answers from the PROXY process — the only
+    place the proxy_* families are real; the tunneled exposition renders
+    them as full-catalog zeros (the TC06 silent-zero class).  A failed
+    scrape records an error row — a gap in the timeline should be
+    visible, not silent."""
+    scrape_timeout = max(1.0, interval)
+    while True:
+        serve_text = await fetch_metrics(
+            host, port, "/metrics", scrape_timeout)
+        proxy_text = await fetch_metrics(
+            host, port, "/metrics?local=1", scrape_timeout)
+        row: Dict[str, object] = {"t": round(time.monotonic() - t0, 1)}
+        if serve_text is None and proxy_text is None:
+            row["error"] = "unreachable"
+        else:
+            if serve_text is not None:
+                row.update({
+                    k: v
+                    for k, v in parse_metrics_sample(serve_text).items()
+                    if not k.startswith("proxy_")
+                })
+            if proxy_text is not None:
+                row.update({
+                    k: v
+                    for k, v in parse_metrics_sample(proxy_text).items()
+                    if k.startswith("proxy_")
+                })
+        rows.append(row)
+        await asyncio.sleep(interval)
+
+
 async def fetch_healthz(host: str, port: int) -> Optional[dict]:
     try:
         reader, writer = await asyncio.open_connection(host, port)
@@ -270,6 +374,12 @@ async def run_load(args) -> dict:
     per_tenant: Dict[str, List[ReqResult]] = {}
     tasks = []
     t0 = time.monotonic()
+    timeline: List[dict] = []
+    poller = None
+    if args.metrics_poll > 0:
+        poller = asyncio.create_task(metrics_poller(
+            args.host, args.port, args.metrics_poll, t0, timeline,
+        ))
     for name, clients, requests in args.tenants:
         results = per_tenant.setdefault(name, [])
         for i in range(clients):
@@ -283,6 +393,9 @@ async def run_load(args) -> dict:
     done, pending = await asyncio.wait(tasks, timeout=args.timeout)
     for t in pending:
         t.cancel()
+    if poller is not None:
+        poller.cancel()
+        await asyncio.gather(poller, return_exceptions=True)
     # Retrieve every task's outcome: cancelled stragglers AND tasks that
     # died with an uncaught exception (whose remaining requests would
     # otherwise vanish from the report with the exit code still 0).
@@ -304,7 +417,7 @@ async def run_load(args) -> dict:
     if not args.no_healthz:
         await asyncio.sleep(0.5)  # let the server settle before leak check
         healthz = await fetch_healthz(args.host, args.port)
-    return {
+    out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
         "stuck_tasks": stuck,
@@ -320,6 +433,12 @@ async def run_load(args) -> dict:
             "retry_after_s": healthz.get("retry_after_s"),
         },
     }
+    if args.metrics_poll > 0:
+        # The in-run timeline next to the summary row (--metrics-poll):
+        # sheds/TTFT/queue depth sampled every poll interval, so a PERF
+        # round plots the run's shape instead of its end state.
+        out["metrics_timeline"] = timeline
+    return out
 
 
 def spawn_stack(args) -> Tuple[subprocess.Popen, int]:
@@ -383,6 +502,12 @@ def main(argv=None) -> int:
                     help="whole-run budget; clients past it count as STUCK")
     ap.add_argument("--no-healthz", action="store_true",
                     help="skip the post-run /healthz leak check")
+    ap.add_argument("--metrics-poll", type=float, default=0.0,
+                    help="sample the stack's /metrics every S seconds "
+                         "during the herd and emit the rows as a "
+                         "'metrics_timeline' key next to the summary "
+                         "(sheds, queue depth, token counters, TTFT/TTFB "
+                         "quantiles; 0 = off)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output only")
     ap.add_argument("--spawn", action="store_true",

@@ -110,9 +110,34 @@ def summarize(trace: dict) -> dict:
             if e is not None and e["args"].get("tenant"):
                 tenant = e["args"]["tenant"]
                 break
+        # Per-peer attribution (ISSUE 9): serve.dispatch spans carry the
+        # fabric peer id the serve side learned at handshake.  `peers`
+        # lists every peer that touched the request — a failover shows
+        # two — and `peer` is the one whose dispatch parented the first
+        # engine generation (i.e. the peer that actually SERVED it),
+        # falling back to proxy.request's own peer attr (the peer that
+        # completed the relay) for captures without engine spans.
+        dispatches = spans.get("serve.dispatch", ())
+        peers = sorted({
+            e["args"]["peer"] for e in dispatches if e["args"].get("peer")
+        })
+        peer = None
+        if eng is not None:
+            eng_parent = eng["args"].get("parent_id")
+            for e in dispatches:
+                if (eng_parent and e["args"].get("span_id") == eng_parent
+                        and e["args"].get("peer")):
+                    peer = e["args"]["peer"]
+                    break
+        if peer is None and prx is not None:
+            peer = prx["args"].get("peer")
+        if peer is None and len(peers) == 1:
+            peer = peers[0]
         requests.append({
             "trace_id": tid,
             "tenant": tenant,
+            "peer": peer,
+            "peers": peers,
             "path": (top or {}).get("args", {}).get("path"),
             "status": (prx or {}).get("args", {}).get("status"),
             "finish": (eng or {}).get("args", {}).get("finish"),
@@ -153,6 +178,34 @@ def summarize(trace: dict) -> dict:
             }
             for t in sorted(counts)
         }
+    # Per-peer TTFT rollup (ISSUE 9) — present only when the capture
+    # carries fabric peer identities (stitched fleet traces, fabric
+    # peers), so single-peer captures render unchanged.  `failovers`
+    # counts requests that touched more than one peer: their TTFT
+    # attributes to the peer that finally served them, and the count says
+    # how much of a peer's tail is failover recovery rather than its own
+    # serving latency.
+    if any(r["peer"] or r["peers"] for r in requests):
+        by_peer: Dict[str, List[float]] = {}
+        pcounts: Dict[str, int] = {}
+        pfail: Dict[str, int] = {}
+        for r in requests:
+            p = r["peer"] or "-"
+            pcounts[p] = pcounts.get(p, 0) + 1
+            if len(r["peers"]) > 1:
+                pfail[p] = pfail.get(p, 0) + 1
+            if r["ttft_ms"] is not None:
+                by_peer.setdefault(p, []).append(r["ttft_ms"])
+        aggregate["by_peer"] = {
+            p: {
+                "requests": pcounts[p],
+                "failovers": pfail.get(p, 0),
+                "ttft_p50_ms": _pct(by_peer.get(p, []), 50),
+                "ttft_p99_ms": _pct(by_peer.get(p, []), 99),
+                "ttft_p999_ms": _pct(by_peer.get(p, []), 99.9),
+            }
+            for p in sorted(pcounts)
+        }
     scope = {
         name: {"count": len(xs), "p50_ms": _pct(xs, 50)}
         for name, xs in sorted(engine_scope.items())
@@ -186,16 +239,22 @@ def main(argv=None) -> int:
         layers = "->".join(
             t for t in ("proxy", "serve", "engine") if t in r["layers"]
         )
+        where = f" @ {'+'.join(r['peers'])}" if r["peers"] else ""
         print(f"{r['trace_id'][:12]:12} {_fmt(r['total_ms'])} "
               f"{_fmt(r['ttft_ms'])} {_fmt(r['queue_wait_ms'])} "
               f"{_fmt(r['prefill_exec_ms'])} {_fmt(r['park_ms'])}  "
-              f"{layers} / {r['finish'] or '-'}")
+              f"{layers} / {r['finish'] or '-'}{where}")
     agg = out["aggregate"]
     print(f"-- {agg['requests']} request(s); engine TTFT ms "
           f"p50={agg['ttft_p50_ms']} p99={agg['ttft_p99_ms']} "
           f"p999={agg['ttft_p999_ms']}")
     for t, row in (agg.get("by_tenant") or {}).items():
         print(f"-- tenant {t}: n={row['requests']} TTFT ms "
+              f"p50={row['ttft_p50_ms']} p99={row['ttft_p99_ms']} "
+              f"p999={row['ttft_p999_ms']}")
+    for p, row in (agg.get("by_peer") or {}).items():
+        print(f"-- peer {p}: n={row['requests']} "
+              f"failovers={row['failovers']} TTFT ms "
               f"p50={row['ttft_p50_ms']} p99={row['ttft_p99_ms']} "
               f"p999={row['ttft_p999_ms']}")
     for name, s in out["engine_scope"].items():

@@ -1,0 +1,92 @@
+"""`make bench-smoke` schema stability (ISSUE 9): the bench result-row
+keys are a CONTRACT — CI appends smoke rows to trend files, so a renamed
+or dropped key corrupts every downstream reader silently.
+
+Fast and engine-free: the row-builder dict in bench._run_attempt is
+cross-checked STATICALLY (ast) against bench.RESULT_ROW_KEYS, and both
+against the list pinned here — three copies that must move in lockstep,
+so drift in any one of them fails loudly.  (_run_attempt itself also
+raises at runtime on drift; `make bench-smoke` exercises that path on a
+real tiny CPU run.)
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The pinned schema.  Changing it is an intentional, reviewed act: update
+#: bench.RESULT_ROW_KEYS, the row builder, and THIS list together.
+PINNED_ROW_KEYS = (
+    "platform", "metric", "value", "unit", "vs_baseline",
+    "ttft_p50_ms", "ttft_p99_ms", "ttft_p999_ms",
+    "ttfb_p50_ms", "ttfb_p99_ms", "ttfb_p999_ms",
+    "engine_ttft_p50_ms", "engine_ttft_p99_ms",
+    "queue_wait_p50_ms", "prefill_exec_p50_ms",
+    "prefill_p50_ms", "decode_fetch_p50_ms",
+    "mfu", "model", "quant", "quant_group_size", "prefill_act_quant",
+    "kv_quant", "flash_decode", "flash_sgrid", "fused_decode_layer",
+    "decode_kernels_per_step", "prefix_cache", "spec_ngram",
+    "mux", "mux_budget_tokens", "mux_prefill_chunk",
+    "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
+    "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
+    "wall_s",
+)
+
+
+def _bench_module():
+    spec = importlib.util.spec_from_file_location(
+        "bench_schema_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _builder_dict_keys() -> list:
+    """The literal keys of the `row = {...}` dict inside _run_attempt,
+    extracted statically — the builder cannot drift from the pinned list
+    without this test noticing, and nothing heavy ever runs."""
+    tree = ast.parse(open(os.path.join(REPO, "bench.py")).read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.AsyncFunctionDef)
+                and node.name == "_run_attempt"):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                        and sub.targets[0].id == "row"
+                        and isinstance(sub.value, ast.Dict)):
+                    return [
+                        k.value for k in sub.value.keys
+                        if isinstance(k, ast.Constant)
+                    ]
+    raise AssertionError("bench._run_attempt row dict not found")
+
+
+def test_result_row_keys_pinned():
+    bench = _bench_module()
+    assert tuple(bench.RESULT_ROW_KEYS) == PINNED_ROW_KEYS
+
+
+def test_row_builder_matches_declared_schema():
+    keys = _builder_dict_keys()
+    assert len(keys) == len(set(keys)), "duplicate keys in the row builder"
+    assert tuple(keys) == PINNED_ROW_KEYS
+
+
+def test_finalize_preserves_schema_and_adds_only_driver_keys():
+    """_finalize may ADD driver-facing keys but must never rename or drop
+    a row key — a CPU smoke row keeps the full schema with vs_baseline
+    nulled and no_tpu set."""
+    bench = _bench_module()
+    row = {k: 0 for k in PINNED_ROW_KEYS}
+    row["platform"] = "cpu"
+    out = bench._finalize(dict(row))
+    assert set(PINNED_ROW_KEYS) <= set(out)
+    assert out["no_tpu"] is True and out["vs_baseline"] is None
+    assert json.dumps(out)  # the row stays a single serializable JSON line

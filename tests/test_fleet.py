@@ -1,0 +1,470 @@
+"""Fleet observability plane (ISSUE 9): federated /metrics, stitched
+cross-peer traces, and SLO burn verdicts over a loopback fabric.
+
+The acceptance scenario is a 3-peer fabric with one ``kill=``-induced peer
+death, run TWICE per seed: the federated exposition must carry every live
+peer's engine series under distinct ``peer`` labels plus a staleness
+marker for the killed peer (returned within the bounded scrape timeout —
+no hang), the stitched Chrome trace must show a failed-over request's
+serve.dispatch spans on TWO peer lanes under one trace id, and the
+/healthz ``slo`` burn verdicts must be identical across the seeded runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+import random
+import time
+
+from p2p_llm_tunnel_tpu.endpoints import http11
+from p2p_llm_tunnel_tpu.endpoints.peerset import FLEET_SCRAPE_TIMEOUT
+from p2p_llm_tunnel_tpu.endpoints.proxy import ProxyState, run_proxy_fabric
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+from p2p_llm_tunnel_tpu.transport.chaos import ChaosChannel, ChaosSpec
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.slo import default_objectives, global_slo
+from p2p_llm_tunnel_tpu.utils.tracing import (
+    global_tracer,
+    validate_chrome_trace,
+)
+
+SEED = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+async def _start_peer(state: ProxyState, pid: str, backend,
+                      chaos: str = ""):
+    """One serve peer over loopback, admitted into ``state``; the
+    proxy-side channel optionally rides a seeded chaos schedule."""
+    serve_ch, proxy_ch = loopback_pair()
+    task = asyncio.create_task(run_serve(serve_ch, backend=backend))
+    if chaos:
+        proxy_ch = ChaosChannel(proxy_ch, ChaosSpec.parse(chaos))
+    link = await state.admit(proxy_ch, peer_id=pid)
+    return serve_ch, proxy_ch, task, link
+
+
+@contextlib.asynccontextmanager
+async def _fabric_listener(state: ProxyState):
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    task = asyncio.create_task(
+        run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+    port = await asyncio.wait_for(ready, 5)
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+async def _ok_backend(req, body):
+    async def chunks():
+        yield b"ok"
+
+    return 200, {"content-type": "text/plain"}, chunks()
+
+
+# ---------------------------------------------------------------------------
+# (a) federated /metrics: peer labels, staleness markers, bounded time
+# ---------------------------------------------------------------------------
+
+#: Chaos kill index for the doomed peer's proxy-side channel: HELLO is
+#: send 0, the fleet scrape's REQ_HEADERS is send 1, and its REQ_END —
+#: send 2 — trips the kill, so the FIRST fleet scrape loses the channel
+#: mid-request, deterministically in message count, every run.
+_KILL_AT_SCRAPE = 2
+
+
+def _fleet_metrics_run(seed: int) -> dict:
+    """One seeded 3-peer federation run; returns the record two runs must
+    agree on."""
+
+    async def main():
+        random.seed(seed)
+        state = ProxyState(fabric=True)
+        async with _fabric_listener(state) as base:
+            tasks = []
+            for pid, chaos in (
+                ("peer0", f"kill={_KILL_AT_SCRAPE},seed={seed}"),
+                ("peer1", ""),
+                ("peer2", ""),
+            ):
+                _, _, task, _ = await _start_peer(
+                    state, pid, _ok_backend, chaos=chaos)
+                tasks.append(task)
+            try:
+                t0 = time.monotonic()
+                resp = await http11.http_request(
+                    "GET", f"{base}/metrics?fleet=1", timeout=15)
+                text = (await resp.read_all()).decode()
+                elapsed = time.monotonic() - t0
+                # Bounded: the killed peer cost at most the per-peer
+                # scrape timeout, and scrapes run concurrently.
+                assert elapsed < FLEET_SCRAPE_TIMEOUT + 3.0, elapsed
+
+                # The killed peer is out of the dispatchable set but NOT
+                # out of the fleet's view: it answers as a stale marker.
+                snap_resp = await http11.http_request(
+                    "GET", f"{base}/healthz?local=1", timeout=5)
+                snap = json.loads(await snap_resp.read_all())
+                return {
+                    "status": resp.status,
+                    "live_labels": sorted(
+                        pid for pid in ("peer0", "peer1", "peer2")
+                        if 'engine_tokens_total{peer="' + pid + '"}' in text
+                    ),
+                    "stale_marker_1": sorted(
+                        pid for pid in ("peer0", "peer1", "peer2")
+                        if 'fleet_peer_scrape_stale{peer="' + pid + '"} 1'
+                        in text
+                    ),
+                    "stale_marker_0": sorted(
+                        pid for pid in ("peer0", "peer1", "peer2")
+                        if 'fleet_peer_scrape_stale{peer="' + pid + '"} 0'
+                        in text
+                    ),
+                    "fleet_live_line": "fleet_peers_live 2" in text,
+                    "tenant_labeled_dropped_unlabeled": (
+                        "\nengine_tokens_total 0" not in text
+                    ),
+                    "proxy_lane": (
+                        'transport_cwnd{peer="proxy"}' in text
+                    ),
+                    "no_phantom_proxy_engine": (
+                        'engine_tokens_total{peer="proxy"}' not in text
+                    ),
+                    "help_once": text.count(
+                        "# HELP engine_tokens_total ") == 1,
+                    "snap_fleet": {
+                        "peers_live": snap["fleet"]["peers_live"],
+                        "stale_peers": snap["fleet"]["stale_peers"],
+                    },
+                }
+            finally:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+    return run(main())
+
+
+def test_fleet_metrics_kill_staleness_two_run_deterministic():
+    one = _fleet_metrics_run(SEED)
+    two = _fleet_metrics_run(SEED)
+    assert one == two, f"seeded runs diverged:\n{one}\n{two}"
+    assert one["status"] == 200
+    # Every live peer's engine series under a distinct peer label...
+    assert one["live_labels"] == ["peer1", "peer2"]
+    # ...the killed peer as an explicit staleness marker, never a hang...
+    assert one["stale_marker_1"] == ["peer0"]
+    assert one["stale_marker_0"] == ["peer1", "peer2"]
+    # ...plus the fleet aggregates, with the proxy's unlabeled zero-copy
+    # of peer-scoped series dropped and metadata emitted once.
+    assert one["fleet_live_line"] is True
+    assert one["tenant_labeled_dropped_unlabeled"] is True
+    assert one["help_once"] is True
+    # The proxy process is a lane too: its own transport series ride
+    # relabeled rather than vanishing from the fleet surface — but ONLY
+    # the families it writes, so no phantom always-zero engine peer.
+    assert one["proxy_lane"] is True
+    assert one["no_phantom_proxy_engine"] is True
+    # /healthz?local=1 serves the same data as its fleet section.
+    assert one["snap_fleet"] == {"peers_live": 2,
+                                 "stale_peers": ["peer0"]}
+
+
+# ---------------------------------------------------------------------------
+# (b) stitched cross-peer trace: failover spans on two peer lanes
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def tracing_on():
+    global_tracer.clear()
+    global_tracer.configure(enabled=True, sample=1.0, capacity=16384)
+    try:
+        yield
+    finally:
+        global_tracer.configure(enabled=False, sample=1.0)
+        global_tracer.clear()
+
+
+def test_stitched_trace_shows_failover_on_two_peer_lanes():
+    """A request that fails over from peer-a to peer-b appears in the
+    stitched fleet trace as ONE trace id with sibling serve.dispatch spans
+    on two distinct process lanes, schema-valid end to end."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate_a = asyncio.Event()
+
+        async def backend_a(req, body):
+            await gate_a.wait()  # holds the request pre-headers forever
+
+            async def chunks():
+                yield b"from-A"
+
+            return 200, {}, chunks()
+
+        async with _fabric_listener(state) as base:
+            _, proxy_a, task_a, link_a = await _start_peer(
+                state, "peer-a", backend_a)
+            req = asyncio.create_task(
+                http11.http_request("GET", f"{base}/gen", timeout=10))
+            while link_a.inflight != 1:
+                await asyncio.sleep(0.01)
+            _, _, task_b, _ = await _start_peer(
+                state, "peer-b", _ok_backend)
+            proxy_a.close()
+            resp = await req
+            assert resp.status == 200
+            assert await resp.read_all() == b"ok"
+
+            # peer-a's serve loop must have recorded its aborted dispatch
+            # span before we pull the journals.
+            await asyncio.gather(task_a, return_exceptions=True)
+
+            r = await http11.http_request(
+                "GET", f"{base}/healthz?trace=1&fleet=1", timeout=10)
+            stitched = json.loads(await r.read_all())
+            validate_chrome_trace(stitched)
+
+            dispatches = [
+                ev for ev in stitched["traceEvents"]
+                if ev.get("name") == "serve.dispatch"
+                and ev["args"].get("path") == "/gen"
+            ]
+            assert len(dispatches) == 2
+            # One trace id across both dispatch attempts...
+            tids = {ev["args"]["trace_id"] for ev in dispatches}
+            assert len(tids) == 1
+            # ...on two DISTINCT process lanes, labeled by handshake id.
+            assert {ev["args"]["peer"] for ev in dispatches} == \
+                {"peer-a", "peer-b"}
+            assert len({ev["pid"] for ev in dispatches}) == 2
+            # The proxy's root span shares the trace id on its own lane.
+            roots = [
+                ev for ev in stitched["traceEvents"]
+                if ev.get("name") == "proxy.request"
+                and ev["args"].get("trace_id") in tids
+            ]
+            assert roots and all(
+                ev["pid"] not in {d["pid"] for d in dispatches}
+                for ev in roots
+            )
+            # Lane metadata names the peers; the dead peer's journal was
+            # unpullable, so it is flagged stale.
+            names = {
+                ev["args"]["name"] for ev in stitched["traceEvents"]
+                if ev.get("ph") == "M" and ev["name"] == "process_name"
+            }
+            assert "proxy" in names
+            assert any(n.startswith("peer:peer-a") for n in names)
+            assert "peer:peer-b" in names
+            assert "peer-a" in stitched["stitch"]["stale"]
+            task_b.cancel()
+            await asyncio.gather(task_b, return_exceptions=True)
+
+    with tracing_on():
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# (c) SLO verdicts: identical across two seeded runs, degraded wiring
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def slo_on():
+    global_slo.configure(
+        enabled=True, objectives=default_objectives(), min_events=0,
+    )
+    try:
+        yield
+    finally:
+        global_slo.configure(
+            enabled=False, objectives=default_objectives(),
+            min_events=None,
+        )
+
+
+def _slo_run(seed: int) -> dict:
+    """One seeded 2-peer run with a deterministic availability fault mix:
+    4 good requests + 1 upstream failure."""
+
+    async def main():
+        random.seed(seed)
+        state = ProxyState(fabric=True)
+
+        async def backend(req, body):
+            if req.path == "/boom":
+                raise RuntimeError("injected upstream failure")
+            return await _ok_backend(req, body)
+
+        async with _fabric_listener(state) as base:
+            tasks = []
+            for pid in ("peer1", "peer2"):
+                _, _, task, _ = await _start_peer(state, pid, backend)
+                tasks.append(task)
+            try:
+                for i in range(4):
+                    r = await http11.http_request(
+                        "GET", f"{base}/gen{i}", timeout=10)
+                    assert r.status == 200
+                    await r.read_all()
+                r = await http11.http_request(
+                    "GET", f"{base}/boom", timeout=10)
+                assert r.status == 502
+                await r.read_all()
+
+                hz = await http11.http_request(
+                    "GET", f"{base}/healthz", timeout=10)
+                body = json.loads(await hz.read_all())
+                return {
+                    "http_status": hz.status,
+                    "status": body["status"],
+                    "slo": body["slo"],
+                }
+            finally:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+    return run(main())
+
+
+def test_slo_verdicts_identical_across_seeded_runs_and_degrade_health():
+    with slo_on():
+        one = _slo_run(SEED)
+        global_slo.reset()
+        two = _slo_run(SEED)
+    assert one == two, f"seeded runs diverged:\n{one}\n{two}"
+    # 1 failure / 5 requests against a 99.9% objective: burn 200x in both
+    # windows -> breached, and the burning/breached verdict degrades the
+    # peer's health (503 + degraded) so fabric routing can steer around it.
+    avail = one["slo"]["objectives"]["availability"]
+    assert avail["state"] == "breached"
+    assert avail["events_slow"] == 5
+    assert avail["burn_fast"] == avail["burn_slow"] == 200.0
+    assert one["slo"]["alerting"] is True
+    assert one["status"] == "degraded" and one["http_status"] == 503
+    # The ttft objective has no engine feeding it here: ok, zero events.
+    assert one["slo"]["objectives"]["ttft"]["state"] == "ok"
+
+
+def test_slo_disabled_leaves_healthz_ok():
+    """The library-default posture: with the SLO engine disabled, the same
+    failure mix leaves /healthz ok — bare run_serve embeddings and every
+    pre-ISSUE-9 test keep their health semantics."""
+    out = _slo_run(SEED)
+    assert out["status"] == "ok" and out["http_status"] == 200
+    assert out["slo"]["enabled"] is False
+    assert out["slo"]["alerting"] is False
+
+
+# ---------------------------------------------------------------------------
+# fleet surfaces with zero peers: answer, never hang
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_surfaces_answer_with_no_peers():
+    async def main():
+        state = ProxyState(fabric=True)
+        async with _fabric_listener(state) as base:
+            r = await http11.http_request(
+                "GET", f"{base}/metrics?fleet=1", timeout=5)
+            text = (await r.read_all()).decode()
+            assert r.status == 200
+            assert "fleet_peers_live 0" in text
+            assert "proxy_requests_total" in text
+            r = await http11.http_request(
+                "GET", f"{base}/healthz?trace=1&fleet=1", timeout=5)
+            stitched = json.loads(await r.read_all())
+            validate_chrome_trace(stitched)
+            assert stitched["stitch"]["sources"] == ["proxy"]
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# merger + staleness-lifecycle units (review-find regressions)
+# ---------------------------------------------------------------------------
+
+
+def test_federation_keeps_brace_in_quoted_label_value():
+    """Tenant ids are client-controlled: a '}' INSIDE a quoted label value
+    must not end the label group early and silently drop the series from
+    the fleet exposition."""
+    from p2p_llm_tunnel_tpu.utils.metrics import federate_prometheus_texts
+
+    peer_text = (
+        "# HELP tenant_requests_total x\n"
+        "# TYPE tenant_requests_total counter\n"
+        'tenant_requests_total{tenant="a}b"} 5\n'
+    )
+    out = federate_prometheus_texts({"p1": peer_text}, "")
+    assert 'tenant_requests_total{peer="p1",tenant="a}b"} 5' in out
+
+
+def test_stale_marker_expires_with_the_departed_ttl():
+    """A departed peer past DEPARTED_TTL_S leaves the scrape set — its
+    staleness marker must leave the exposition with it, not read 1
+    forever."""
+    from p2p_llm_tunnel_tpu.endpoints.peerset import PeerSet
+
+    ps = PeerSet(fabric=True)
+    ps.publish_fleet_gauges({"gone": None, "alive": "serve_shed_total 0\n"})
+    assert global_metrics.labeled_gauge(
+        "fleet_peer_scrape_stale") == {"gone": 1.0, "alive": 0.0}
+    # Next fleet snapshot no longer includes the long-dead peer.
+    ps.publish_fleet_gauges({"alive": "serve_shed_total 0\n"})
+    assert global_metrics.labeled_gauge(
+        "fleet_peer_scrape_stale") == {"alive": 0.0}
+
+
+def test_fetch_timeout_covers_a_send_that_never_completes():
+    """A peer that stopped READING blocks channel.send itself; the fleet
+    scrape bound must cover the sends, not just the response wait."""
+    from p2p_llm_tunnel_tpu.endpoints.peerset import PeerLink, PeerSet
+
+    async def main():
+        class _WedgedChannel:
+            async def send(self, data):
+                await asyncio.Event().wait()  # never returns
+
+        ps = PeerSet(fabric=True)
+        link = PeerLink("wedged", _WedgedChannel())
+        link.ready = True
+        t0 = time.monotonic()
+        assert await ps.fetch(link, "/metrics", timeout=0.2) is None
+        assert time.monotonic() - t0 < 2.0
+        assert link.pending == {}
+
+    run(main())
+
+
+def test_fleet_sheds_sum_carries_forward_over_transient_staleness():
+    """A transient scrape timeout must not dip fleet_sheds_summed by a
+    whole peer's contribution (operators rate() it — the dip would read
+    as a huge spurious excursion); the stale peer carries its last-known
+    value until it leaves the scrape set entirely."""
+    from p2p_llm_tunnel_tpu.endpoints.peerset import PeerSet
+
+    ps = PeerSet(fabric=True)
+    fresh_a = "serve_shed_total 600\nengine_tenant_sheds_total 0\n"
+    fresh_b = "serve_shed_total 400\nengine_tenant_sheds_total 0\n"
+    ps.publish_fleet_gauges({"a": fresh_a, "b": fresh_b})
+    assert global_metrics.gauge("fleet_sheds_summed") == 1000.0
+    # b times out once: its 400 carries forward, no dip.
+    ps.publish_fleet_gauges({"a": fresh_a, "b": None})
+    assert global_metrics.gauge("fleet_sheds_summed") == 1000.0
+    # b leaves the scrape set (departed past TTL): a real peer-set change.
+    ps.publish_fleet_gauges({"a": fresh_a})
+    assert global_metrics.gauge("fleet_sheds_summed") == 600.0

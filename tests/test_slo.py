@@ -1,0 +1,256 @@
+"""SLO burn-rate engine (ISSUE 9, utils/slo.py): fake-clock unit tests.
+
+The engine's charter is deterministic, injectable-clock evaluation: these
+tests drive a fake clock through window expiry and pin the ok → burning →
+breached → ok lifecycle, the count-ratio (never wall-rate) arithmetic, the
+latency-threshold mapping, the labeled-gauge publication, and the
+disabled-by-default no-op contract that keeps bare library use from ever
+flipping a test /healthz status.
+"""
+
+from __future__ import annotations
+
+from p2p_llm_tunnel_tpu.utils.metrics import Metrics
+from p2p_llm_tunnel_tpu.utils.slo import (
+    BURN_THRESHOLD,
+    Objective,
+    SloEngine,
+    default_objectives,
+    global_slo,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def engine(clock, **kw):
+    kw.setdefault("min_events", 0)
+    kw.setdefault("enabled", True)
+    return SloEngine(
+        [Objective("avail", 0.999),
+         Objective("ttft", 0.99, threshold_ms=100.0)],
+        clock=clock, **kw,
+    )
+
+
+def test_no_events_is_ok_with_zero_burn():
+    e = engine(FakeClock())
+    v = e.evaluate()
+    assert v["avail"]["state"] == "ok"
+    assert v["avail"]["burn_fast"] == 0.0
+    assert v["avail"]["burn_slow"] == 0.0
+    assert v["ttft"]["threshold_ms"] == 100.0
+
+
+def test_burn_is_count_ratio_over_budget():
+    clk = FakeClock()
+    e = engine(clk)
+    # 1 bad out of 100: err 0.01, budget 0.001 -> burn 10 in both windows.
+    for _ in range(99):
+        e.record("avail", True)
+    e.record("avail", False)
+    v = e.evaluate()["avail"]
+    assert v["burn_fast"] == 10.0 and v["burn_slow"] == 10.0
+    assert v["events_fast"] == 100 and v["events_slow"] == 100
+    # 10 < 14.4: consuming budget but below the alert threshold.
+    assert v["state"] == "ok"
+
+
+def test_lifecycle_ok_burning_breached_and_decay():
+    clk = FakeClock()
+    e = engine(clk)
+    # A healthy hour of history: 1000 good events, aged past the fast
+    # window but inside the slow one.
+    for _ in range(1000):
+        e.record("avail", True)
+    clk.advance(3000.0)
+    # A fresh error burst: 5 bad / 5 good in the fast window.
+    for _ in range(5):
+        e.record("avail", False)
+        e.record("avail", True)
+    v = e.evaluate()["avail"]
+    # fast: 5/10 err -> burn 500 >= 14.4; slow: 5/1010 ≈ 0.005 err ->
+    # burn ≈ 5 < 14.4 — the multiwindow split that means BURNING, the
+    # healthy history says it is not yet a sustained breach.
+    assert v["state"] == "burning"
+    assert v["burn_fast"] >= BURN_THRESHOLD
+    assert v["burn_slow"] < BURN_THRESHOLD
+
+    # The good history ages out of the slow window while the failure
+    # CONTINUES -> both windows burn -> breached.
+    clk.advance(1000.0)
+    for _ in range(5):
+        e.record("avail", False)
+        e.record("avail", True)
+    v = e.evaluate()["avail"]
+    assert v["state"] == "breached"
+    assert v["burn_fast"] >= BURN_THRESHOLD
+    assert v["burn_slow"] >= BURN_THRESHOLD
+
+    # Errors STOP: the fast window drains first, so the verdict decays
+    # (a recovered peer must not stay de-routed for the slow window's
+    # full hour), then everything ages out to zero burn.
+    clk.advance(1000.0)
+    v = e.evaluate()["avail"]
+    assert v["state"] == "ok" and v["burn_slow"] > 0.0
+    clk.advance(4000.0)
+    v = e.evaluate()["avail"]
+    assert v["state"] == "ok" and v["burn_slow"] == 0.0
+
+
+def test_min_events_guard_suppresses_thin_evidence():
+    clk = FakeClock()
+    e = engine(clk, min_events=10)
+    # 1 bad / 3 events would burn at 333x — but 3 < 10 events is not
+    # evidence, and one unlucky request must not page.
+    e.record("avail", False)
+    e.record("avail", True)
+    e.record("avail", True)
+    assert e.evaluate()["avail"]["state"] == "ok"
+    for _ in range(7):
+        e.record("avail", False)
+    assert e.evaluate()["avail"]["state"] == "breached"
+
+
+def test_latency_objective_maps_threshold_to_good_bad():
+    clk = FakeClock()
+    e = engine(clk)
+    for ms in (10.0, 50.0, 100.0):  # at-threshold counts good
+        e.record_latency("ttft", ms)
+    e.record_latency("ttft", 101.0)
+    v = e.evaluate()["ttft"]
+    assert v["events_slow"] == 4
+    # 1/4 err over budget 0.01 -> burn 25 >= 14.4 in both windows.
+    assert v["state"] == "breached"
+    # Unknown objective and non-latency objective: ignored, never a crash.
+    e.record_latency("nope", 1.0)
+    e.record_latency("avail", 1.0)
+    assert e.evaluate()["avail"]["events_slow"] == 0
+
+
+def test_determinism_same_events_same_verdicts():
+    def run():
+        clk = FakeClock()
+        e = engine(clk)
+        for i in range(200):
+            e.record("avail", i % 7 != 0)
+            e.record_latency("ttft", float(i % 150))
+            if i % 50 == 49:
+                clk.advance(120.0)
+        return e.evaluate()
+
+    assert run() == run()
+
+
+def test_reset_drops_events_keeps_config():
+    clk = FakeClock()
+    e = engine(clk)
+    for _ in range(20):
+        e.record("avail", False)
+    assert e.evaluate()["avail"]["state"] == "breached"
+    e.reset()
+    v = e.evaluate()["avail"]
+    assert v["state"] == "ok" and v["events_slow"] == 0
+    assert "avail" in e.objectives  # objectives survive reset
+
+
+def test_disabled_engine_is_inert_and_publishes_nothing():
+    clk = FakeClock()
+    e = engine(clk, enabled=False)
+    e.record("avail", False)
+    e.record_latency("ttft", 1e9)
+    assert e.evaluate()["avail"]["events_slow"] == 0
+    reg = Metrics()
+    assert e.publish(reg) == {}
+    assert reg.labeled_gauge("slo_state") == {}
+    sec = e.section()
+    assert sec["enabled"] is False and sec["alerting"] is False
+
+
+def test_publish_writes_labeled_catalog_series():
+    clk = FakeClock()
+    e = engine(clk)
+    for _ in range(20):
+        e.record("avail", False)
+    reg = Metrics()
+    verdicts = e.publish(reg)
+    assert verdicts["avail"]["state"] == "breached"
+    assert reg.labeled_gauge("slo_state")["avail"] == 2.0
+    assert reg.labeled_gauge("slo_burn_fast")["avail"] > 0
+    text = reg.prometheus_text()
+    assert 'slo_state{objective="avail"} 2' in text
+    assert 'slo_burn_slow{objective="ttft"} 0' in text
+
+
+def test_section_alerting_flag_follows_worst_objective():
+    clk = FakeClock()
+    e = engine(clk)
+    sec = e.section()
+    assert sec["enabled"] is True and sec["alerting"] is False
+    for _ in range(20):
+        e.record("avail", False)
+    sec = e.section()
+    assert sec["alerting"] is True
+    assert sec["objectives"]["avail"]["state"] == "breached"
+    assert sec["objectives"]["ttft"]["state"] == "ok"
+
+
+def test_configure_replaces_objectives_and_drops_history():
+    clk = FakeClock()
+    e = engine(clk)
+    for _ in range(20):
+        e.record("avail", False)
+    e.configure(objectives=[Objective("avail", 0.5)])
+    v = e.evaluate()["avail"]
+    assert v["events_slow"] == 0 and v["target"] == 0.5
+
+
+def test_default_objectives_and_global_engine_posture():
+    objs = {o.name: o for o in default_objectives(
+        ttft_ms=750.0, ttft_target=0.95, availability_target=0.99)}
+    assert objs["ttft"].threshold_ms == 750.0
+    assert objs["ttft"].target == 0.95
+    assert objs["availability"].target == 0.99
+    # The process-global engine ships DISABLED (library use must never
+    # flip a /healthz status); the serve CLI enables it.
+    assert global_slo.enabled is False
+    assert {"ttft", "availability"} <= set(global_slo.objectives)
+
+
+def test_zero_budget_objective_burns_not_crashes():
+    clk = FakeClock()
+    e = SloEngine([Objective("strict", 1.0)], clock=clk,
+                  min_events=0, enabled=True)
+    e.record("strict", True)
+    assert e.evaluate()["strict"]["state"] == "ok"
+    e.record("strict", False)
+    assert e.evaluate()["strict"]["state"] == "breached"
+
+
+def test_burning_needs_fast_window_evidence_too():
+    """min_events guards BOTH windows for the burning verdict: an hour of
+    healthy history plus ONE transient 502 in a near-empty fast window
+    must not de-route the peer for five minutes (review find)."""
+    clk = FakeClock()
+    e = engine(clk, min_events=10)
+    for _ in range(1000):
+        e.record("avail", True)
+    clk.advance(3000.0)  # history ages out of the fast window only
+    e.record("avail", False)  # one lonely fast-window event
+    v = e.evaluate()["avail"]
+    assert v["events_fast"] == 1
+    assert v["burn_fast"] >= BURN_THRESHOLD  # the ratio alone would page
+    assert v["state"] == "ok"  # ...but one event is not evidence
+    # With real fast-window evidence the same ratio DOES burn.
+    for _ in range(6):
+        e.record("avail", False)
+        e.record("avail", True)
+    assert e.evaluate()["avail"]["state"] == "burning"

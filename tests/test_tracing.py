@@ -227,7 +227,7 @@ def test_prometheus_text_covers_the_full_catalog():
     assert "# TYPE engine_queue_depth gauge" in text
     assert "# TYPE engine_ttft_ms summary" in text
     for q in ("0.5", "0.95", "0.99", "0.999"):
-        assert f'engine_ttft_ms{{quantile="{q}"}}' in text
+        assert f'engine_ttft_ms{{quantile="{q}"}}' in text  # tunnelcheck: disable=TC12  read-side assertion against the registry's OWN rendering; no series is produced here
     assert "engine_ttft_ms_count 100" in text
     # Never-written series still expose zeros (schema-complete scrape).
     assert "serve_shed_total 0" in text
@@ -490,6 +490,143 @@ def test_traceview_multi_generation_trace_pairs_by_parent():
     assert req["ttft_ms"] == pytest.approx(500, abs=1)
     assert req["queue_wait_ms"] == pytest.approx(200, abs=1)
     assert req["total_ms"] == pytest.approx(8000, abs=1)
+
+
+def test_traceview_per_peer_attribution():
+    """Fabric captures carry serve.dispatch peer attrs (ISSUE 9): the
+    rollup attributes each request's TTFT to the peer whose dispatch
+    parented the engine generation, lists every peer a failover touched,
+    and rolls up a by_peer aggregate with a failover count."""
+    rec = TraceRecorder(enabled=True)
+    root = rec.add_span("proxy.request", trace_id=TID, t0=1.0, t1=3.0,
+                        track="proxy", attrs={"status": 200,
+                                              "peer": "peer-b"})
+    rec.add_span("serve.dispatch", trace_id=TID, parent_id=root,
+                 track="serve", t0=1.0, t1=1.2,
+                 attrs={"peer": "peer-a", "path": "/gen"})
+    d2 = rec.add_span("serve.dispatch", trace_id=TID, parent_id=root,
+                      track="serve", t0=1.3, t1=2.9,
+                      attrs={"peer": "peer-b", "path": "/gen"})
+    eng = rec.add_span("engine.request", trace_id=TID, parent_id=d2,
+                       t0=1.4, t1=2.8)
+    rec.add_event("engine.first_token", trace_id=TID, parent_id=eng, t=1.9)
+    tv = _load_traceview()
+    out = tv.summarize(rec.chrome_trace())
+    (req,) = out["requests"]
+    # TTFT belongs to the peer that actually served the generation...
+    assert req["peer"] == "peer-b"
+    # ...while the failover trail lists both peers it touched.
+    assert req["peers"] == ["peer-a", "peer-b"]
+    by_peer = out["aggregate"]["by_peer"]
+    assert by_peer["peer-b"]["requests"] == 1
+    assert by_peer["peer-b"]["failovers"] == 1
+    assert by_peer["peer-b"]["ttft_p50_ms"] == pytest.approx(500, abs=1)
+
+
+# ---------------------------------------------------------------------------
+# cross-peer trace stitching (ISSUE 9, stitch_chrome_traces)
+# ---------------------------------------------------------------------------
+
+def _capture(build) -> dict:
+    rec = TraceRecorder(enabled=True)
+    build(rec)
+    return rec.chrome_trace()
+
+
+def test_stitch_assigns_lanes_and_dedupes_shared_journals():
+    """Single-process fabrics share one recorder: the same records pulled
+    via three journals must appear ONCE, with serve-track spans landing on
+    the lane their peer attr names and engine spans inheriting their
+    parent dispatch's lane."""
+    from p2p_llm_tunnel_tpu.utils.tracing import stitch_chrome_traces
+
+    rec = TraceRecorder(enabled=True)
+    root = rec.add_span("proxy.request", trace_id=TID, t0=1.0, t1=3.0,
+                        track="proxy", attrs={"status": 200})
+    d = rec.add_span("serve.dispatch", trace_id=TID, parent_id=root,
+                     track="serve", t0=1.1, t1=2.9,
+                     attrs={"peer": "p1", "path": "/g"})
+    rec.add_span("engine.request", trace_id=TID, parent_id=d,
+                 t0=1.2, t1=2.8)
+    shared = rec.chrome_trace()
+    out = stitch_chrome_traces(
+        {"proxy": shared, "p1": shared, "p2": shared})
+    validate_chrome_trace(out)
+    events = [e for e in out["traceEvents"] if e["ph"] != "M"]
+    assert len(events) == 3  # deduped across the three identical pulls
+    by_name = {e["name"]: e for e in events}
+    # proxy-track events pin to the proxy lane even when pulled from a
+    # peer journal; the dispatch and its engine child share p1's lane.
+    assert by_name["proxy.request"]["pid"] != by_name["serve.dispatch"]["pid"]
+    assert by_name["engine.request"]["pid"] == \
+        by_name["serve.dispatch"]["pid"]
+    assert out["stitch"]["sources"] == ["proxy", "p1", "p2"]
+    assert out["stitch"]["stale"] == []
+    assert out["stitch"]["partial_traces"] == []
+
+
+def test_stitch_flags_evicted_journal_as_partial_not_crash():
+    """A peer whose ring buffer evicted the sampled trace (or that died
+    before its journal could be pulled) yields a PARTIAL chain: flagged in
+    the stitch summary, never an exception (the federation-failure-mode
+    satellite)."""
+    from p2p_llm_tunnel_tpu.utils.tracing import stitch_chrome_traces
+
+    def proxy_only(rec):
+        rec.add_span("proxy.request", trace_id=TID, t0=1.0, t1=2.0,
+                     track="proxy", attrs={"status": 200, "peer": "p1"})
+
+    # Case 1: the serving peer's journal is empty (evicted) — the
+    # proxy.request names p1 but no span of the trace sits on p1's lane.
+    out = stitch_chrome_traces({
+        "proxy": _capture(proxy_only),
+        "p1": {"traceEvents": []},
+    })
+    validate_chrome_trace(out)
+    assert out["stitch"]["partial_traces"] == [TID]
+    assert out["stitch"]["stale"] == []
+
+    # Case 2: the peer was unpullable entirely (dead/slow): stale AND the
+    # chain is partial.
+    out = stitch_chrome_traces({
+        "proxy": _capture(proxy_only), "p1": None,
+    })
+    validate_chrome_trace(out)
+    assert out["stitch"]["stale"] == ["p1"]
+    assert out["stitch"]["partial_traces"] == [TID]
+
+    # Case 3: an orphaned parent_id (the dispatch span evicted under the
+    # engine span) is also partial — and still renders.
+    def orphaned(rec):
+        rec.add_span("engine.request", trace_id=TID,
+                     parent_id="feedfeedfeed", t0=1.0, t1=2.0)
+
+    out = stitch_chrome_traces({"proxy": _capture(orphaned)})
+    validate_chrome_trace(out)
+    assert out["stitch"]["partial_traces"] == [TID]
+
+
+def test_stitch_keeps_colliding_cross_process_span_ids_distinct():
+    """Counter-allocated span ids collide ACROSS processes: two peers'
+    journals reusing span id 1 at different timestamps are different
+    spans and must both survive the dedupe."""
+    from p2p_llm_tunnel_tpu.utils.tracing import stitch_chrome_traces
+
+    def peer_at(t0):
+        def build(rec):
+            rec.add_span("serve.dispatch", trace_id=TID, span_id="000001",
+                         track="serve", t0=t0, t1=t0 + 1.0,
+                         attrs={"peer": ""})
+        return build
+
+    # Distinct ts -> distinct records, each on its source journal's lane
+    # (no peer attr, no parent: source fallback).
+    out = stitch_chrome_traces({
+        "p1": _capture(peer_at(1.0)), "p2": _capture(peer_at(5.0)),
+    })
+    events = [e for e in out["traceEvents"] if e["ph"] != "M"]
+    assert len(events) == 2
+    assert {e["pid"] for e in events} == {1, 2}
 
 
 # ---------------------------------------------------------------------------

@@ -1670,3 +1670,90 @@ def check_path_text(text: str):
         f = _P(d) / "cli.py"
         f.write_text(text)
         return run_paths([f], rules=["TC11"])
+
+
+# ---------------------------------------------------------------------------
+# TC12 — labeled Prometheus series only through the bounded registry
+# ---------------------------------------------------------------------------
+
+
+def test_tc12_flags_fstring_label_interpolation(tmp_path):
+    active, _ = check(
+        tmp_path,
+        '''
+        def render(tenant, v):
+            return f'tenant_tokens_total{{tenant="{tenant}"}} {v}'
+        ''',
+        rules=["TC12"],
+    )
+    assert rules_of(active) == ["TC12"]
+    assert "set_labeled_gauge" in active[0].message
+
+
+def test_tc12_flags_percent_and_format_interpolation(tmp_path):
+    active, _ = check(
+        tmp_path,
+        '''
+        def render(pid, v):
+            a = 'x{peer="%s"} %g' % (pid, v)
+            b = 'x{peer="{}"} {}'.format(pid, v)
+            return a, b
+        ''',
+        rules=["TC12"],
+    )
+    assert rules_of(active) == ["TC12", "TC12"]
+
+
+def test_tc12_ignores_plain_literals_and_unrelated_fstrings(tmp_path):
+    # Non-interpolated label literals (test assertions against exposition
+    # output) carry no cardinality risk; f-strings without label syntax
+    # in their CONSTANT parts are someone else's business.
+    active, _ = check(
+        tmp_path,
+        '''
+        def asserts(text, q):
+            assert 'tenant_in_flight{tenant="a"} 1' in text
+            assert f'quantile="{q}"' in text
+            return f"plain {q} interpolation"
+        ''',
+        rules=["TC12"],
+    )
+    assert active == []
+
+
+def test_tc12_waiver_and_registry_exemption(tmp_path):
+    active, waived = check(
+        tmp_path,
+        '''
+        def render(t):
+            return f'x{{tenant="{t}"}} 1'  # tunnelcheck: disable=TC12  fixture
+        ''',
+        rules=["TC12"],
+    )
+    assert active == [] and rules_of(waived) == ["TC12"]
+    # The registry module itself is the ONE legal interpolation site.
+    active, _ = check(
+        tmp_path,
+        '''
+        def prom_sample(name, k, v, val):
+            return f'{name}{{{k}="{v}"}} {val}'
+        ''',
+        filename="p2p_llm_tunnel_tpu/utils/metrics.py",
+        rules=["TC12"],
+    )
+    assert active == []
+
+
+def test_tc12_bounded_helper_is_actually_bounded():
+    """The helpers TC12 points at must honor their cap: past LABELED_CAP
+    distinct labels the least-recently-set is evicted, so the rule's
+    cardinality story is enforced at runtime too."""
+    from p2p_llm_tunnel_tpu.utils.metrics import LABELED_CAP, Metrics
+
+    m = Metrics()
+    for i in range(LABELED_CAP + 10):
+        m.set_labeled_gauge("fleet_peer_scrape_stale", "peer",
+                            f"p{i:04d}", float(i))
+    got = m.labeled_gauge("fleet_peer_scrape_stale")
+    assert len(got) == LABELED_CAP
+    assert "p0000" not in got and f"p{LABELED_CAP + 9:04d}" in got

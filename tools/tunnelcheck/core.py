@@ -418,6 +418,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_deps,
         rules_dispatch,
         rules_jax,
+        rules_labels,
         rules_metrics,
         rules_protocol,
         rules_queues,
@@ -437,6 +438,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC09": rules_tracing.check_tc09,
         "TC10": rules_queues.check_tc10,
         "TC11": rules_retry.check_tc11,
+        "TC12": rules_labels.check_tc12,
     }
 
 
@@ -453,6 +455,7 @@ RULE_SUMMARIES = {
     "TC09": "span name not in utils.tracing.SPAN_CATALOG / span emission inside traced fns",
     "TC10": "unbounded Queue/deque in endpoints/transport/protocol without a backpressure waiver",
     "TC11": "retry/backoff loop in cli.py/endpoints/transport without a cap+attempt bound or jitter",
+    "TC12": "labeled Prometheus series interpolated outside the bounded registry helpers",
 }
 
 

@@ -1,0 +1,94 @@
+"""TC12: labeled Prometheus series only through the bounded registry.
+
+A hand-rolled ``f'{name}{{tenant="{t}"}} {v}'`` exposition line bypasses
+every bound the registry enforces: the TENANT_CAP / LABELED_CAP eviction
+that keeps adversarial label minting from exploding series cardinality
+(ISSUE 7's x-api-key minter, ISSUE 9's peer/objective labels), and the
+label-value escaping that keeps a quote inside a tenant name from
+corrupting the whole exposition.  One interpolation site that drifts from
+the registry's rendering also silently splits the series it duplicates —
+the TC06 class, label edition.
+
+``utils/metrics.py`` is the ONE module allowed to interpolate label
+syntax (``prom_sample`` / ``prom_label_escape`` / ``prometheus_text`` /
+the federation merger live there); everywhere else must WRITE through the
+bounded helpers (``Metrics.set_labeled_gauge``, the ``tenant_*`` methods)
+and render through the registry.  This rule flags label-pattern literals
+(``{key="``) in any INTERPOLATING string construction — f-strings,
+``%``-formatting, ``str.format`` — outside that module.  Plain string
+constants (test assertions against exposition output, docstrings) carry
+no cardinality risk and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+
+#: A Prometheus label assignment inside a literal: ``{key="`` (f-string
+#: sources double the braces, but the AST constant carries one).
+LABEL_RE = re.compile(r"\{\s*[A-Za-z_][A-Za-z0-9_]*\s*=\s*\"")
+
+#: The registry module — the one place label interpolation is legal.
+REGISTRY_SUFFIX = "p2p_llm_tunnel_tpu/utils/metrics.py"
+
+_MSG = (
+    "labeled Prometheus series interpolated by hand — produce it through "
+    "the bounded registry helpers (Metrics.set_labeled_gauge / the "
+    "tenant_* methods, rendered by prometheus_text/prom_sample in "
+    "utils/metrics.py) instead: raw label interpolation bypasses the "
+    "cardinality caps and label escaping (the exposition-explosion class)"
+)
+
+
+def _fstring_has_label_literal(node: ast.JoinedStr) -> bool:
+    has_pattern = any(
+        isinstance(v, ast.Constant) and isinstance(v.value, str)
+        and LABEL_RE.search(v.value)
+        for v in node.values
+    )
+    has_interp = any(
+        isinstance(v, ast.FormattedValue) for v in node.values
+    )
+    return has_pattern and has_interp
+
+
+def check_tc12(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if sf.path.as_posix().endswith(REGISTRY_SUFFIX):
+        return iter(())
+    out: List[Violation] = []
+    for node in ast.walk(sf.tree):
+        hit = False
+        if isinstance(node, ast.JoinedStr):
+            hit = _fstring_has_label_literal(node)
+        elif (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+            and LABEL_RE.search(node.left.value)
+        ):
+            hit = True
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"
+            and isinstance(node.func.value, ast.Constant)
+            and isinstance(node.func.value.value, str)
+            and LABEL_RE.search(node.func.value.value)
+        ):
+            hit = True
+        if hit:
+            out.append(
+                Violation(
+                    "TC12",
+                    sf.path,
+                    node.lineno,
+                    _MSG,
+                    end_line=node.end_lineno,
+                )
+            )
+    return iter(out)

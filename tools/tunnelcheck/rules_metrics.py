@@ -16,8 +16,9 @@ from typing import Iterator, List
 
 from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
 
-WRITE_METHODS = {"inc", "set_gauge", "observe"}
-READ_METHODS = {"counter", "gauge", "percentile", "rate"}
+WRITE_METHODS = {"inc", "set_gauge", "observe", "set_labeled_gauge",
+                 "prune_labeled_gauge"}
+READ_METHODS = {"counter", "gauge", "percentile", "rate", "labeled_gauge"}
 
 
 def check_tc06(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
