@@ -7,7 +7,12 @@ native:            ## build the C++ frame codec
 	scripts/build-native.sh
 
 lint:              ## tunnelcheck static invariants + test-collection guard
-	python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests bench.py __graft_entry__.py
+	@# --jobs auto: rule passes fan across a fork pool (cross-file context
+	@# parsed once, inherited copy-on-write); wall time is in the summary
+	@# line.  The SARIF artifact is the machine-consumable twin of the
+	@# human output (waived findings included as suppressed results).
+	@mkdir -p artifacts
+	python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests bench.py __graft_entry__.py --jobs auto --sarif artifacts/lint.sarif
 	@# Collection guard (ISSUE 4): collect ALL of tests/ — slow marks
 	@# included — so a slow-tier test file that stops importing fails HERE
 	@# instead of rotting uncollected (test_bench_wedge sat broken for two

@@ -713,7 +713,7 @@ async def _serve_dispatch(
             log.warning("bad REQ_HEADERS payload: %s", e)
             return
         log.debug("request %d %s %s", headers.stream_id, headers.method, headers.path)
-        pending[headers.stream_id] = (headers, bytearray())
+        pending[headers.stream_id] = (headers, bytearray())  # tunnelcheck: disable=TC15  multi-frame lifecycle: released by this dispatch's REQ_END arm (pop below); the registry dies with the serve loop's channel on disconnect, and the single reader task owns every entry
     elif msg.msg_type == MessageType.REQ_BODY:
         entry = pending.get(msg.stream_id)
         if entry is not None:

@@ -595,6 +595,12 @@ class InferenceEngine:
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        # Serializes stop() (tunnelcheck TC13): the SIGTERM drain path and
+        # a test/API teardown can both call it, and the await-task-then-
+        # clear sequence must not interleave — the second caller would
+        # re-run the snapshot/shutdown tail against torn state.
+        self._stop_lock = asyncio.Lock()
+        self._stopped = False
         # Watchdog state: monotonic time of the last accounted token (or
         # idle period); degraded flips when the budget is blown while work
         # is active, and clears on the next progress.
@@ -874,6 +880,7 @@ class InferenceEngine:
     async def start(self) -> None:
         if self._task is None:
             self._running = True
+            self._stopped = False
             self._task = asyncio.create_task(self._loop())
             if self.ecfg.watchdog_budget_s > 0:
                 self._watchdog_task = asyncio.create_task(self._watchdog())
@@ -911,37 +918,60 @@ class InferenceEngine:
     async def stop(self) -> None:
         self._running = False
         self._wake.set()
-        if self._watchdog_task is not None:
-            self._watchdog_task.cancel()
-            try:
-                await self._watchdog_task
-            except asyncio.CancelledError:
-                pass
-            self._watchdog_task = None
-        if self._task is not None:
-            try:
-                await self._task
-            except Exception:
-                # Already logged + surfaced to consumers by the loop's
-                # crash containment; stop() stays clean so teardown paths
-                # don't have to handle the crash a second time.
-                pass
-            self._task = None
-        # Persist warm prompt KV before the executor goes away (reads the
-        # pool device arrays; must happen while XLA dispatch still works).
-        self.save_prefix_snapshot()
-        if (self._spmd is not None and self._spmd.rank == 0
-                and not self._spmd_stop_sent):
-            # Release the follower ranks blocked in spmd_follower_loop.
-            # Once only: stop() must stay idempotent, and a second stop
-            # broadcast would hang rank 0 (followers already exited).
-            self._spmd_stop_sent = True
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(self._executor, self._spmd.send_stop)
-        # Unblock every in-flight generate() consumer.
-        for state in list(self._requests.values()):
-            state.queue.put_nowait(None)
-        self._executor.shutdown(wait=False)
+        # Serialized + idempotent (tunnelcheck TC13): SIGTERM drain and a
+        # teardown path can call stop() concurrently, and the
+        # await-task-then-clear sequences below are read-modify-writes of
+        # shared task handles across awaits — the second caller must wait
+        # and then find the work already done, not re-await a handle the
+        # first caller is mid-way through clearing.
+        async with self._stop_lock:
+            if self._stopped:
+                return
+            if self._watchdog_task is not None:
+                self._watchdog_task.cancel()
+                try:
+                    await self._watchdog_task
+                except asyncio.CancelledError:
+                    pass
+                self._watchdog_task = None
+            if self._task is not None:
+                try:
+                    await self._task
+                except asyncio.CancelledError:
+                    # Either a previously-aborted stop() already propagated
+                    # a cancel into the loop task, or OUR caller's cancel
+                    # (teardown under wait_for) was just delivered into it
+                    # through this await: in both cases the loop is dead,
+                    # and finishing the shutdown tail — unblocking parked
+                    # consumers, stopping follower ranks, releasing the
+                    # executor — beats aborting half-stopped.
+                    pass
+                except Exception:
+                    # Already logged + surfaced to consumers by the loop's
+                    # crash containment; stop() stays clean so teardown paths
+                    # don't have to handle the crash a second time.
+                    pass
+                self._task = None
+            # Persist warm prompt KV before the executor goes away (reads the
+            # pool device arrays; must happen while XLA dispatch still works).
+            self.save_prefix_snapshot()
+            if (self._spmd is not None and self._spmd.rank == 0
+                    and not self._spmd_stop_sent):
+                # Release the follower ranks blocked in spmd_follower_loop.
+                # Once only: stop() must stay idempotent, and a second stop
+                # broadcast would hang rank 0 (followers already exited).
+                self._spmd_stop_sent = True
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(self._executor, self._spmd.send_stop)
+            # Unblock every in-flight generate() consumer.
+            for state in list(self._requests.values()):
+                state.queue.put_nowait(None)
+            self._executor.shutdown(wait=False)
+            # Marked done only once the whole tail ran: a stop() cancelled
+            # mid-way (teardown under wait_for) must leave the work
+            # re-runnable — flagging up front would turn every retry into
+            # a silent no-op with consumers still parked on their queues.
+            self._stopped = True
 
     async def warmup(self) -> None:
         """Pre-compile every decode-burst variant the serving loop can hit:

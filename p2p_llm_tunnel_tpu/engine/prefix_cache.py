@@ -232,7 +232,7 @@ def plan_group_admission(
                 continue
         keys = [k for _, k in missing]
         for k in keys:
-            inflight[k] = rid
+            inflight[k] = rid  # tunnelcheck: disable=TC15  cross-function lifecycle: released by engine._owner_done — on finish via _finish_segments -> _mux_wake, and on owner death via _mux_wake's per-iteration alive sweep (a dead owner's claims are dropped so waiters re-plan, never park forever)
         owners.append((rid, hist, ids, keys))
     return owners, waiters
 

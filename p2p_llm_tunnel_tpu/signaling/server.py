@@ -88,15 +88,20 @@ class SignalServer:
                 "server (pip install websockets)"
             )
         self._server = await serve(self._handle, self.host, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        self.port = self._server.sockets[0].getsockname()[1]  # tunnelcheck: disable=TC13  start() runs once on the owning entrypoint before any concurrent use; the port-0 -> bound-port rewrite is that single call's handoff, not a shared RMW
         log.info("signal server listening on ws://%s:%d", self.host, self.port)
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # Claim-then-await (tunnelcheck TC13): the handle is cleared
+        # BEFORE the suspension, so a concurrent stop() — entrypoint
+        # teardown racing a test's finally — finds None instead of
+        # close()/wait_closed()-ing a server the first caller is mid-way
+        # through tearing down.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
     async def serve_forever(self) -> None:
         await self.start()
@@ -194,7 +199,7 @@ class SignalServer:
                     peer = _Peer(str(uuid.uuid4()), room_name, ws, role)
                     existing = self._occupants(room_name)
                     self.rooms.setdefault(room_name, set()).add(peer.peer_id)
-                    self.peers[peer.peer_id] = peer
+                    self.peers[peer.peer_id] = peer  # tunnelcheck: disable=TC13  single-owner key: this connection's handler task is the only writer of its own fresh uuid key; other handlers' reads are lookups of THEIR keys, not guards for this write
                     # ``observed`` is this server's view of the peer's address
                     # — a built-in STUN-lite so peers can advertise their
                     # NAT-external IP as a candidate (extension field; the

@@ -213,6 +213,60 @@ def test_stop_unblocks_inflight_consumers():
     asyncio.run(run())
 
 
+def test_stop_is_concurrent_safe_and_idempotent():
+    """Regression for the tunnelcheck TC13 finding on stop(): SIGTERM
+    drain and a teardown path can both call stop(), and the
+    await-task-then-clear sequence used to be a read-modify-write of the
+    task handles across awaits.  stop() is now serialized behind a lock
+    and idempotent — concurrent and repeated calls must all complete
+    cleanly, with the stop tail (snapshot, executor shutdown) running
+    exactly once."""
+    async def run():
+        engine = make_engine()
+        await engine.start()
+
+        saves = []
+        original = engine.save_prefix_snapshot
+        engine.save_prefix_snapshot = lambda: saves.append(1) or original()
+
+        await asyncio.gather(engine.stop(), engine.stop(), engine.stop())
+        await engine.stop()  # already stopped: a clean no-op
+        assert saves == [1], "stop tail must run exactly once"
+        assert engine._task is None and engine._watchdog_task is None
+
+    asyncio.run(run())
+
+
+def test_stop_survives_cancellation_midway():
+    """Cancelling stop() mid-tail (teardown under asyncio.wait_for) must
+    not leave the engine half-stopped with consumers parked: the cancel
+    asyncio delivers into the awaited loop task is absorbed (the loop is
+    dead either way) and the tail still runs — consumers unblocked,
+    executor released; the done flag is only set once the tail completed,
+    so an abort elsewhere leaves stop() re-runnable instead of a silent
+    no-op."""
+    async def run():
+        engine = make_engine()
+        await engine.start()
+
+        gate = asyncio.Event()
+        real_task = engine._task
+        engine._task = asyncio.create_task(gate.wait())  # park the stop tail
+
+        stopping = asyncio.create_task(engine.stop())
+        await asyncio.sleep(0.05)  # inside `await self._task`, parked on gate
+        stopping.cancel()  # propagates into the parked await (fut_waiter)
+        with contextlib.suppress(asyncio.CancelledError):
+            await stopping
+        assert engine._stopped is True, "cancelled stop must finish the tail"
+        assert engine._task is None
+
+        await real_task  # the real loop exited on _running=False
+        await engine.stop()  # already stopped: a clean no-op
+
+    asyncio.run(run())
+
+
 def test_stream_decoder_multibyte():
     tok = ByteTokenizer()
     text = "héllo ✓"

@@ -261,3 +261,20 @@ def test_peer_left_fans_out_with_role():
         await server.stop()
 
     run(main())
+
+
+def test_server_stop_is_concurrent_safe_and_idempotent():
+    """Regression for the tunnelcheck TC13 finding on SignalServer.stop():
+    the old shape checked ``self._server``, awaited ``wait_closed()``, and
+    only then cleared the handle — a concurrent stop() (entrypoint
+    teardown racing a test's finally) could act on a handle the first
+    caller was mid-way through tearing down.  stop() now claims the
+    handle BEFORE the suspension, so every interleaving finds either the
+    live server or None."""
+    async def main():
+        server, _url = await _start_server()
+        await asyncio.gather(server.stop(), server.stop(), server.stop())
+        assert server._server is None
+        await server.stop()  # already stopped: a clean no-op
+
+    run(main())

@@ -1757,3 +1757,830 @@ def test_tc12_bounded_helper_is_actually_bounded():
     got = m.labeled_gauge("fleet_peer_scrape_stale")
     assert len(got) == LABELED_CAP
     assert "p0000" not in got and f"p{LABELED_CAP + 9:04d}" in got
+
+
+# ---------------------------------------------------------------------------
+# TC13 — await-atomicity: shared RMW across a suspension point
+# ---------------------------------------------------------------------------
+
+PEERS_FIXTURE = "p2p_llm_tunnel_tpu/endpoints/fixture_peers.py"
+
+
+def test_tc13_stale_local_rmw_across_await(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        class Breaker:
+            def ok(self):
+                return self.failures < 3
+
+            async def probe(self, peer):
+                n = self.failures
+                await peer.send(b"probe")
+                self.failures = n + 1
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert rules_of(active) == ["TC13"]
+    assert "stale local `n`" in active[0].message
+    assert "failures" in active[0].message
+
+
+def test_tc13_check_then_act_across_await(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        class Breaker:
+            def ok(self):
+                return self.failures < 3
+
+            async def probe(self, peer):
+                if self.failures >= 3:
+                    await peer.send(b"probe")
+                    self.failures = 0
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert rules_of(active) == ["TC13"]
+
+
+def test_tc13_reread_after_await_is_clean(tmp_path):
+    """The check-again idiom: a fresh read after the suspension refreshes
+    the premise, so the write is NOT torn."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Breaker:
+            def ok(self):
+                return self.failures < 3
+
+            async def probe(self, peer):
+                await peer.send(b"probe")
+                self.failures = self.failures + 1
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert active == []
+
+
+def test_tc13_lock_held_rmw_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        class Breaker:
+            def ok(self):
+                return self.failures < 3
+
+            async def probe(self, peer):
+                async with self._lock:
+                    n = self.failures
+                    await peer.send(b"probe")
+                    self.failures = n + 1
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert active == []
+
+
+def test_tc13_single_accessor_attr_is_exempt(tmp_path):
+    """An attribute only ONE function ever touches has a single-writer
+    contract by construction — no second accessor can interleave."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Loop:
+            async def run(self, peer):
+                n = self._only_here
+                await peer.send(b"x")
+                self._only_here = n + 1
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert active == []
+
+
+def test_tc13_blind_write_after_await_is_clean(tmp_path):
+    """A write whose value does not depend on a pre-await read (keepalive
+    timestamp stamping) is not a read-modify-write."""
+    active, _ = check(
+        tmp_path,
+        """
+        import time
+
+        class Keepalive:
+            def read(self):
+                return self._sent_at
+
+            async def run(self, peer):
+                while True:
+                    await peer.sleep(1)
+                    self._sent_at = time.monotonic()
+                    await peer.send(b"ping")
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert active == []
+
+
+def test_tc13_waiver_names_the_owning_task(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        class Loop:
+            def read(self):
+                return self._progress
+
+            async def run(self, peer):
+                n = self._progress
+                await peer.send(b"x")
+                self._progress = n + 1  # tunnelcheck: disable=TC13  single-writer: the engine loop task owns decode progress
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC13"]
+
+
+def test_tc13_meta_breaker_half_open_wedge(tmp_path):
+    """The rule reproduces its incident (the TC02/TC11 pattern): the PR 8
+    review breaker bug — half-open bookkeeping decided from a
+    consec_failures read taken BEFORE the probe dispatch's await, so a
+    concurrent failure in the await window was silently erased."""
+    active, _ = check(
+        tmp_path,
+        """
+        CB_THRESHOLD = 3
+
+        class PeerSet:
+            def dispatchable(self, link):
+                return link.consec_failures < CB_THRESHOLD
+
+            async def half_open_probe(self, link, msg):
+                tripped = link.consec_failures >= CB_THRESHOLD
+                await link.channel.send(msg)
+                if tripped:
+                    link.consec_failures = 0
+        """,
+        filename=PEERS_FIXTURE,
+        rules=["TC13"],
+    )
+    assert rules_of(active) == ["TC13"]
+    assert "consec_failures" in active[0].message
+    assert "interleave" in active[0].message
+
+
+# ---------------------------------------------------------------------------
+# TC14 — header taint must pass a registered sanitizer before trusted sinks
+# ---------------------------------------------------------------------------
+
+API_FIXTURE = "p2p_llm_tunnel_tpu/endpoints/fixture_api.py"
+
+
+def test_tc14_meta_pre_pr7_tenant_minting(tmp_path):
+    """The rule reproduces its incident: the pre-PR-7 ingress took the raw
+    x-tunnel-tenant header bytes as the scheduler identity AND the metric
+    label — the exact minting hole parse_tenant closed."""
+    active, _ = check(
+        tmp_path,
+        """
+        async def handle(req, payload, global_metrics):
+            tenant = ""
+            for k, v in req.headers.items():
+                if k.lower() == "x-tunnel-tenant":
+                    tenant = v
+            kwargs = {}
+            if tenant:
+                kwargs["tenant"] = tenant
+                global_metrics.tenant_begin(tenant)
+            return kwargs
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert rules_of(active) == ["TC14", "TC14"]
+    assert any("scheduler tenant identity" in v.message for v in active)
+    assert any("per-tenant accounting" in v.message for v in active)
+
+
+def test_tc14_sanitized_ingress_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import parse_tenant
+
+        async def handle(req, global_metrics):
+            tenant = parse_tenant(req.headers)
+            kwargs = {}
+            if tenant:
+                kwargs["tenant"] = tenant
+                global_metrics.tenant_begin(tenant)
+            return kwargs
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert active == []
+
+
+def test_tc14_headers_param_seeds_taint(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def account(headers, global_metrics):
+            for k, v in headers.items():
+                if k == "x-tunnel-tenant":
+                    global_metrics.tenant_tokens(v)
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert rules_of(active) == ["TC14"]
+
+
+def test_tc14_labeled_gauge_and_log_interpolation_sinks(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def publish(req, metrics, log):
+            raw = req.headers.get("x-tunnel-tenant", "")
+            metrics.set_labeled_gauge("tenant_inflight", "tenant", raw, 1.0)
+            log.warning(f"tenant {raw} over limit")
+            log.error("tenant {t} over limit".format(t=raw))
+            log.warning("tenant %s over limit", raw)  # lazy args: exempt
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert rules_of(active) == ["TC14", "TC14", "TC14"]
+    assert any("labeled-metrics" in v.message for v in active)
+    assert any("log interpolation" in v.message for v in active)
+
+
+def test_tc14_relay_target_sink(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def relay(req, signaling):
+            target = req.headers.get("x-relay-to", "")
+            await signaling.send({"type": "relay", "to": target})
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert rules_of(active) == ["TC14"]
+    assert "relay" in active[0].message
+
+
+def test_tc14_numeric_coercion_sanitizes(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def weight(headers, scheduler):
+            w = int(headers.get("x-weight", "1"))
+            scheduler.charge_tokens(w, 1)
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert active == []
+
+
+def test_tc14_waiver(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        def account(headers, global_metrics):
+            v = headers.get("x-tunnel-tenant", "")
+            global_metrics.tenant_begin(v)  # tunnelcheck: disable=TC14  fixture: proxy-stamped header, trusted inside the tunnel
+        """,
+        filename=API_FIXTURE,
+        rules=["TC14"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC14"]
+
+
+def test_tc14_out_of_scope_tree_is_free(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def account(headers, global_metrics):
+            global_metrics.tenant_begin(headers.get("t", ""))
+        """,
+        filename="somewhere_else.py",
+        rules=["TC14"],
+    )
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# TC15 — resource lifecycle: release on every exit path, aclose() included
+# ---------------------------------------------------------------------------
+
+ENG_FIXTURE = "p2p_llm_tunnel_tpu/engine/fixture_lifecycle.py"
+
+
+def test_tc15_meta_pre_pr6_finish_after_final_yield(tmp_path):
+    """The rule reproduces its incident: pre-PR-6 generate() emitted the
+    request span AFTER the yield loop — a consumer that stops iterating
+    closes the generator at the yield (GeneratorExit) and the emission
+    never runs, logging every normal finish as a leaked/cancelled span."""
+    active, _ = check(
+        tmp_path,
+        """
+        async def generate(self, req, queue, global_tracer):
+            span = new_span_id()
+            while True:
+                event = await queue.get()
+                if event is None:
+                    break
+                yield event
+            global_tracer.add_span(
+                "engine.request", trace_id=req.trace, span_id=span,
+            )
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert rules_of(active) == ["TC15"]
+    assert "aclose" in active[0].message
+    assert "span" in active[0].message
+
+
+def test_tc15_finally_release_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def generate(self, req, queue, global_tracer):
+            span = new_span_id()
+            try:
+                while True:
+                    event = await queue.get()
+                    if event is None:
+                        return
+                    yield event
+            finally:
+                global_tracer.add_span(
+                    "engine.request", trace_id=req.trace, span_id=span,
+                )
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_inflight_registry_across_await(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def fetch(self, link, sid, q):
+            link.pending[sid] = q
+            await link.channel.send(b"x")
+            link.pending.pop(sid, None)
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert rules_of(active) == ["TC15"]
+    assert "link.pending" in active[0].message
+
+
+def test_tc15_inflight_registry_finally_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def fetch(self, link, sid, q):
+            link.pending[sid] = q
+            try:
+                await link.channel.send(b"x")
+            finally:
+                link.pending.pop(sid, None)
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_straight_line_release_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def requeue(self, sid, q):
+            self.pending[sid] = q
+            self.counts[sid] = self.counts.get(sid, 0) + 1
+            self.pending.pop(sid)
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_local_buffer_is_not_a_registry(tmp_path):
+    """A bare-name dict local to the frame (pending_lp accumulation) dies
+    with the frame — only parameters count as passed-in shared registries."""
+    active, _ = check(
+        tmp_path,
+        """
+        async def stream(self, queue):
+            pending_lp = {}
+            while True:
+                i = await queue.get()
+                if i is None:
+                    break
+                pending_lp[i] = i
+                yield i
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_param_registry_counts(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def plan(wave, inflight):
+            for rid in wave:
+                inflight[rid] = rid
+            return wave
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert rules_of(active) == ["TC15"]
+
+
+def test_tc15_delegated_closure_release_satisfies(tmp_path):
+    """A nested closure owning the release (drop_stream/finish_span) is
+    the delegated-owner contract the proxy dispatch path uses."""
+    active, _ = check(
+        tmp_path,
+        """
+        async def dispatch(self, link, sid, q):
+            link.pending[sid] = q
+
+            def drop_stream():
+                link.pending.pop(sid, None)
+
+            try:
+                await link.channel.send(b"x")
+            except Exception:
+                drop_stream()
+                raise
+            return drop_stream
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_crypto_box_open_is_not_an_acquire(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def decrypt(self, data):
+            plain = self._box.open(data)
+            await self.deliver(plain)
+        """,
+        filename="p2p_llm_tunnel_tpu/transport/fixture_crypto.py",
+        rules=["TC15"],
+    )
+    assert active == []
+
+
+def test_tc15_waiver_names_releasing_owner(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        def register(self, sid, q):
+            self.pending[sid] = q  # tunnelcheck: disable=TC15  released by the reader task's RES_END arm
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC15"]
+
+
+# ---------------------------------------------------------------------------
+# SARIF export, --list-rules pin, TC00 counting, parallel + changed-only
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_2_1_0_shape(tmp_path):
+    """Pins the SARIF 2.1.0 shape downstream consumers ingest: version,
+    $schema, the rules table (ruleIndex points into it), physical
+    locations with SRCROOT-relative URIs, and waived findings carried as
+    suppressed results."""
+    import json
+
+    from tools.tunnelcheck.core import RULE_SUMMARIES
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+        "\nasync def g():\n    time.sleep(2)  # tunnelcheck: disable=TC01  fixture\n"
+    )
+    out = tmp_path / "artifacts" / "lint.sarif"
+    rc = tunnelcheck_main([str(bad), "--sarif", str(out)])
+    assert rc == 1
+    log = json.loads(out.read_text())
+
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-2.1.0.json")
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tunnelcheck"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(RULE_SUMMARIES)
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+
+    results = run["results"]
+    assert len(results) == 2  # one active, one suppressed
+    active = [r for r in results if "suppressions" not in r]
+    waived = [r for r in results if "suppressions" in r]
+    assert len(active) == 1 and len(waived) == 1
+    res = active[0]
+    assert res["ruleId"] == "TC01"
+    assert rule_ids[res["ruleIndex"]] == "TC01"
+    assert res["level"] == "error"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["artifactLocation"]["uriBaseId"] == "SRCROOT"
+    assert loc["region"]["startLine"] == 4
+    assert waived[0]["suppressions"][0]["kind"] == "inSource"
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].startswith("file://")
+
+
+def test_sarif_includes_tc00(tmp_path):
+    import json
+
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n")
+    out = tmp_path / "lint.sarif"
+    assert tunnelcheck_main([str(broken), "--sarif", str(out)]) == 1
+    log = json.loads(out.read_text())
+    assert [r["ruleId"] for r in log["runs"][0]["results"]] == ["TC00"]
+
+
+def test_list_rules_pinned_against_code_and_readme(capsys):
+    """Rule-id drift (docs vs code) fails fast: --list-rules must show
+    exactly TC00..TC15, every runnable rule must have a summary, and the
+    README rule table must carry a row for every rule."""
+    from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
+
+    assert tunnelcheck_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    listed = [line.split()[0] for line in out.strip().splitlines()]
+    assert listed == [f"TC{i:02d}" for i in range(16)]
+    assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
+
+    readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")
+    for rid in RULE_SUMMARIES:
+        if rid == "TC00":
+            continue  # framework behavior, documented in prose
+        assert f"| {rid}" in readme, f"README rule table is missing {rid}"
+
+
+def test_tc00_counted_in_summary_and_exit_code(tmp_path, capsys):
+    """The ISSUE 11 bugfix pin: an unparseable file must show up in the
+    printed summary total AND drive exit code 1 — through the default run,
+    a rule filter, and the parallel path — because both are computed from
+    the same violation list."""
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+
+    rc = tunnelcheck_main([str(tmp_path)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "1 violation(s)" in err
+
+    rc = tunnelcheck_main([str(tmp_path), "--rules", "TC06"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "1 violation(s)" in err
+
+
+def _cli_subprocess(args):
+    """Run the real CLI in a clean subprocess.  The parallel paths fork,
+    and forking THIS process — pytest with JAX threads already live — is
+    exactly what the fork pool must never do in production (the CLI
+    process never imports jax); keep the test honest the same way."""
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tunnelcheck", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+
+
+def test_tc00_counted_in_summary_with_parallel_jobs(tmp_path):
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    proc = _cli_subprocess([str(tmp_path), "--jobs", "2"])
+    assert proc.returncode == 1
+    assert "1 violation(s)" in proc.stderr
+    assert "(2 job(s))" in proc.stderr
+
+
+def test_parallel_jobs_match_serial(tmp_path):
+    """--jobs must be a pure speedup: identical findings (waived included),
+    identical order."""
+    (tmp_path / "a.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "import time\n\nasync def g():\n    time.sleep(2)  "
+        "# tunnelcheck: disable=TC01  fixture\n"
+    )
+    (tmp_path / "c.py").write_text("def broken(:\n")
+    serial = _cli_subprocess([str(tmp_path), "--show-waived"])
+    parallel = _cli_subprocess([str(tmp_path), "--show-waived", "--jobs", "3"])
+    assert serial.returncode == parallel.returncode == 1
+    assert serial.stdout == parallel.stdout
+    lines = serial.stdout.strip().splitlines()
+    assert "TC01" in lines[0] and "TC00" in lines[1]  # path-sorted
+    assert "[waived]" in lines[2]
+
+
+def test_restrict_limits_findings_not_context(tmp_path):
+    """The --changed-only substrate: findings only for the restricted
+    set, while unrestricted files still feed cross-file context (the
+    jit-factory below is DEFINED in an unrestricted file and must still
+    poison the loop in the restricted one)."""
+    factory = tmp_path / "factory.py"
+    factory.write_text(
+        "import jax\n\ndef make_op():\n    return jax.jit(lambda x: x)\n"
+    )
+    user = tmp_path / "p2p_llm_tunnel_tpu" / "engine" / "user.py"
+    user.parent.mkdir(parents=True)
+    user.write_text(
+        "from factory import make_op\n\n"
+        "def admit(requests):\n"
+        "    for req in requests:\n"
+        "        make_op()\n"
+    )
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+
+    full, _ = run_paths([tmp_path])
+    assert sorted({v.rule for v in full}) == ["TC01", "TC07"]
+
+    restricted, _ = run_paths([tmp_path], restrict={user.resolve()})
+    assert [v.rule for v in restricted] == ["TC07"]
+    assert restricted[0].path == user
+
+
+def test_changed_only_cli_uses_git_answer(tmp_path, capsys, monkeypatch):
+    """--changed-only scopes findings to what git reports; a git failure
+    degrades to a full run instead of silently reporting clean."""
+    import tools.tunnelcheck.__main__ as cli
+
+    bad1 = tmp_path / "bad1.py"
+    bad1.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    bad2 = tmp_path / "bad2.py"
+    bad2.write_text("import time\n\nasync def g():\n    time.sleep(1)\n")
+
+    monkeypatch.setattr(cli, "_git_changed_files",
+                        lambda root: {bad1.resolve()})
+    rc = cli.main([str(tmp_path), "--changed-only"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "bad1.py" in captured.out and "bad2.py" not in captured.out
+    assert "1 changed of 2 file(s)" in captured.err
+
+    monkeypatch.setattr(cli, "_git_changed_files", lambda root: None)
+    rc = cli.main([str(tmp_path), "--changed-only"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "bad1.py" in captured.out and "bad2.py" in captured.out
+
+
+# ---------------------------------------------------------------------------
+# Substrate unit tests (dataflow.py / callgraph.py)
+# ---------------------------------------------------------------------------
+
+
+def test_dataflow_augassign_awaiting_value_is_torn():
+    """``self._x += await f()`` reads the target, suspends, then stores —
+    the torn-increment shape, visible only with evaluation-order events."""
+    import ast as ast_mod
+
+    from tools.tunnelcheck.dataflow import FuncCFG, attr_reach
+
+    tree = ast_mod.parse(
+        "async def f(self):\n    self._x += await g()\n"
+    )
+    torn = attr_reach(FuncCFG(tree.body[0]), {"self"})
+    assert [(t.obj, t.attr) for t in torn] == [("self", "_x")]
+
+
+def test_dataflow_try_finally_write_sees_body_reads():
+    """A finally-block write observes reads from anywhere in the try body
+    (any statement may raise), so a torn RMW cannot hide in a handler."""
+    import ast as ast_mod
+
+    from tools.tunnelcheck.dataflow import FuncCFG, attr_reach
+
+    tree = ast_mod.parse(
+        "async def f(self):\n"
+        "    n = self._x\n"
+        "    try:\n"
+        "        await g()\n"
+        "    finally:\n"
+        "        self._x = n + 1\n"
+    )
+    torn = attr_reach(FuncCFG(tree.body[0]), {"self"})
+    assert [(t.obj, t.attr, t.via_local) for t in torn] == [
+        ("self", "_x", "n")
+    ]
+
+
+def test_callgraph_transitive_callers_and_factories(tmp_path):
+    from tools.tunnelcheck.callgraph import CallGraph
+    from tools.tunnelcheck.core import load_source
+
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import jax\n\n"
+        "def factory():\n    return jax.jit(lambda x: x)\n\n"
+        "def middle():\n    return factory()\n\n"
+        "def outer():\n    return middle()\n\n"
+        "def unrelated():\n    return 1\n"
+    )
+    sf, err = load_source(f)
+    assert err is None
+    graph = CallGraph([sf])
+    assert graph.functions_calling("jax.jit") == {"factory"}
+    closure = graph.transitive_callers(
+        lambda n: "jax.jit" in n.dotted_calls, within=f
+    )
+    assert closure == {"factory", "middle", "outer"}
+    assert graph.resolve("outer") is not None
+    assert graph.resolve("nope") is None
+
+
+def test_callgraph_indexes_defs_in_nested_compounds(tmp_path):
+    """Coverage regression pin: defs inside except handlers, doubly-nested
+    ifs, and loops inside try must be indexed exactly like the full-
+    recursion walkers the call graph replaced — a def the graph cannot
+    see is a def TC02/TC03/TC07/TC09 silently stop checking."""
+    import ast as ast_mod
+
+    from tools.tunnelcheck.callgraph import CallGraph
+    from tools.tunnelcheck.core import load_source
+    from tools.tunnelcheck.dataflow import iter_functions
+
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "try:\n"
+        "    import fast\n"
+        "except ImportError:\n"
+        "    def fallback(x):\n"
+        "        return x\n"
+        "\n"
+        "if True:\n"
+        "    if True:\n"
+        "        def doubly_nested():\n"
+        "            pass\n"
+        "\n"
+        "class C:\n"
+        "    try:\n"
+        "        def meth(self):\n"
+        "            pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "\n"
+        "for _ in range(1):\n"
+        "    def in_loop():\n"
+        "        pass\n"
+        "\n"
+        "match 1:\n"
+        "    case 1:\n"
+        "        def in_match():\n"
+        "            pass\n"
+        "    case _:\n"
+        "        pass\n"
+    )
+    sf, err = load_source(f)
+    assert err is None
+    graph = CallGraph([sf])
+    indexed = {id(n.node) for n in graph.by_path[f]}
+    for fn, _cls in iter_functions(sf.tree):
+        assert id(fn) in indexed, f"call graph missed `{fn.name}`"
+    meth = [n for n in graph.by_path[f] if n.name == "meth"]
+    assert meth and meth[0].info.is_method  # class context survives nesting

@@ -1,12 +1,18 @@
 """tunnelcheck: project-native static analysis for the tunnel codebase.
 
 Stdlib-only (``ast``-based) rules that make this repo's recurring runtime
-bug classes statically detectable.  See README.md "Static analysis &
-invariants" for the rule table and the incidents each rule guards against.
+bug classes statically detectable.  Two layers since ISSUE 11: a shared
+analysis substrate (``dataflow.py`` — per-function CFGs with await-point
+partitioning, reaching reads over shared attributes, a taint lattice —
+and ``callgraph.py`` — the project-wide call graph) and one rule module
+per bug family on top.  See README.md "Static analysis & invariants" for
+the TC01–TC15 rule table and the incidents each rule guards against.
 
 Usage::
 
     python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests
+    python -m tools.tunnelcheck ... --jobs auto --sarif out.sarif
+    python -m tools.tunnelcheck ... --changed-only
 
 Waive a single finding on its line::
 

@@ -1,15 +1,58 @@
 """CLI: ``python -m tools.tunnelcheck p2p_llm_tunnel_tpu scripts tests``.
 
 Exit status: 0 = clean, 1 = violations, 2 = usage error.
+
+``--jobs N`` fans the per-file rule passes across a fork pool (``auto``
+picks the CPU count); ``--changed-only`` restricts FINDINGS to files git
+reports as changed while the whole path set still feeds cross-file
+context; ``--sarif out.json`` writes the machine-consumable SARIF 2.1.0
+log alongside the human output.
+
+The printed summary and the exit code are computed from the SAME
+violation list — TC00 parse errors included — so they can never disagree
+(the unparseable-file counting bug class is pinned by a fixture test).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
+import time
 from pathlib import Path
+from typing import Optional, Set
 
-from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules, run_paths
+from tools.tunnelcheck.core import (
+    REPO_ROOT,
+    RULE_SUMMARIES,
+    all_rules,
+    iter_python_files,
+    run_paths,
+)
+
+
+def _git_changed_files(root: Path) -> Optional[Set[Path]]:
+    """Resolved paths of files git sees as modified/added/untracked, or
+    None when git is unavailable (callers fall back to a full run)."""
+    out: Set[Path] = set()
+    try:
+        for args in (
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30,
+            )
+            if proc.returncode != 0:
+                return None
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line:
+                    out.add((root / line).resolve())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
 
 
 def main(argv=None) -> int:
@@ -29,6 +72,27 @@ def main(argv=None) -> int:
         "--show-waived",
         action="store_true",
         help="also print findings silenced by `# tunnelcheck: disable=` waivers",
+    )
+    parser.add_argument(
+        "--jobs",
+        default="1",
+        help="worker processes for the rule passes (an int, or `auto` "
+        "for the CPU count); cross-file context is built once and "
+        "fork-shared",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="report findings only for files git sees as changed "
+        "(modified/added/untracked vs HEAD); the full path set still "
+        "feeds cross-file context, so TC02/TC06/TC07 resolution is "
+        "identical to a full run",
+    )
+    parser.add_argument(
+        "--sarif",
+        metavar="OUT.json",
+        help="also write findings (waived included, as suppressed results) "
+        "as a SARIF 2.1.0 log",
     )
     args = parser.parse_args(argv)
 
@@ -61,17 +125,58 @@ def main(argv=None) -> int:
             )
             return 2
 
+    if args.jobs == "auto":
+        jobs = os.cpu_count() or 1
+    else:
+        try:
+            jobs = int(args.jobs)
+        except ValueError:
+            print(f"tunnelcheck: error: bad --jobs value: {args.jobs!r}",
+                  file=sys.stderr)
+            return 2
+    jobs = max(1, jobs)
+
+    restrict: Optional[Set[Path]] = None
+    if args.changed_only:
+        changed = _git_changed_files(REPO_ROOT)
+        if changed is None:
+            print(
+                "tunnelcheck: --changed-only: git unavailable, running on "
+                "everything",
+                file=sys.stderr,
+            )
+        else:
+            restrict = {
+                f.resolve() for f in iter_python_files(paths)
+            } & changed
+
     root = Path.cwd()
     stats: dict = {}
-    active, waived = run_paths(paths, rules=selected, stats=stats)
+    t0 = time.monotonic()
+    active, waived = run_paths(
+        paths, rules=selected, stats=stats, jobs=jobs, restrict=restrict,
+    )
+    elapsed = time.monotonic() - t0
     for v in active:
         print(v.render(root))
     if args.show_waived:
         for v in waived:
             print(f"{v.render(root)} [waived]")
+
+    if args.sarif:
+        from tools.tunnelcheck.sarif import write_sarif
+
+        write_sarif(Path(args.sarif), active, waived, root=root)
+
+    checked = (
+        f"{len(restrict)} changed of {stats.get('files', 0)}"
+        if restrict is not None
+        else f"{stats.get('files', 0)}"
+    )
     summary = (
         f"tunnelcheck: {len(active)} violation(s), {len(waived)} waived, "
-        f"{stats.get('files', 0)} file(s) scanned"
+        f"{checked} file(s) scanned in {elapsed:.2f}s"
+        f" ({jobs} job(s))"
     )
     print(summary, file=sys.stderr)
     return 1 if active else 0

@@ -1,0 +1,193 @@
+"""Project-wide call graph: the cross-file resolution TC02 half-built,
+promoted to a shared substrate layer.
+
+Before this module, three rules each carried a private sliver of the same
+graph: TC02 resolved jitted callables through ``ProjectContext``'s flat
+function index, TC07 re-derived "functions whose body calls ``jax.jit``"
+with its own project scan plus a per-module transitive-dispatch closure,
+and TC03 kept a same-file def index.  One drifting copy per rule is the
+config-rot bug class (TC08) applied to the checker itself — so the graph
+now lives here, built once per run, cached on the
+:class:`~tools.tunnelcheck.core.ProjectContext`.
+
+The graph is *name-keyed and over-approximate*: an edge ``f → g`` exists
+when ``f``'s body contains a call whose callee (bare name or resolved
+dotted path) is ``g``.  Dynamic dispatch, aliasing through containers, and
+higher-order flow are invisible — rules that need soundness in one
+direction (TC07: "could this loop body reach a device dispatch?") want
+exactly this over-approximation, and rules that need a unique signature
+(TC02) go through :meth:`resolve`, which refuses ambiguous answers rather
+than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from tools.tunnelcheck.core import FuncInfo, SourceFile, resolve_dotted
+
+
+@dataclass
+class FuncNode:
+    """One def in the project: its statically-extracted signature, the
+    class that owns it (if a method), and its outgoing call edges."""
+
+    info: FuncInfo
+    node: ast.AST
+    cls: Optional[str]
+    path: Path
+    #: Bare callee names of every call in the body (``obj.meth`` → "meth").
+    calls: Set[str] = field(default_factory=set)
+    #: Canonical dotted callees resolvable through the module's imports
+    #: ("jnp.abs" → "jax.numpy.abs").
+    dotted_calls: Set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.info.name
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.info.name}" if self.cls else self.info.name
+
+
+class CallGraph:
+    """All defs in the scanned set, with name-keyed call edges."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        #: bare name -> every def carrying it, in scan order.
+        self.by_name: Dict[str, List[FuncNode]] = {}
+        #: per-file view, for rules whose scope is one module.
+        self.by_path: Dict[Path, List[FuncNode]] = {}
+        #: functions_calling() memo — TC07 asks for the jax.jit factories
+        #: once per in-scope file, and the project-wide sweep must stay a
+        #: once-per-run cost like the private cache it replaced.
+        self._calling_cache: Dict[str, Set[str]] = {}
+        for sf in files:
+            self._index_file(sf)
+
+    def _index_file(self, sf: SourceFile) -> None:
+        nodes = self.by_path.setdefault(sf.path, [])
+
+        def visit(body, cls: Optional[str], class_depth: int) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body, stmt.name, class_depth + 1)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    deco = {
+                        resolve_dotted(d, sf.aliases)
+                        for d in stmt.decorator_list
+                    }
+                    is_method = class_depth > 0 and not (
+                        deco & {"staticmethod", "classmethod"}
+                    )
+                    fn = FuncNode(
+                        info=FuncInfo.from_node(stmt, sf.path, is_method=is_method),
+                        node=stmt,
+                        cls=cls if class_depth > 0 else None,
+                        path=sf.path,
+                    )
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call):
+                            if isinstance(sub.func, ast.Attribute):
+                                fn.calls.add(sub.func.attr)
+                            elif isinstance(sub.func, ast.Name):
+                                fn.calls.add(sub.func.id)
+                            resolved = resolve_dotted(sub.func, sf.aliases)
+                            if resolved:
+                                fn.dotted_calls.add(resolved)
+                    self.by_name.setdefault(stmt.name, []).append(fn)
+                    nodes.append(fn)
+                    visit(stmt.body, None, 0)  # nested defs: not methods
+                else:
+                    # Fully recurse into compound statements (if/try/with/
+                    # loops, except handlers) at the SAME class context —
+                    # a def inside an except handler or a doubly-nested if
+                    # must be indexed exactly like the old ast.walk-based
+                    # per-rule indexers did, or TC02/TC03/TC07/TC09 lose
+                    # coverage silently.
+                    for _field, value in ast.iter_fields(stmt):
+                        if not isinstance(value, list) or not value:
+                            continue
+                        if isinstance(value[0], ast.stmt):
+                            visit(value, cls, class_depth)
+                        elif isinstance(value[0], ast.excepthandler):
+                            for handler in value:
+                                visit(handler.body, cls, class_depth)
+                        elif isinstance(value[0], ast.match_case):
+                            for case in value:
+                                visit(case.body, cls, class_depth)
+
+        visit(sf.tree.body, None, 0)
+
+    # -- signature resolution (TC02's consumer) ---------------------------
+
+    def resolve(
+        self, name: str, prefer_path: Optional[Path] = None
+    ) -> Optional[FuncInfo]:
+        """The unique signature for ``name``, or None when absent or
+        ambiguous.  Same-file defs win; otherwise every project-wide def
+        must agree on shape — a common helper name with divergent
+        signatures is skipped rather than guessed at."""
+        nodes = self.by_name.get(name)
+        if not nodes:
+            return None
+        infos = [n.info for n in nodes]
+        if prefer_path is not None:
+            local = [i for i in infos if i.path == prefer_path]
+            if len(local) == 1:
+                return local[0]
+            if len(local) > 1:
+                infos = local
+        shapes = {
+            (tuple(i.pos), i.n_pos_defaults, tuple(i.kwonly), i.has_vararg,
+             i.has_kwarg, i.is_method)
+            for i in infos
+        }
+        return infos[0] if len(shapes) == 1 else None
+
+    # -- closures (TC07's consumers) --------------------------------------
+
+    def functions_calling(self, dotted: str) -> Set[str]:
+        """Bare names of every def (project-wide) whose body calls the
+        canonical dotted path — e.g. ``jax.jit`` finds the jit factories
+        whose returned callables are device dispatches.  Memoized per
+        run; the graph is immutable once built."""
+        cached = self._calling_cache.get(dotted)
+        if cached is None:
+            cached = {
+                name
+                for name, nodes in self.by_name.items()
+                if any(dotted in n.dotted_calls for n in nodes)
+            }
+            self._calling_cache[dotted] = cached
+        return cached
+
+    def transitive_callers(
+        self,
+        seeds: Callable[[FuncNode], bool],
+        within: Optional[Path] = None,
+    ) -> Set[str]:
+        """Names of defs that transitively CALL a seed (a def for which
+        ``seeds(node)`` is True) through name-keyed edges.  ``within``
+        restricts both the candidate set and the edge targets to one file
+        — TC07's per-module dispatch closure — while seeds themselves are
+        judged wherever they are defined."""
+        nodes = self.by_path.get(within, []) if within is not None else [
+            n for ns in self.by_name.values() for n in ns
+        ]
+        marked: Set[str] = {n.name for n in nodes if seeds(n)}
+        changed = True
+        while changed:
+            changed = False
+            for n in nodes:
+                if n.name in marked:
+                    continue
+                if n.calls & marked:
+                    marked.add(n.name)
+                    changed = True
+        return marked

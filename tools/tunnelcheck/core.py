@@ -309,9 +309,8 @@ class ProjectContext:
 
     def __init__(self, files: Sequence[SourceFile]):
         self.files = list(files)
-        self.func_index: Dict[str, List[FuncInfo]] = {}
-        for sf in self.files:
-            self._index_defs(sf)
+        self._callgraph = None
+        self._attr_counts: Optional[Dict[str, int]] = None
 
         frames = _parse_registry_file(
             "p2p_llm_tunnel_tpu/protocol/frames.py", self.files
@@ -335,61 +334,28 @@ class ProjectContext:
             _str_collection(tracing, "SPAN_CATALOG") if tracing else set()
         )
 
-    def _index_defs(self, sf: SourceFile) -> None:
-        class Indexer(ast.NodeVisitor):
-            def __init__(self, outer: "ProjectContext"):
-                self.outer = outer
-                self.class_depth = 0
+    @property
+    def callgraph(self):
+        """The project-wide call graph (tools.tunnelcheck.callgraph), built
+        once per run on first use and shared by every rule — the cross-file
+        resolution TC02 half-built, now a substrate layer."""
+        if self._callgraph is None:
+            from tools.tunnelcheck.callgraph import CallGraph
 
-            def visit_ClassDef(self, node: ast.ClassDef) -> None:
-                self.class_depth += 1
-                for stmt in node.body:
-                    self.visit(stmt)
-                self.class_depth -= 1
+            self._callgraph = CallGraph(self.files)
+        return self._callgraph
 
-            def _visit_def(self, node) -> None:
-                deco = {
-                    resolve_dotted(d, sf.aliases) for d in node.decorator_list
-                }
-                is_method = self.class_depth > 0 and not (
-                    deco & {"staticmethod", "classmethod"}
-                )
-                info = FuncInfo.from_node(node, sf.path, is_method=is_method)
-                self.outer.func_index.setdefault(node.name, []).append(info)
-                saved, self.class_depth = self.class_depth, 0
-                for stmt in node.body:
-                    self.visit(stmt)
-                self.class_depth = saved
+    def attr_function_count(self, attr: str) -> int:
+        """In how many distinct functions (project-wide) is ``attr``
+        accessed through any receiver?  TC13's shared-state gate."""
+        if self._attr_counts is None:
+            from tools.tunnelcheck.dataflow import attr_function_counts
 
-            visit_FunctionDef = _visit_def
-            visit_AsyncFunctionDef = _visit_def
+            self._attr_counts = attr_function_counts(
+                sf.tree for sf in self.files
+            )
+        return self._attr_counts.get(attr, 0)
 
-        Indexer(self).visit(sf.tree)
-
-    def lookup_function(
-        self, name: str, prefer_path: Optional[Path] = None
-    ) -> Optional[FuncInfo]:
-        """The unique signature for ``name``, or None when absent/ambiguous.
-
-        Same-file defs win; otherwise all project-wide defs must agree on
-        shape (so a common helper name with divergent signatures is skipped
-        rather than guessed at).
-        """
-        infos = self.func_index.get(name)
-        if not infos:
-            return None
-        if prefer_path is not None:
-            local = [i for i in infos if i.path == prefer_path]
-            if len(local) == 1:
-                return local[0]
-            if len(local) > 1:
-                infos = local
-        shapes = {
-            (tuple(i.pos), i.n_pos_defaults, tuple(i.kwonly), i.has_vararg,
-             i.has_kwarg, i.is_method)
-            for i in infos
-        }
-        return infos[0] if len(shapes) == 1 else None
 
 
 def iter_python_files(paths: Iterable[Path]) -> Iterator[Path]:
@@ -414,15 +380,18 @@ def all_rules() -> Dict[str, "object"]:
     """rule id -> check function ``(SourceFile, ProjectContext) -> Iterator``."""
     from tools.tunnelcheck import (
         rules_async,
+        rules_atomicity,
         rules_config,
         rules_deps,
         rules_dispatch,
         rules_jax,
         rules_labels,
+        rules_lifecycle,
         rules_metrics,
         rules_protocol,
         rules_queues,
         rules_retry,
+        rules_taint,
         rules_tracing,
     )
 
@@ -439,6 +408,9 @@ def all_rules() -> Dict[str, "object"]:
         "TC10": rules_queues.check_tc10,
         "TC11": rules_retry.check_tc11,
         "TC12": rules_labels.check_tc12,
+        "TC13": rules_atomicity.check_tc13,
+        "TC14": rules_taint.check_tc14,
+        "TC15": rules_lifecycle.check_tc15,
     }
 
 
@@ -456,18 +428,67 @@ RULE_SUMMARIES = {
     "TC10": "unbounded Queue/deque in endpoints/transport/protocol without a backpressure waiver",
     "TC11": "retry/backoff loop in cli.py/endpoints/transport without a cap+attempt bound or jitter",
     "TC12": "labeled Prometheus series interpolated outside the bounded registry helpers",
+    "TC13": "read-modify-write of shared state straddles an await/yield without a lock",
+    "TC14": "client-controlled header/body bytes reach a trusted sink unsanitized",
+    "TC15": "span/slot/in-flight registration not released on every exit path (incl. generator aclose)",
 }
+
+
+#: Fork-inherited state for parallel workers: set by :func:`run_paths`
+#: immediately before the pool forks, so child processes see the parsed
+#: files and warmed ProjectContext via copy-on-write instead of re-parsing
+#: the tree per worker.
+_FORK_STATE: Optional[Tuple[List[SourceFile], ProjectContext, List[str]]] = None
+
+
+def _check_one(
+    sf: SourceFile, ctx: ProjectContext, selected: Sequence[str],
+    checks: Dict[str, object],
+) -> Tuple[List[Violation], List[Violation]]:
+    active: List[Violation] = []
+    waived: List[Violation] = []
+    for rule_id in selected:
+        for v in checks[rule_id](sf, ctx):
+            (waived if sf.waived(v.rule, v.line, v.end_line) else active).append(v)
+    return active, waived
+
+
+def _fork_worker(indices: Sequence[int]) -> Tuple[List[Violation], List[Violation]]:
+    files, ctx, selected = _FORK_STATE  # type: ignore[misc]
+    checks = all_rules()
+    active: List[Violation] = []
+    waived: List[Violation] = []
+    for i in indices:
+        a, w = _check_one(files[i], ctx, selected, checks)
+        active.extend(a)
+        waived.extend(w)
+    return active, waived
 
 
 def run_paths(
     paths: Sequence[Path],
     rules: Optional[Sequence[str]] = None,
     stats: Optional[Dict[str, int]] = None,
+    jobs: int = 1,
+    restrict: Optional[Set[Path]] = None,
 ) -> Tuple[List[Violation], List[Violation]]:
     """Run the suite. Returns (active_violations, waived_violations).
 
     ``stats``, when given, receives ``{"files": <count scanned>}`` so the
     CLI summary doesn't re-walk the tree.
+
+    ``jobs`` > 1 fans the per-file rule passes across a fork-based
+    multiprocessing pool (135 files × 15 rules is embarrassingly parallel;
+    cross-file context is parsed once in the parent and inherited
+    copy-on-write).  Platforms without fork fall back to serial — results
+    are byte-identical either way, including TC00 parse errors, which are
+    collected in the parent so the exit-code and summary paths can never
+    disagree about them.
+
+    ``restrict`` limits which files get *findings* (the ``--changed-only``
+    mode) while the whole path set still feeds cross-file context — a
+    changed-file scan must see the unchanged registries and callees or
+    TC02/TC06/TC07 would lose their cross-file resolution.
     """
     files: List[SourceFile] = []
     active: List[Violation] = []
@@ -477,7 +498,8 @@ def run_paths(
         n_files += 1
         sf, err = load_source(path)
         if err is not None:
-            active.append(err)
+            if restrict is None or path.resolve() in restrict:
+                active.append(err)
         if sf is not None:
             files.append(sf)
     if stats is not None:
@@ -496,10 +518,46 @@ def run_paths(
                 f"unknown rule id(s): {', '.join(sorted(unknown))}"
             )
         selected = [r for r in rules if r in checks]
-    for sf in files:
-        for rule_id in selected:
-            for v in checks[rule_id](sf, ctx):
-                (waived if sf.waived(v.rule, v.line, v.end_line) else active).append(v)
+
+    if restrict is None:
+        check_files = files
+    else:
+        check_files = [sf for sf in files if sf.path.resolve() in restrict]
+
+    ran_parallel = False
+    if jobs > 1 and len(check_files) > 1:
+        import multiprocessing
+
+        try:
+            mp = multiprocessing.get_context("fork")
+        except ValueError:
+            mp = None
+        if mp is not None:
+            # Warm the lazily-built shared structures BEFORE forking, so
+            # every worker inherits them instead of rebuilding per process.
+            ctx.callgraph
+            ctx.attr_function_count("")
+            global _FORK_STATE
+            file_index = {id(sf): i for i, sf in enumerate(files)}
+            chunks: List[List[int]] = [[] for _ in range(jobs)]
+            for j, sf in enumerate(check_files):
+                chunks[j % jobs].append(file_index[id(sf)])
+            chunks = [c for c in chunks if c]
+            _FORK_STATE = (files, ctx, list(selected))
+            try:
+                with mp.Pool(len(chunks)) as pool:
+                    for a, w in pool.map(_fork_worker, chunks):
+                        active.extend(a)
+                        waived.extend(w)
+                ran_parallel = True
+            finally:
+                _FORK_STATE = None
+    if not ran_parallel:
+        for sf in check_files:
+            a, w = _check_one(sf, ctx, selected, checks)
+            active.extend(a)
+            waived.extend(w)
+
     active.sort(key=lambda v: (str(v.path), v.line, v.rule))
     waived.sort(key=lambda v: (str(v.path), v.line, v.rule))
     return active, waived

@@ -1,0 +1,688 @@
+"""Shared dataflow substrate: per-function CFGs, await-point partitioning,
+reaching reads over ``self.*`` attributes, and a taint lattice.
+
+Every serving-engine incident this repo shipped a fix for — the
+x-tunnel-tenant minting hole (PR 7), the finish-recorded-after-final-yield
+span leak (PR 6), the breaker half-open wedge (PR 8 review) — is a
+*dataflow* bug: client-controlled bytes reaching a trusted sink, or shared
+mutable state torn across an ``await``.  The 13 original rules each carried
+a private sliver of flow analysis (TC07's transitive-dispatch closure,
+TC03's traced-function marking); this module is the shared substrate the
+incident-grounded rules (TC13/TC14/TC15) are built on, and that existing
+rules migrate to via :mod:`tools.tunnelcheck.callgraph`.
+
+Three layers, all stdlib-``ast`` (never importing the scanned code):
+
+- :class:`FuncCFG` — basic blocks of :class:`Event` s with control-flow
+  edges.  Statements are lowered to evaluation-order event streams (reads
+  before writes, awaited operands before the suspension itself), so an
+  ``AugAssign`` whose value awaits is correctly seen as read → await →
+  write.  ``await`` and ``yield`` are both suspension events: an async
+  generator parked at a ``yield`` has released the loop exactly like one
+  parked at an ``await`` (and may never resume at all — ``aclose()``).
+- :func:`attr_reach` — a forward worklist analysis over the CFG computing,
+  at each write to a shared attribute, whether the value or the guarding
+  read of that attribute crossed a suspension point (the await-atomicity
+  question TC13 asks).  This is reaching-definitions with definitions
+  replaced by *reads* and kill replaced by *refresh*: a re-read after the
+  await (the check-again idiom) clears the crossed flag, because the code
+  re-validated its premise.
+- :func:`taint_locals` / :func:`expr_tainted` — a two-point taint lattice
+  (clean < tainted) propagated through local assignments to a fixpoint.
+  Sources and sanitizers are injected by the rule (TC14 seeds at
+  client-controlled request headers/bodies and clears at the registered
+  sanitizers), so the engine itself stays policy-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+#: Mutating container/obj methods: calling one on a tracked attribute is a
+#: WRITE to it (``self.departed.pop(pid)`` mutates shared state exactly as
+#: ``self.departed = ...`` would, just in place).
+MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault", "add", "discard", "appendleft",
+})
+
+#: Identifier words that mark an ``async with``/``with`` context expression
+#: as a mutual-exclusion region (``self._lock``, ``self._admit_mutex``...).
+LOCK_WORDS = frozenset({"lock", "mutex", "sem", "semaphore", "cond", "condition"})
+
+
+@dataclass
+class Event:
+    """One atomicity-relevant action, in evaluation order.
+
+    ``kind`` is one of:
+
+    - ``read``    — load of a tracked attribute (``obj`` = root name)
+    - ``write``   — store/mutation of a tracked attribute; ``deps`` names
+                    the locals whose values flow into it
+    - ``suspend`` — ``await`` or ``yield``/``yield from`` (``is_yield``
+                    distinguishes them for messages)
+    - ``local``   — assignment to a local name; ``deps`` = locals read by
+                    the RHS, ``attr_deps`` = tracked attrs read by the RHS
+    """
+
+    kind: str
+    line: int
+    obj: str = ""
+    attr: str = ""
+    name: str = ""  # local target for kind="local"
+    deps: Set[str] = field(default_factory=set)
+    attr_deps: Set[Tuple[str, str]] = field(default_factory=set)
+    locked: bool = False
+    is_yield: bool = False
+    node: Optional[ast.AST] = None
+
+
+class Block:
+    __slots__ = ("events", "succs")
+
+    def __init__(self) -> None:
+        self.events: List[Event] = []
+        self.succs: List["Block"] = []
+
+    def link(self, other: "Block") -> None:
+        if other not in self.succs:
+            self.succs.append(other)
+
+
+def _attr_key(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``(root, attr)`` for a one-level attribute access on a plain name
+    (``self._x`` → ("self", "_x"), ``link.state`` → ("link", "state")).
+    Deeper chains track their OUTERMOST shared hop (``self.a.b`` reads
+    ``self.a``), which is what the atomicity question cares about."""
+    while isinstance(node, ast.Attribute) and isinstance(node.value, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr)
+    return None
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name and LOCK_WORDS & set(name.lower().split("_")):
+            return True
+    return False
+
+
+class _EventExtractor:
+    """Lower one expression/statement to evaluation-order events."""
+
+    def __init__(self, locked: bool):
+        self.locked = locked
+        self.out: List[Event] = []
+
+    def _ev(self, kind: str, node: ast.AST, **kw) -> None:
+        self.out.append(Event(
+            kind, getattr(node, "lineno", 0), locked=self.locked,
+            node=node, **kw,
+        ))
+
+    def expr(self, node: ast.AST) -> None:
+        """Events of evaluating ``node``, children before the node's own
+        effect (operands are evaluated before an await suspends, receivers
+        before a mutating call fires)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scopes run later (or never); not this flow
+        if isinstance(node, ast.Await):
+            self.expr(node.value)
+            self._ev("suspend", node)
+            return
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            if getattr(node, "value", None) is not None:
+                self.expr(node.value)
+            self._ev("suspend", node, is_yield=True)
+            return
+        if isinstance(node, ast.Call):
+            # Receiver/args first, then the call's own read/mutation.
+            key = None
+            method = ""
+            if isinstance(node.func, ast.Attribute):
+                key = _attr_key(node.func.value)
+                method = node.func.attr
+                self.expr(node.func.value)
+            else:
+                self.expr(node.func)
+            for a in node.args:
+                self.expr(a)
+            for kw in node.keywords:
+                self.expr(kw.value)
+            if key is not None:
+                if method in MUTATING_METHODS:
+                    self._ev("write", node, obj=key[0], attr=key[1])
+                else:
+                    self._ev("read", node, obj=key[0], attr=key[1])
+            return
+        if isinstance(node, ast.Attribute):
+            key = _attr_key(node)
+            if key is not None:
+                self._ev("read", node, obj=key[0], attr=key[1])
+            else:
+                self.expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.expr(child)
+
+    def _value_deps(self, value: ast.AST) -> Tuple[Set[str], Set[Tuple[str, str]]]:
+        names: Set[str] = set()
+        attrs: Set[Tuple[str, str]] = set()
+        for sub in ast.walk(value):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                names.add(sub.id)
+            key = _attr_key(sub) if isinstance(sub, ast.Attribute) else None
+            if key is not None:
+                attrs.add(key)
+        return names, attrs
+
+    def _store(self, target: ast.AST, value: Optional[ast.AST]) -> None:
+        deps, attr_deps = self._value_deps(value) if value is not None else (set(), set())
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._store(e, value)
+            return
+        if isinstance(target, ast.Name):
+            self._ev("local", target, name=target.id, deps=deps, attr_deps=attr_deps)
+            return
+        if isinstance(target, ast.Subscript):
+            # ``self.reg[k] = v`` mutates ``self.reg`` in place.
+            target = target.value
+        key = _attr_key(target)
+        if key is not None:
+            self._ev("write", target, obj=key[0], attr=key[1],
+                     deps=deps, attr_deps=attr_deps)
+
+    def stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.Assign):
+            self.expr(node.value)
+            for t in node.targets:
+                self._store(t, node.value)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self.expr(node.value)
+                self._store(node.target, node.value)
+        elif isinstance(node, ast.AugAssign):
+            # target is READ, then value evaluates (may suspend), then the
+            # store — the exact torn-increment shape TC13 exists for.
+            tkey = _attr_key(node.target)
+            if tkey is not None:
+                self._ev("read", node.target, obj=tkey[0], attr=tkey[1])
+            self.expr(node.value)
+            deps, attr_deps = self._value_deps(node.value)
+            if tkey is not None:
+                self._ev("write", node.target, obj=tkey[0], attr=tkey[1],
+                         deps=deps, attr_deps=attr_deps | {tkey})
+            elif isinstance(node.target, ast.Name):
+                self._ev("local", node.target, name=node.target.id,
+                         deps=deps | {node.target.id}, attr_deps=attr_deps)
+            elif isinstance(node.target, ast.Subscript):
+                self._store(node.target, node.value)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                tgt = t.value if isinstance(t, ast.Subscript) else t
+                key = _attr_key(tgt)
+                if key is not None:
+                    self._ev("write", t, obj=key[0], attr=key[1])
+        elif isinstance(node, (ast.Expr, ast.Return, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(node):
+                self.expr(child)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+
+
+# ---------------------------------------------------------------------------
+# CFG construction
+# ---------------------------------------------------------------------------
+
+class FuncCFG:
+    """Control-flow graph of one function body.
+
+    ``entry``/``exit_block`` bracket the graph; ``blocks`` lists every
+    reachable block.  Loops carry back edges; ``try`` bodies edge into
+    their handlers from both the body's entry and its exit (the standard
+    any-statement-may-raise approximation at block granularity); finally
+    blocks are on every leaving path.  Nested function definitions are
+    opaque — their bodies run in another activation, under their own CFG.
+    """
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.entry = Block()
+        self.exit_block = Block()
+        self._loop_stack: List[Tuple[Block, Block]] = []  # (head, after)
+        cur = self._build_body(list(fn.body), self.entry, locked=False)
+        cur.link(self.exit_block)
+        self.blocks = self._collect()
+
+    # -- helpers ---------------------------------------------------------
+
+    def _collect(self) -> List[Block]:
+        seen: List[Block] = []
+        stack = [self.entry]
+        marked = {id(self.entry)}
+        while stack:
+            b = stack.pop()
+            seen.append(b)
+            for s in b.succs:
+                if id(s) not in marked:
+                    marked.add(id(s))
+                    stack.append(s)
+        return seen
+
+    def _emit(self, stmt: ast.stmt, block: Block, locked: bool) -> None:
+        ex = _EventExtractor(locked)
+        ex.stmt(stmt)
+        block.events.extend(ex.out)
+
+    def _build_body(self, body: List[ast.stmt], cur: Block, locked: bool) -> Block:
+        for stmt in body:
+            cur = self._build_stmt(stmt, cur, locked)
+        return cur
+
+    def _build_stmt(self, stmt: ast.stmt, cur: Block, locked: bool) -> Block:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return cur
+        if isinstance(stmt, ast.If):
+            ex = _EventExtractor(locked)
+            ex.expr(stmt.test)
+            cur.events.extend(ex.out)
+            then_b, else_b, join = Block(), Block(), Block()
+            cur.link(then_b)
+            cur.link(else_b)
+            self._build_body(stmt.body, then_b, locked).link(join)
+            self._build_body(stmt.orelse, else_b, locked).link(join)
+            return join
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head, body_b, after = Block(), Block(), Block()
+            cur.link(head)
+            ex = _EventExtractor(locked)
+            if isinstance(stmt, ast.While):
+                ex.expr(stmt.test)
+            else:
+                ex.expr(stmt.iter)
+                if isinstance(stmt, ast.AsyncFor):
+                    # Each iteration awaits __anext__.
+                    ex.out.append(Event("suspend", stmt.lineno, locked=locked))
+                ex._store(stmt.target, None)
+            head.events.extend(ex.out)
+            head.link(body_b)
+            head.link(after)
+            self._loop_stack.append((head, after))
+            self._build_body(stmt.body, body_b, locked).link(head)
+            self._loop_stack.pop()
+            if stmt.orelse:
+                els = Block()
+                head.link(els)
+                self._build_body(stmt.orelse, els, locked).link(after)
+            return after
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            lockish = any(_is_lockish(item.context_expr) for item in stmt.items)
+            ex = _EventExtractor(locked)
+            for item in stmt.items:
+                ex.expr(item.context_expr)
+                if isinstance(stmt, ast.AsyncWith):
+                    ex.out.append(Event("suspend", stmt.lineno, locked=locked))
+                if item.optional_vars is not None:
+                    ex._store(item.optional_vars, item.context_expr)
+            cur.events.extend(ex.out)
+            return self._build_body(stmt.body, cur, locked or lockish)
+        if isinstance(stmt, ast.Try):
+            body_entry = Block()
+            cur.link(body_entry)
+            body_exit = self._build_body(stmt.body, body_entry, locked)
+            else_exit = self._build_body(stmt.orelse, body_exit, locked)
+            join = Block()
+            handler_exits: List[Block] = [else_exit]
+            for handler in stmt.handlers:
+                h = Block()
+                # Any statement in the body may raise: the handler sees
+                # both the state at entry and the state at the end.
+                body_entry.link(h)
+                body_exit.link(h)
+                handler_exits.append(self._build_body(handler.body, h, locked))
+            if stmt.finalbody:
+                fin = Block()
+                for e in handler_exits:
+                    e.link(fin)
+                # The finally also runs on the raising/early-return paths.
+                body_entry.link(fin)
+                body_exit.link(fin)
+                fin_exit = self._build_body(stmt.finalbody, fin, locked)
+                fin_exit.link(join)
+                fin_exit.link(self.exit_block)
+            else:
+                for e in handler_exits:
+                    e.link(join)
+            return join
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self._emit(stmt, cur, locked)
+            cur.link(self.exit_block)
+            return Block()  # unreachable continuation
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            if self._loop_stack:
+                head, after = self._loop_stack[-1]
+                cur.link(after if isinstance(stmt, ast.Break) else head)
+            return Block()
+        self._emit(stmt, cur, locked)
+        return cur
+
+
+# ---------------------------------------------------------------------------
+# Await-partitioned reaching reads (TC13's question)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TornWrite:
+    """A write to a shared attribute whose guarding read (or the value
+    flowing into it) happened on the far side of a suspension point."""
+
+    obj: str
+    attr: str
+    line: int
+    suspend_line: int
+    via_local: str = ""  # the stale local carrying the pre-suspend read
+    is_yield: bool = False
+    node: Optional[ast.AST] = None
+
+
+def attr_reach(
+    cfg: FuncCFG,
+    tracked_roots: Set[str],
+    tracked: Optional[Callable[[str, str], bool]] = None,
+) -> List[TornWrite]:
+    """Worklist fixpoint over ``cfg``: at each unlocked write to a tracked
+    attribute, report whether the most recent read of that attribute — or
+    a local whose value derives from such a read — crossed a suspension
+    point since.  A re-read after the suspension *refreshes* the attribute
+    (the check-again-after-await idiom is the sanctioned fix and must not
+    flag); holding a lock around both sides suppresses entirely.
+    """
+    keep = tracked or (lambda obj, attr: True)
+
+    # State: attr key -> (crossed, suspend_line, was_yield);
+    # local -> {attr key -> same triple}.
+    AttrState = Dict[Tuple[str, str], Tuple[bool, int, bool]]
+    LocalState = Dict[str, Dict[Tuple[str, str], Tuple[bool, int, bool]]]
+
+    def merge_attr(a: AttrState, b: AttrState) -> AttrState:
+        out = dict(a)
+        for k, v in b.items():
+            if k in out:
+                o = out[k]
+                out[k] = (o[0] or v[0], max(o[1], v[1]), o[2] or v[2])
+            else:
+                out[k] = v
+        return out
+
+    def merge_local(a: LocalState, b: LocalState) -> LocalState:
+        out = {k: dict(v) for k, v in a.items()}
+        for name, deps in b.items():
+            out[name] = merge_attr(out.get(name, {}), deps)
+        return out
+
+    in_attr: Dict[int, AttrState] = {id(cfg.entry): {}}
+    in_local: Dict[int, LocalState] = {id(cfg.entry): {}}
+    torn: Dict[Tuple[str, str, int], TornWrite] = {}
+
+    worklist = [cfg.entry]
+    iterations = 0
+    limit = 4 * (len(cfg.blocks) + 1) * (len(cfg.blocks) + 8)
+    while worklist and iterations < limit:
+        iterations += 1
+        block = worklist.pop()
+        attrs: AttrState = dict(in_attr.get(id(block), {}))
+        locals_: LocalState = {
+            k: dict(v) for k, v in in_local.get(id(block), {}).items()
+        }
+        for ev in block.events:
+            if ev.kind == "suspend":
+                attrs = {
+                    k: (True, ev.line, ev.is_yield) for k in attrs
+                }
+                locals_ = {
+                    name: {k: (True, ev.line, ev.is_yield) for k in deps}
+                    for name, deps in locals_.items()
+                }
+            elif ev.kind == "read":
+                if ev.obj in tracked_roots and keep(ev.obj, ev.attr):
+                    attrs[(ev.obj, ev.attr)] = (False, 0, False)
+            elif ev.kind == "local":
+                deps: Dict[Tuple[str, str], Tuple[bool, int, bool]] = {}
+                for key in ev.attr_deps:
+                    if key[0] in tracked_roots and keep(*key):
+                        deps[key] = (False, 0, False)
+                # sorted: Set iteration order is hash-seed-dependent, and
+                # the reported line/local must be byte-identical between
+                # the serial and forked runs.
+                for dep in sorted(ev.deps):
+                    for key, val in locals_.get(dep, {}).items():
+                        cur = deps.get(key)
+                        if cur is None or val[0] and not cur[0]:
+                            deps[key] = val
+                locals_[ev.name] = deps
+            elif ev.kind == "write":
+                key = (ev.obj, ev.attr)
+                if ev.obj in tracked_roots and keep(ev.obj, ev.attr) \
+                        and not ev.locked:
+                    hit = None
+                    via = ""
+                    state = attrs.get(key)
+                    if state is not None and state[0]:
+                        hit = (state[1], state[2])
+                    for dep in sorted(ev.deps):
+                        val = locals_.get(dep, {}).get(key)
+                        if val is not None and val[0]:
+                            hit, via = (val[1], val[2]), dep
+                            break
+                    if hit is not None:
+                        tk = (ev.obj, ev.attr, ev.line)
+                        if tk not in torn:
+                            torn[tk] = TornWrite(
+                                ev.obj, ev.attr, ev.line, hit[0],
+                                via_local=via, is_yield=hit[1], node=ev.node,
+                            )
+                # A write ENDS the RMW window whether or not it flagged:
+                # the pending-read entry is dropped entirely, so a blind
+                # write-after-write loop (keepalive stamping a timestamp
+                # every interval) never reads as a read-modify-write.
+                attrs.pop(key, None)
+        for succ in block.succs:
+            old_a = in_attr.get(id(succ))
+            old_l = in_local.get(id(succ))
+            new_a = attrs if old_a is None else merge_attr(old_a, attrs)
+            new_l = locals_ if old_l is None else merge_local(old_l, locals_)
+            if new_a != old_a or new_l != old_l:
+                in_attr[id(succ)] = new_a
+                in_local[id(succ)] = new_l
+                if succ not in worklist:
+                    worklist.append(succ)
+    return sorted(torn.values(), key=lambda t: (t.line, t.attr))
+
+
+# ---------------------------------------------------------------------------
+# Attribute access index (the "reachable from two tasks" gate)
+# ---------------------------------------------------------------------------
+
+def suspension_lines(fn: ast.AST) -> List[int]:
+    """Lines of every await/yield directly in ``fn`` (nested defs opaque)."""
+    out: List[int] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, (ast.Await, ast.Yield, ast.YieldFrom)):
+                out.append(getattr(child, "lineno", 0))
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def iter_functions(tree: ast.Module) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+    """Every (def, enclosing_class_name) in a module, any nesting."""
+
+    def walk(node: ast.AST, cls: Optional[str]) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, None)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def attr_function_counts(trees: Iterable[ast.Module]) -> Dict[str, int]:
+    """attr name -> number of distinct functions (project-wide) that read
+    or write it through ANY receiver.  TC13's shared-state gate: an
+    attribute only one function ever touches has a single-writer contract
+    by construction and is exempt without a waiver."""
+    counts: Dict[str, Set[int]] = {}
+    for tree in trees:
+        for fn, _cls in iter_functions(tree):
+            fid = id(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Attribute):
+                    key = _attr_key(sub)
+                    if key is not None:
+                        counts.setdefault(key[1], set()).add(fid)
+    return {attr: len(fns) for attr, fns in counts.items()}
+
+
+# ---------------------------------------------------------------------------
+# Taint lattice (TC14's engine)
+# ---------------------------------------------------------------------------
+
+def call_name(node: ast.Call) -> str:
+    """Bare callee name of a call (``obj.meth(...)`` -> "meth") — shared by
+    every rule that matches callees by name."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def param_names(fn: ast.AST) -> Set[str]:
+    """Named parameters of a def (positional-only + positional + kw-only)
+    — the seed set taint/lifecycle/atomicity rules share."""
+    a = fn.args
+    return {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+
+
+def expr_tainted(
+    expr: ast.AST,
+    tainted: Set[str],
+    is_source: Callable[[ast.AST], bool],
+    sanitizers: "frozenset[str] | Set[str]",
+) -> bool:
+    """Does evaluating ``expr`` yield client-controlled bytes?
+
+    Tainted if any subexpression is a source or a tainted local, UNLESS
+    the subexpression is (inside) a call to a registered sanitizer — the
+    sanitizer's *result* is clean by definition, whatever it read.
+    """
+    sanitized: Set[int] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and call_name(sub) in sanitizers:
+            sanitized.update(id(n) for n in ast.walk(sub))
+    for sub in ast.walk(expr):
+        if id(sub) in sanitized:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if is_source(sub):
+            return True
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id in tainted:
+            return True
+    return False
+
+
+def taint_locals(
+    fn: ast.AST,
+    is_source: Callable[[ast.AST], bool],
+    sanitizers: "frozenset[str] | Set[str]",
+    seed: Optional[Set[str]] = None,
+) -> Set[str]:
+    """Fixpoint of tainted local names in one function body.
+
+    Flow-insensitive (a name tainted anywhere is tainted everywhere): this
+    over-approximates, which for a security-ish rule is the right failure
+    direction — the waiver syntax carries the human judgement.  Nested
+    defs are opaque (their params rebind).
+    """
+    tainted: Set[str] = set(seed or ())
+
+    def targets(node) -> Iterator[str]:
+        tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in tgts:
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Name):
+                        yield e.id
+
+    stmts: List[ast.AST] = []
+
+    def collect(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stmts.append(child)
+            collect(child)
+
+    collect(fn)
+
+    changed = True
+    while changed:
+        changed = False
+        for node in stmts:
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(node, "value", None)
+                if value is None:
+                    continue
+                if expr_tainted(value, tainted, is_source, sanitizers):
+                    for name in targets(node):
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if expr_tainted(node.iter, tainted, is_source, sanitizers):
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is None:
+                        continue
+                    if expr_tainted(item.context_expr, tainted, is_source,
+                                    sanitizers):
+                        for t in ast.walk(item.optional_vars):
+                            if isinstance(t, ast.Name) and t.id not in tainted:
+                                tainted.add(t.id)
+                                changed = True
+    return tainted

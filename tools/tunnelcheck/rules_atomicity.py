@@ -1,0 +1,108 @@
+"""TC13: read-modify-write of shared mutable state across an await.
+
+The PR 8 review incident made permanent: the peer circuit breaker's
+half-open bookkeeping read ``consec_failures``, awaited the probe
+dispatch, then wrote breaker state based on the *stale* read — a second
+task's concurrent failure/success in the await window could wedge the
+breaker half-open (or double-open it).  Nothing crashes; the fabric just
+routes wrong under exactly the overlapping-failure load the breaker
+exists for.  ``make test-race`` only catches what a seeded schedule
+happens to interleave; this rule makes the invariant static.
+
+Built on the shared substrate (:mod:`tools.tunnelcheck.dataflow`): each
+``async def`` in the serving scope gets a CFG, and a worklist analysis
+reports every write to a *shared* attribute whose guarding read — or the
+local carrying the value being written — crossed an ``await``/``yield``
+(both suspension points: an async generator parked at a yield has
+released the loop, and ``aclose()`` may mean it never resumes).
+
+What does NOT flag:
+
+- re-reading after the await (the check-again idiom — the read is fresh);
+- holding a lock: writes inside ``async with self._lock`` (any context
+  expression with a lock-ish identifier word) are atomic sections;
+- attributes only ONE function ever touches, project-wide: nothing else
+  can interleave, so the single-writer contract holds by construction
+  (the ``attr_function_count`` gate);
+- sync defs: without an await there is no suspension to tear across
+  (cross-THREAD tearing is ``make test-race``'s and the GIL's problem).
+
+Deliberate single-task ownership (the engine ``_loop`` pattern: every
+mutation of decode state happens on the one loop task) is waived per
+line, naming the owning task — the waiver IS the documented contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+from tools.tunnelcheck.dataflow import (
+    FuncCFG,
+    attr_reach,
+    iter_functions,
+    param_names,
+)
+
+#: Serving-path scope: the asyncio-heavy modules whose objects are reached
+#: from many tasks (request handlers, per-peer readers, probers,
+#: keepalives, the engine loop).  Fixture trees reuse these path parts.
+SCOPE_PARTS = (
+    "p2p_llm_tunnel_tpu/endpoints/",
+    "p2p_llm_tunnel_tpu/engine/",
+    "p2p_llm_tunnel_tpu/transport/",
+    "p2p_llm_tunnel_tpu/protocol/",
+    "p2p_llm_tunnel_tpu/signaling/",
+    "p2p_llm_tunnel_tpu/utils/",
+)
+
+#: An attribute is "shared" when at least this many distinct functions
+#: (project-wide, any receiver) touch it — one accessor means a
+#: single-writer contract by construction.
+MIN_ACCESSOR_FUNCTIONS = 2
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    p = sf.path.as_posix()
+    return any(part in p for part in SCOPE_PARTS)
+
+
+def check_tc13(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if not _in_scope(sf):
+        return iter(())
+    out: List[Violation] = []
+    for fn, _cls in iter_functions(sf.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # Roots that can alias pre-existing (shared) objects: self, and
+        # any parameter — a caller handed it in, so another task may hold
+        # it too.  Fresh locals (constructed in this activation) are not
+        # tracked; publishing them is the caller's last step, after which
+        # this frame no longer writes.
+        roots = {"self"} | param_names(fn)
+
+        def shared(obj: str, attr: str) -> bool:
+            return ctx.attr_function_count(attr) >= MIN_ACCESSOR_FUNCTIONS
+
+        cfg = FuncCFG(fn)
+        for torn in attr_reach(cfg, roots, tracked=shared):
+            where = "yield" if torn.is_yield else "await"
+            via = (f" via stale local `{torn.via_local}`"
+                   if torn.via_local else "")
+            node = torn.node
+            out.append(Violation(
+                "TC13",
+                sf.path,
+                torn.line,
+                f"read-modify-write of shared `{torn.obj}.{torn.attr}` "
+                f"straddles the {where}/suspension at line "
+                f"{torn.suspend_line}{via}: another task can interleave in "
+                "the suspension window (the breaker half-open wedge class) "
+                "— hold an asyncio.Lock across the read+write, re-read "
+                "after the await, or waive naming the single-writer task "
+                "that owns this state",
+                end_line=getattr(node, "end_lineno", None) if node is not None
+                else None,
+            ))
+    return iter(out)

@@ -35,7 +35,7 @@ documentation of the dispatch-granularity contract at each site.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Set
+from typing import Iterator, List, Set
 
 from tools.tunnelcheck.core import (
     ProjectContext,
@@ -111,24 +111,9 @@ def _callee_name(func: ast.AST) -> str:
 def _project_jit_factories(ctx: ProjectContext) -> Set[str]:
     """Names of functions ANYWHERE in the scanned set whose body contains
     a ``jax.jit(...)`` call — their return values (tuples included) are
-    dispatch callables, and calling them IS a trace/dispatch."""
-    cached = getattr(ctx, "_tc07_factories", None)
-    if cached is not None:
-        return cached
-    out: Set[str] = set()
-    for sf in ctx.files:
-        for node in ast.walk(sf.tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            for sub in ast.walk(node):
-                if (
-                    isinstance(sub, ast.Call)
-                    and resolve_dotted(sub.func, sf.aliases) == "jax.jit"
-                ):
-                    out.add(node.name)
-                    break
-    ctx._tc07_factories = out
-    return out
+    dispatch callables, and calling them IS a trace/dispatch.  Served by
+    the shared call graph (this used to be a private project-wide scan)."""
+    return ctx.callgraph.functions_calling("jax.jit")
 
 
 def _dispatch_names(sf: SourceFile, factories: Set[str]) -> Set[str]:
@@ -177,38 +162,23 @@ def _dispatch_names(sf: SourceFile, factories: Set[str]) -> Set[str]:
 
 
 def _dispatching_functions(
-    sf: SourceFile, names: Set[str], factories: Set[str]
+    sf: SourceFile, names: Set[str], factories: Set[str], ctx: ProjectContext
 ) -> Set[str]:
-    """Module functions that transitively perform a device dispatch."""
-    funcs: Dict[str, ast.AST] = {}
-    for node in ast.walk(sf.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            funcs[node.name] = node
+    """Module functions that transitively perform a device dispatch — the
+    shared call graph's transitive-caller closure, seeded at defs whose
+    body contains a direct device op, a jit, a dispatch-bound name, or a
+    jit-factory call."""
+    device_dotted = set(DEVICE_CALLS) | {"jax.jit"}
 
-    def body_dispatches(fn: ast.AST, known: Set[str]) -> bool:
-        for sub in ast.walk(fn):
-            if not isinstance(sub, ast.Call):
-                continue
-            resolved = resolve_dotted(sub.func, sf.aliases)
-            if resolved in DEVICE_CALLS or resolved == "jax.jit":
-                return True
-            callee = _callee_name(sub.func)
-            if callee == "block_until_ready" or callee in names \
-                    or callee in factories or callee in known:
-                return True
-        return False
+    def is_seed(fn) -> bool:
+        return bool(
+            fn.dotted_calls & device_dotted
+            or "block_until_ready" in fn.calls
+            or fn.calls & names
+            or fn.calls & factories
+        )
 
-    dispatching: Set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for name, fn in funcs.items():
-            if name in dispatching:
-                continue
-            if body_dispatches(fn, dispatching):
-                dispatching.add(name)
-                changed = True
-    return dispatching
+    return ctx.callgraph.transitive_callers(is_seed, within=sf.path)
 
 
 def check_tc07(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
@@ -216,7 +186,7 @@ def check_tc07(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
         return iter(())
     factories = _project_jit_factories(ctx)
     names = _dispatch_names(sf, factories)
-    dispatching = _dispatching_functions(sf, names, factories)
+    dispatching = _dispatching_functions(sf, names, factories, ctx)
     out: List[Violation] = []
     reported: Set = set()
 

@@ -89,18 +89,21 @@ def _resolve_target(
 ) -> Tuple[Optional[FuncInfo], bool]:
     """(signature, drop_self) for a jitted expression, or (None, False).
 
-    ``obj.meth`` drops ``self`` (attribute access binds it); a bare name
-    that resolves to a method project-wide is skipped as ambiguous.
+    Resolution goes through the SHARED call graph (same-file defs win,
+    project-wide defs must agree on shape) — the cross-file half TC02
+    originally carried privately, now substrate.  ``obj.meth`` drops
+    ``self`` (attribute access binds it); a bare name that resolves to a
+    method project-wide is skipped as ambiguous.
     """
     if isinstance(target, ast.Lambda):
         return FuncInfo.from_node(target, sf.path), False
     if isinstance(target, ast.Name):
-        info = ctx.lookup_function(target.id, prefer_path=sf.path)
+        info = ctx.callgraph.resolve(target.id, prefer_path=sf.path)
         if info is not None and info.is_method:
             return None, False
         return info, False
     if isinstance(target, ast.Attribute):
-        info = ctx.lookup_function(target.attr, prefer_path=sf.path)
+        info = ctx.callgraph.resolve(target.attr, prefer_path=sf.path)
         if info is None:
             return None, False
         return info, info.is_method
@@ -274,11 +277,12 @@ HOST_SYNC_CALLS = {
 }
 
 
-def _module_defs(sf: SourceFile) -> Dict[str, List[ast.AST]]:
+def _module_defs(sf: SourceFile, ctx: ProjectContext) -> Dict[str, List[ast.AST]]:
+    """name -> defs in this module, served from the shared call graph's
+    per-file index instead of a private ``ast.walk`` copy."""
     defs: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(sf.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs.setdefault(node.name, []).append(node)
+    for fn in ctx.callgraph.by_path.get(sf.path, []):
+        defs.setdefault(fn.name, []).append(fn.node)
     return defs
 
 
@@ -353,10 +357,12 @@ def _static_subtree_ids(node: ast.AST, sf: SourceFile) -> set:
     return exempt
 
 
-def _traced_functions(sf: SourceFile) -> List[Tuple[ast.AST, "set[str]"]]:
+def _traced_functions(
+    sf: SourceFile, ctx: ProjectContext
+) -> List[Tuple[ast.AST, "set[str]"]]:
     """(node, static_param_names) for every function/lambda this module jits
     or hands to lax control flow."""
-    defs = _module_defs(sf)
+    defs = _module_defs(sf, ctx)
     traced: Dict[int, list] = {}  # id(node) -> [node, static names]
 
     def mark(node: ast.AST, statics: "set[str]") -> None:
@@ -416,7 +422,6 @@ def _traced_functions(sf: SourceFile) -> List[Tuple[ast.AST, "set[str]"]]:
 
 
 def check_tc03(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
-    del ctx
     reported: set = set()
     out: List[Violation] = []
 
@@ -425,7 +430,7 @@ def check_tc03(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
             reported.add((line, msg))
             out.append(Violation("TC03", sf.path, line, msg, end_line=end_line))
 
-    for fn, statics in _traced_functions(sf):
+    for fn, statics in _traced_functions(sf, ctx):
         fn_name = getattr(fn, "name", "<lambda>")
         traced_params = set(_fn_param_names(fn)) - statics
 

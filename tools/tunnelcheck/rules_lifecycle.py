@@ -1,0 +1,398 @@
+"""TC15: spans, slots, and in-flight registrations must be released on
+every CFG exit path — including generator ``aclose()`` at a yield.
+
+The PR 6 incident made permanent: the engine's ``generate()`` recorded a
+request's finish state AFTER its final ``yield``.  A consumer that stops
+iterating once it has the last event closes the generator AT the yield
+(GeneratorExit), the post-yield code never runs, and the trace journal
+logged every normal finish as "cancelled" — the span effectively leaked
+its true lifecycle.  The shipped fix moved the bookkeeping before the
+yield and the span emission into ``finally``; this rule makes that shape
+mandatory for every paired acquire/release the serving path owns.
+
+Three registered acquire kinds (the lifecycle registry):
+
+- ``X.open(...)`` paired with ``X.close(...)`` — the FlowControl
+  per-stream window is the canonical instance;
+- in-flight registrations: ``R[key] = value`` where ``R``'s name carries
+  an in-flight word (``pending``, ``requests``, ``inflight``) paired with
+  ``R.pop(...)`` / ``del R[key]`` / ``R.clear()`` — the PeerSet demux
+  queues and the engine's active-request map;
+- span identities: ``sid = new_span_id()`` paired with an
+  ``add_span(..., span_id=sid)`` emission — an allocated identity that
+  never reaches ``add_span`` is a hole in the trace exactly where the
+  request died.
+
+An acquire is satisfied when a matching release sits in a ``finally``
+(it then runs on every exit path of its try, including GeneratorExit
+raised at an interior yield), or when acquire and release are
+straight-line in the same block with no suspension (``await``/``yield``),
+``return``, or ``raise`` between them.  Anything else — and in particular
+a release placed after a loop that yields — is exactly the incident:
+the consumer may never come back.
+
+The finally-satisfaction is deliberately function-scoped and
+position-blind (a matching release in ANY ``finally`` of the function
+counts): the leak window between an acquire and a directly following
+``try`` is visible to review, while the miss this rule exists for — no
+finally at all on a suspending path — is what actually shipped.
+Cross-function lifecycles (acquire here, release in a sibling method)
+cannot be proven by a per-function CFG and must carry a waiver naming
+the releasing owner — the waiver is the documented contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+from tools.tunnelcheck.dataflow import call_name, iter_functions, param_names
+
+SCOPE_PARTS = (
+    "p2p_llm_tunnel_tpu/endpoints/",
+    "p2p_llm_tunnel_tpu/engine/",
+    "p2p_llm_tunnel_tpu/transport/",
+    "p2p_llm_tunnel_tpu/protocol/",
+    "p2p_llm_tunnel_tpu/signaling/",
+)
+
+#: Word-wise match on the registry attribute's name: ``link.pending``,
+#: ``self._requests``, ``self._inflight_prefix``.  A BARE name only counts
+#: when it is a function parameter — a passed-in shared registry; a local
+#: ``pending_lp`` accumulation buffer dies with the frame and needs no
+#: release.
+INFLIGHT_WORDS = frozenset({"pending", "inflight", "requests"})
+
+#: ``X.open()``/``X.close()`` pairing applies when the receiver's name
+#: carries a resource word (the FlowControl per-stream window, channels,
+#: connections) — NOT to every ``.open()`` spelling: a crypto
+#: ``box.open(ciphertext)`` is a decrypt, not an acquire.
+OPEN_WORDS = frozenset({
+    "flow", "window", "channel", "conn", "connection", "stream", "slot",
+    "slots", "file",
+})
+
+SPAN_ALLOC = "new_span_id"
+SPAN_EMIT = "add_span"
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    p = sf.path.as_posix()
+    return any(part in p for part in SCOPE_PARTS)
+
+
+def _chain(node: ast.AST) -> Optional[str]:
+    """Dotted receiver chain ("link.pending", "self._requests"), or None
+    when anything but plain Name/Attribute hops appear."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _words(chain: str) -> Set[str]:
+    return set(chain.rsplit(".", 1)[-1].lower().split("_"))
+
+
+def _inflight_chain(node: ast.AST, params: Set[str]) -> Optional[str]:
+    chain = _chain(node)
+    if chain is None:
+        return None
+    if "." not in chain and chain not in params:
+        return None  # local buffer, not a shared registry
+    if INFLIGHT_WORDS & _words(chain):
+        return chain
+    return None
+
+
+def _open_chain(node: ast.AST) -> Optional[str]:
+    chain = _chain(node)
+    if chain is not None and OPEN_WORDS & _words(chain):
+        return chain
+    return None
+
+
+def _stmt_suspends(stmt: ast.stmt) -> Optional[ast.AST]:
+    """The highest-stakes suspension inside ``stmt`` (nested defs opaque):
+    a ``yield`` wins over an ``await`` when both occur, because the yield
+    is where ``aclose()``/GeneratorExit can end the function for good —
+    the exit path the incident this rule guards actually took."""
+    first_await: Optional[ast.AST] = None
+
+    def walk(node: ast.AST) -> Optional[ast.AST]:
+        nonlocal first_await
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, (ast.Yield, ast.YieldFrom)):
+                return child
+            if isinstance(child, ast.Await) and first_await is None:
+                first_await = child
+            found = walk(child)
+            if found is not None:
+                return found
+        return None
+
+    return walk(stmt) or first_await
+
+
+class _Acquire:
+    __slots__ = ("kind", "chain", "stmt", "block_id", "index", "node")
+
+    def __init__(self, kind: str, chain: str, stmt: ast.stmt,
+                 block_id: int, index: int, node: ast.AST):
+        self.kind = kind
+        self.chain = chain
+        self.stmt = stmt
+        self.block_id = block_id
+        self.index = index
+        self.node = node
+
+
+def _collect(fn: ast.AST, params: Set[str]):
+    """(acquires, releases, blocks) for one function body.
+
+    ``releases`` maps (kind, chain) -> list of (in_finally, block_id,
+    index).  ``blocks`` maps block_id -> the statement list, for the
+    straight-line check.  Releases inside NESTED defs are collected as
+    finally-equivalent: a closure owning the release (``drop_stream``,
+    ``finish_span``) is a delegated-owner contract this per-function
+    analysis accepts — the closure is defined precisely to be called on
+    every exit arm.
+    """
+    acquires: List[_Acquire] = []
+    releases: Dict[Tuple[str, str], List[Tuple[bool, int, int]]] = {}
+    blocks: Dict[int, List[ast.stmt]] = {}
+
+    def shallow_walk(stmt: ast.stmt):
+        """The statement and its expression-level descendants — nested
+        statement lists (a compound's body/orelse/finalbody/handlers) are
+        visited by walk_body's own recursion, so scanning them here would
+        double-report one acquire at two nesting levels."""
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for fname, value in ast.iter_fields(node):
+                if fname in ("body", "orelse", "finalbody", "handlers") \
+                        and isinstance(node, ast.stmt) \
+                        and not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if isinstance(value, ast.AST):
+                    stack.append(value)
+                elif isinstance(value, list):
+                    stack.extend(v for v in value if isinstance(v, ast.AST))
+
+    def stmt_releases(stmt: ast.stmt) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        for sub in shallow_walk(stmt):
+            if isinstance(sub, ast.Call):
+                name = call_name(sub)
+                if name == "close" and isinstance(sub.func, ast.Attribute):
+                    chain = _open_chain(sub.func.value)
+                    if chain is not None:
+                        out.append(("open", chain))
+                elif name in ("pop", "clear") and isinstance(
+                    sub.func, ast.Attribute
+                ):
+                    chain = _inflight_chain(sub.func.value, params)
+                    if chain is not None:
+                        out.append(("inflight", chain))
+                elif name == SPAN_EMIT:
+                    for kw in sub.keywords:
+                        if kw.arg == "span_id":
+                            for ref in ast.walk(kw.value):
+                                c = _chain(ref)
+                                if c is not None:
+                                    out.append(("span", c))
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    tgt = t.value if isinstance(t, ast.Subscript) else t
+                    chain = _inflight_chain(tgt, params)
+                    if chain is not None:
+                        out.append(("inflight", chain))
+        return out
+
+    def stmt_acquires(stmt: ast.stmt) -> List[Tuple[str, str, ast.AST]]:
+        out: List[Tuple[str, str, ast.AST]] = []
+        for sub in shallow_walk(stmt):
+            if isinstance(sub, ast.Call) and call_name(sub) == "open" \
+                    and isinstance(sub.func, ast.Attribute):
+                chain = _open_chain(sub.func.value)
+                if chain is not None:
+                    out.append(("open", chain, sub))
+            elif isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                value = getattr(sub, "value", None)
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        chain = _inflight_chain(t.value, params)
+                        if chain is not None:
+                            out.append(("inflight", chain, sub))
+                if (
+                    isinstance(value, ast.Call)
+                    and call_name(value) == SPAN_ALLOC
+                ):
+                    for t in targets:
+                        chain = _chain(t)
+                        if chain is not None:
+                            out.append(("span", chain, sub))
+                elif (
+                    # ``sid = span_id or new_span_id()`` and friends: any
+                    # RHS that CALLS the allocator binds a span identity.
+                    value is not None
+                    and any(
+                        isinstance(s, ast.Call) and call_name(s) == SPAN_ALLOC
+                        for s in ast.walk(value)
+                    )
+                ):
+                    for t in targets:
+                        chain = _chain(t)
+                        if chain is not None:
+                            out.append(("span", chain, sub))
+        return out
+
+    def walk_body(body: List[ast.stmt], in_finally: bool) -> None:
+        bid = id(body)
+        blocks[bid] = body
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Delegated-owner closures: their releases satisfy any
+                # acquire (finally-equivalent) but their acquires are
+                # their own scope's problem.
+                for sub_stmt in ast.walk(stmt):
+                    if isinstance(sub_stmt, ast.stmt) and sub_stmt is not stmt:
+                        for kind, chain in stmt_releases(sub_stmt):
+                            releases.setdefault((kind, chain), []).append(
+                                (True, bid, i)
+                            )
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            for kind, chain, node in stmt_acquires(stmt):
+                acquires.append(_Acquire(kind, chain, stmt, bid, i, node))
+            for kind, chain in stmt_releases(stmt):
+                releases.setdefault((kind, chain), []).append(
+                    (in_finally, bid, i)
+                )
+            for field, value in ast.iter_fields(stmt):
+                if field == "finalbody":
+                    walk_body(value, True)
+                elif isinstance(value, list) and value and isinstance(
+                    value[0], ast.stmt
+                ):
+                    walk_body(value, in_finally)
+                elif field == "handlers":
+                    for h in value:
+                        walk_body(h.body, in_finally)
+
+    walk_body(list(fn.body), False)
+    return acquires, releases, blocks
+
+
+#: Human names for messages.
+KIND_LABEL = {
+    "open": "acquired resource",
+    "inflight": "registered in-flight entry",
+    "span": "opened span identity",
+}
+KIND_RELEASE = {
+    "open": ".close()",
+    "inflight": ".pop()/del",
+    "span": "add_span(span_id=...)",
+}
+
+
+def check_tc15(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    if not _in_scope(sf):
+        return iter(())
+    out: List[Violation] = []
+
+    for fn, _cls in iter_functions(sf.tree):
+        acquires, releases, blocks = _collect(fn, param_names(fn))
+        if not acquires:
+            continue
+        for acq in acquires:
+            rels = releases.get((acq.kind, acq.chain), [])
+            # Same-statement self-release (``q = R.pop() ... R[k] = q`` in
+            # one expression) can't pair an acquire with itself.
+            rels = [
+                r for r in rels
+                if not (r[1] == acq.block_id and r[2] == acq.index
+                        and acq.kind != "span")
+            ]
+            if any(in_fin for in_fin, _, _ in rels):
+                continue  # finally runs on every exit path, aclose included
+            # Straight-line: a later release in the same block with no
+            # suspension/return/raise between.
+            body = blocks[acq.block_id]
+            satisfied = False
+            first_suspend: Optional[ast.AST] = None
+            for in_fin, bid, idx in rels:
+                if bid != acq.block_id or idx <= acq.index:
+                    continue
+                clean = True
+                for between in body[acq.index + 1: idx]:
+                    if isinstance(between, (ast.Return, ast.Raise)):
+                        clean = False
+                        break
+                    s = _stmt_suspends(between)
+                    if s is not None:
+                        first_suspend = first_suspend or s
+                        clean = False
+                        break
+                if clean:
+                    satisfied = True
+                    break
+            if satisfied:
+                continue
+            if first_suspend is None:
+                # Note the first suspension after the acquire, for the
+                # message (the path the release never covers).
+                for later in body[acq.index + 1:]:
+                    s = _stmt_suspends(later)
+                    if s is not None:
+                        first_suspend = s
+                        break
+            detail = f"no matching {KIND_RELEASE[acq.kind]} found"
+            if rels:
+                detail = (
+                    f"the matching {KIND_RELEASE[acq.kind]} is not in a "
+                    "`finally` and not straight-line after the acquire"
+                )
+            if first_suspend is not None:
+                what = ("yield" if isinstance(
+                    first_suspend, (ast.Yield, ast.YieldFrom)
+                ) else "await")
+                extra = (
+                    " — a generator closed at that yield (aclose()/"
+                    "GeneratorExit) never reaches the release"
+                    if what == "yield" else
+                    " — a cancellation or exception at that await "
+                    "skips the release"
+                )
+                detail += (
+                    f"; the {what} at line {first_suspend.lineno} can exit "
+                    f"first{extra}"
+                )
+            out.append(Violation(
+                "TC15",
+                sf.path,
+                acq.stmt.lineno,
+                f"{KIND_LABEL[acq.kind]} `{acq.chain}` is not released on "
+                f"every exit path: {detail} (the finish-after-final-yield "
+                "span-leak class) — move the release into a `finally`, or "
+                "waive naming the releasing owner",
+                end_line=getattr(acq.stmt, "end_lineno", None),
+            ))
+    return iter(out)

@@ -1,0 +1,215 @@
+"""TC14: client-controlled bytes must pass a registered sanitizer before
+reaching a trusted sink.
+
+The PR 7 incident made permanent: before the tenant-identity hardening,
+the raw ``x-tunnel-tenant`` header value — client-chosen bytes — flowed
+verbatim into the scheduler's fair-admission identity and the per-tenant
+metric labels.  A client could mint a fresh identity per request (defeating
+its own fair-share cap and diluting everyone else's), bloat the accounting
+key space with unbounded label values, and put arbitrary bytes into the
+Prometheus exposition.  The fix routed every ingress through
+:func:`parse_tenant` (strip, cap at MAX_TENANT_LEN, fingerprint
+credentials); this rule makes "every ingress" statically checkable.
+
+Built on the substrate's taint lattice (:mod:`tools.tunnelcheck.dataflow`):
+**sources** are client-controlled request data — ``*.headers`` attribute
+loads and parameters named ``headers``/``body`` in the package scope;
+taint propagates through local assignments, iteration
+(``for k, v in headers.items()``), and ordinary calls (a helper fed
+tainted bytes returns tainted bytes).  **Sanitizers** launder by
+definition: a call to a registered name (``parse_tenant``,
+``tenant_fingerprint``, ``prom_label_escape``, the typed parsers, numeric
+coercions) yields a clean value whatever it read.  **Sinks** are the
+trusted surfaces the incidents hit:
+
+- scheduler identity (``tenant=`` keywords, ``kwargs["tenant"] = ...``,
+  the per-tenant accounting calls);
+- labeled-metrics values (``set_labeled_gauge``'s label value);
+- log interpolation (a tainted value INSIDE the format string — f-string,
+  ``%``-formatting, ``.format`` — or a tainted format string itself;
+  lazy ``log.info("x %s", v)`` args are exempt: stdlib logging formats
+  those without interpreting the value);
+- filesystem paths (``open``/``Path``/``os.remove``-class calls);
+- relay targets (a ``to=`` keyword or ``{"to": ...}`` payload key — the
+  signaling fan-out must never route on unsanitized bytes).
+
+Extending the registries is the intended workflow: a new ingress parser
+gets added to SANITIZERS, a new trusted surface to the sink tables, and
+the self-run keeps both honest (README "Static analysis & invariants").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+from tools.tunnelcheck.dataflow import (
+    call_name,
+    expr_tainted,
+    iter_functions,
+    param_names,
+    taint_locals,
+)
+
+SCOPE_PART = "p2p_llm_tunnel_tpu/"
+
+#: Parameter names seeded as tainted in every scoped function: request
+#: headers and raw request bodies are client bytes wherever they travel.
+TAINTED_PARAMS = frozenset({"headers", "body"})
+
+#: Registered sanitizers: their RESULT is clean regardless of input.
+#: strip/cap/validate live behind these names — inline ``.strip()[:64]``
+#: chains deliberately do NOT launder (the pre-PR-7 code had partial
+#: inline hygiene and still minted identities; centralizing is the point).
+SANITIZERS = frozenset({
+    "parse_tenant",
+    "tenant_fingerprint",
+    "prom_label_escape",
+    "parse_deadline_ms",
+    "parse_trace_context",
+    "int",
+    "float",
+    "bool",
+    "len",
+})
+
+#: Per-tenant accounting entry points: their first argument is the
+#: scheduler/registry identity.
+TENANT_SINK_CALLS = frozenset({
+    "tenant_begin", "tenant_end", "tenant_shed", "tenant_tokens",
+    "charge_tokens",
+})
+
+LOG_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical",
+})
+LOG_RECEIVER_WORDS = frozenset({"log", "logger", "logging"})
+
+FS_CALLS = frozenset({
+    "open", "Path", "remove", "unlink", "makedirs", "rmtree", "mkdir",
+})
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    return SCOPE_PART in sf.path.as_posix()
+
+
+def _is_source(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "headers"
+        and isinstance(expr.ctx, ast.Load)
+    )
+
+
+def _log_receiver(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr in LOG_METHODS):
+        return False
+    recv = node.func.value
+    name = recv.attr if isinstance(recv, ast.Attribute) else (
+        recv.id if isinstance(recv, ast.Name) else ""
+    )
+    return bool(LOG_RECEIVER_WORDS & set(name.lower().split("_")))
+
+
+def check_tc14(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    if not _in_scope(sf):
+        return iter(())
+    out: List[Violation] = []
+    reported: Set = set()
+
+    def report(node: ast.AST, sink: str, hint: str) -> None:
+        key = (node.lineno, sink)
+        if key in reported:
+            return
+        reported.add(key)
+        out.append(Violation(
+            "TC14",
+            sf.path,
+            node.lineno,
+            f"client-controlled bytes reach {sink} without a registered "
+            f"sanitizer ({hint}) — the x-tunnel-tenant minting hole class: "
+            "route through parse_tenant/tenant_fingerprint/"
+            "prom_label_escape (or register the new parser in "
+            "rules_taint.SANITIZERS), or waive naming why these bytes "
+            "are trusted",
+            end_line=getattr(node, "end_lineno", None),
+        ))
+
+    for fn, _cls in iter_functions(sf.tree):
+        seed = param_names(fn) & TAINTED_PARAMS
+        tainted = taint_locals(fn, _is_source, SANITIZERS, seed=seed)
+
+        def dirty(expr: Optional[ast.AST]) -> bool:
+            return expr is not None and expr_tainted(
+                expr, tainted, _is_source, SANITIZERS
+            )
+
+        for node in ast.walk(fn):
+            # kwargs["tenant"] = <tainted> — the scheduler-identity store.
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value == "tenant"
+                        and dirty(node.value)
+                    ):
+                        report(node, "the scheduler tenant identity",
+                               "parse_tenant")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # tenant= keyword anywhere: fair admission keys on it.
+            for kw in node.keywords:
+                if kw.arg == "tenant" and dirty(kw.value):
+                    report(node, "the scheduler tenant identity",
+                           "parse_tenant")
+                if kw.arg == "to" and dirty(kw.value):
+                    report(node, "a relay `to=` target", "validate the peer id")
+            if name in TENANT_SINK_CALLS and node.args and dirty(node.args[0]):
+                report(node, f"per-tenant accounting (`{name}`)",
+                       "parse_tenant")
+            elif name == "set_labeled_gauge" and len(node.args) >= 3 \
+                    and dirty(node.args[2]):
+                report(node, "a labeled-metrics value",
+                       "prom_label_escape / the bounded registry")
+            elif name in FS_CALLS and node.args and dirty(node.args[0]):
+                report(node, f"a filesystem path (`{name}`)",
+                       "never derive paths from request bytes")
+            elif _log_receiver(node) and node.args:
+                fmt = node.args[0]
+                interpolated = dirty(fmt) if isinstance(
+                    fmt, (ast.JoinedStr, ast.BinOp)
+                ) else False
+                if isinstance(fmt, ast.Call) and call_name(fmt) == "format":
+                    interpolated = (
+                        any(dirty(a) for a in fmt.args)
+                        or any(dirty(kw.value) for kw in fmt.keywords)
+                        or dirty(
+                            fmt.func.value
+                            if isinstance(fmt.func, ast.Attribute) else None
+                        )
+                    )
+                if not interpolated and not isinstance(
+                    fmt, (ast.Constant, ast.JoinedStr, ast.BinOp, ast.Call)
+                ):
+                    interpolated = dirty(fmt)  # tainted format string itself
+                if interpolated:
+                    report(node, "log interpolation",
+                           "use lazy %s args, which never interpret the value")
+            # {"to": <tainted>} inside any call payload (signaling sends).
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Dict):
+                    for k, v in zip(a.keys, a.values):
+                        if (
+                            isinstance(k, ast.Constant) and k.value == "to"
+                            and dirty(v)
+                        ):
+                            report(node, "a relay `to=` target",
+                                   "validate the peer id")
+    return iter(out)

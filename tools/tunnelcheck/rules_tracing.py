@@ -31,7 +31,7 @@ SPAN_EMIT_METHODS = {"add_span", "add_event"}
 def check_tc09(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
     catalogue = ctx.span_names
     traced_ids = {}
-    for fn, _statics in _traced_functions(sf):
+    for fn, _statics in _traced_functions(sf, ctx):
         name = getattr(fn, "name", "<lambda>")
         for sub in ast.walk(fn):
             traced_ids.setdefault(id(sub), name)

@@ -1,0 +1,119 @@
+"""SARIF 2.1.0 export: machine-consumable findings (`--sarif out.json`).
+
+One run object, one tool driver (``tunnelcheck``), one result per
+violation.  Waived findings are included as suppressed results
+(``suppressions: [{kind: "inSource"}]`` — the waiver comment IS the
+in-source suppression), so a SARIF consumer can audit what the waivers
+hide exactly like ``--show-waived`` does on the CLI.
+
+The shape follows the published 2.1.0 schema
+(https://json.schemastore.org/sarif-2.1.0.json): ``version`` and
+``$schema`` at the top, ``runs[].tool.driver.rules`` carrying one
+reportingDescriptor per rule id (``results[].ruleIndex`` points into it),
+and physical locations with repo-relative URIs under a ``SRCROOT``
+uriBaseId.  ``tests/test_tunnelcheck.py`` pins this shape — a field
+rename here fails fast instead of silently breaking downstream ingestion.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from tools.tunnelcheck.core import RULE_SUMMARIES, Violation
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _uri(path: Path, root: Optional[Path]) -> str:
+    p = path
+    if root is not None:
+        try:
+            p = path.resolve().relative_to(root.resolve())
+        except ValueError:
+            pass
+    return p.as_posix()
+
+
+def to_sarif(
+    active: Sequence[Violation],
+    waived: Sequence[Violation] = (),
+    root: Optional[Path] = None,
+) -> Dict:
+    """The SARIF log dict for one run (serialize with :func:`write_sarif`)."""
+    rule_ids = sorted(RULE_SUMMARIES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+
+    def result(v: Violation, suppressed: bool) -> Dict:
+        region: Dict = {"startLine": max(1, v.line)}
+        if v.end_line is not None and v.end_line >= v.line:
+            region["endLine"] = v.end_line
+        out: Dict = {
+            "ruleId": v.rule,
+            "ruleIndex": rule_index.get(v.rule, -1),
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": _uri(v.path, root),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": region,
+                },
+            }],
+        }
+        if suppressed:
+            out["suppressions"] = [{
+                "kind": "inSource",
+                "justification": "tunnelcheck: disable waiver comment",
+            }]
+        return out
+
+    results: List[Dict] = [result(v, False) for v in active]
+    results += [result(v, True) for v in waived]
+
+    log: Dict = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tunnelcheck",
+                    "informationUri":
+                        "README.md#static-analysis--invariants",
+                    "rules": [
+                        {
+                            "id": rid,
+                            "shortDescription": {
+                                "text": RULE_SUMMARIES[rid]
+                            },
+                        }
+                        for rid in rule_ids
+                    ],
+                },
+            },
+            "columnKind": "unicodeCodePoints",
+            "results": results,
+        }],
+    }
+    if root is not None:
+        log["runs"][0]["originalUriBaseIds"] = {
+            "SRCROOT": {"uri": root.resolve().as_uri() + "/"}
+        }
+    return log
+
+
+def write_sarif(
+    path: Path,
+    active: Sequence[Violation],
+    waived: Sequence[Violation] = (),
+    root: Optional[Path] = None,
+) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        json.dumps(to_sarif(active, waived, root=root), indent=2) + "\n",
+        encoding="utf-8",
+    )
