@@ -92,6 +92,17 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# identical across two seeded runs (asserted INSIDE the tests).
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_fleet.py -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_fleet.py -q
+	@# ISSUE 12 matrix row: a seeded watchdog incident must yield a
+	@# postmortem black-box bundle IDENTICAL across two runs (waived
+	@# wall-clock fields excluded; asserted INSIDE the test), with the
+	@# captured bundles archived under artifacts/postmortem (gitignored)
+	@# for the round's operator record.
+	@mkdir -p artifacts/postmortem
+	CHAOS_TEST_SEED=5  TUNNEL_POSTMORTEM_DIR=artifacts/postmortem \
+		python -m pytest tests/test_flight.py -q
+	CHAOS_TEST_SEED=19 TUNNEL_POSTMORTEM_DIR=artifacts/postmortem \
+		python -m pytest tests/test_flight.py -k postmortem -q
+	@echo "postmortem bundles archived:"; ls -1 artifacts/postmortem 2>/dev/null || true
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

@@ -89,6 +89,7 @@ RESULT_ROW_KEYS = (
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
+    "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",
     "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
     "wall_s",
 )
@@ -323,6 +324,18 @@ async def _run_attempt(model: str) -> dict:
     t0 = time.monotonic()
     await engine.warmup()
     _log(f"decode warmup (view x steps compiles) took {time.monotonic() - t0:.1f}s")
+    # Cold-start breakdown (ISSUE 12): captured NOW — the post-warmup
+    # global_metrics.reset() below wipes the gauges, and cold start
+    # (BENCH_r03: 207 s to first token) deserves trend datapoints of its
+    # own: total wall, program count, and the slowest single program (the
+    # indivisible floor a chip window must fit).
+    warmup_compile_s = round(
+        global_metrics.gauge("engine_warmup_compile_s"), 2
+    )
+    warmup_programs = int(global_metrics.gauge("engine_warmup_programs"))
+    warmup_compile_max_s = round(
+        global_metrics.gauge("engine_warmup_compile_max_s"), 2
+    )
 
     serve_ch, proxy_ch = loopback_pair()
     serve_task = asyncio.create_task(
@@ -497,6 +510,11 @@ async def _run_attempt(model: str) -> dict:
         "prefix_dedup_hits": global_metrics.counter(
             "engine_prefix_dedup_hits_total"
         ),
+        # Cold-start breakdown (ISSUE 12): captured before the
+        # post-warmup metrics reset above.
+        "warmup_compile_s": warmup_compile_s,
+        "warmup_programs": warmup_programs,
+        "warmup_compile_max_s": warmup_compile_max_s,
         "clients": clients,
         "engine_tok_s": round(engine_tokens / wall, 2) if wall > 0 else 0.0,
         "engine_tokens": engine_tokens,

@@ -152,6 +152,23 @@ def build_parser() -> argparse.ArgumentParser:
                        help="admission control at the tunnel layer: max "
                             "concurrently-dispatched requests before 429 "
                             "(0 = unbounded)")
+    serve.add_argument("--drain-timeout", type=float,
+                       default=float(_env("TUNNEL_DRAIN_TIMEOUT", "0")),
+                       help="seconds a SIGTERM drain waits for in-flight "
+                            "streams before abandoning them; past it a "
+                            "postmortem bundle is captured (trigger "
+                            "'drain') and the tunnel closes anyway "
+                            "(0 = wait forever, the historical behavior; "
+                            "env TUNNEL_DRAIN_TIMEOUT)")
+    serve.add_argument("--postmortem-dir",
+                       default=_env("TUNNEL_POSTMORTEM_DIR",
+                                    "artifacts/postmortem"),
+                       help="directory postmortem black-box bundles are "
+                            "archived into on a watchdog trip, SLO "
+                            "breach, drain timeout, or engine crash "
+                            "(also served at GET /healthz?postmortem=1; "
+                            "empty string disables archiving; env "
+                            "TUNNEL_POSTMORTEM_DIR)")
     serve.add_argument("--watchdog-budget", type=float,
                        default=float(_env("TUNNEL_WATCHDOG_BUDGET", "60")),
                        help="decode-stall watchdog: mark the engine "
@@ -466,7 +483,8 @@ async def _serve_once(args, drain: "Optional[asyncio.Event]" = None) -> None:
     )
     try:
         kwargs = dict(
-            max_inflight=getattr(args, "max_inflight", 0), drain=drain
+            max_inflight=getattr(args, "max_inflight", 0), drain=drain,
+            drain_timeout=getattr(args, "drain_timeout", 0.0),
         )
         if backend is not None:
             await run_serve(channel, backend=backend, **kwargs)
@@ -725,11 +743,16 @@ async def _amain(args) -> None:
             "/healthz?trace=1)", args.trace_buffer, args.trace_sample,
         )
     if args.command == "serve":
+        from p2p_llm_tunnel_tpu.utils.flight import global_blackbox
         from p2p_llm_tunnel_tpu.utils.slo import (
             default_objectives,
             global_slo,
         )
 
+        # Postmortem black box (ISSUE 12): where bundles archive on a
+        # watchdog trip / SLO breach / drain timeout / engine crash.  The
+        # in-memory ring serves GET /healthz?postmortem=1 either way.
+        global_blackbox.configure(directory=args.postmortem_dir or "")
         global_slo.configure(
             enabled=args.slo,
             objectives=default_objectives(

@@ -217,6 +217,41 @@ async def _fleet_trace_response(state: ProxyState) -> HttpResponse:
     )
 
 
+async def _fleet_postmortem_response(state: ProxyState) -> HttpResponse:
+    """GET /healthz?postmortem=1&fleet=1 (ISSUE 12): every peer's latest
+    postmortem bundle pulled concurrently over the tunnel via the same
+    bounded PeerSet.fetch machinery as the metric scrapes — a dead or
+    wedged peer yields a null entry in ``stale``, never a hang.  The
+    proxy's OWN black box rides along as the ``proxy`` entry (a drain
+    timeout in this process captures here)."""
+    import json as _json
+
+    from p2p_llm_tunnel_tpu.utils.flight import global_blackbox
+
+    scrapes = await state.scrape_fleet("/healthz?postmortem=1")
+    peers: Dict[str, Optional[dict]] = {
+        "proxy": global_blackbox.section()
+    }
+    stale = []
+    for pid, body in scrapes.items():
+        if body is None:
+            peers[pid] = None
+            stale.append(pid)
+            continue
+        try:
+            obj = _json.loads(body)
+            peers[pid] = obj if isinstance(obj, dict) else None
+        except ValueError:
+            peers[pid] = None
+            stale.append(pid)
+    return HttpResponse(
+        200, {"content-type": "application/json"},
+        _json.dumps(
+            {"peers": peers, "stale": sorted(stale)}, default=str
+        ).encode(),
+    )
+
+
 async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
     """One HTTP request through the tunnel (proxy.rs:249-426), with
     health-routed dispatch and transparent failover across the PeerSet."""
@@ -241,6 +276,11 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         flags = route[1]
         if {"trace=1", "fleet=1"} <= flags:
             return await _fleet_trace_response(state)
+        if {"postmortem=1", "fleet=1"} <= flags:
+            # Bare ?postmortem=1 tunnels through to the serve peer's own
+            # black box like bare /healthz; with fleet=1 the proxy
+            # federates every peer's bundle (ISSUE 12).
+            return await _fleet_postmortem_response(state)
         if {"trace=1", "local=1"} <= flags:
             # GET /healthz?trace=1&local=1: THIS process's span journal —
             # in the two-process topology the proxy's ingress spans

@@ -40,6 +40,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     parse_deadline_ms,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
+from p2p_llm_tunnel_tpu.utils.flight import global_blackbox, global_flight
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 from p2p_llm_tunnel_tpu.utils.metrics import (
     Metrics,
@@ -492,8 +493,21 @@ async def _send_healthz(
     degraded = (global_metrics.gauge("engine_degraded") > 0
                 or bool(slo_section["alerting"]))
     state = "draining" if draining else ("degraded" if degraded else "ok")
+    # WHY the peer is not-ok (ISSUE 12 satellite): the fabric routes
+    # around degraded peers, and without a reason the routing decision is
+    # unexplainable from the proxy.  Priority order mirrors the status
+    # computation: a drain beats a watchdog trip beats an SLO burn.
+    if draining:
+        reason = "drain"
+    elif global_metrics.gauge("engine_degraded") > 0:
+        reason = "watchdog"
+    elif slo_section["alerting"]:
+        reason = "slo"
+    else:
+        reason = None
     payload = {
         "status": state,
+        "engine_degraded_reason": reason,
         # The fabric identity this peer learned at handshake ("" when
         # joined untagged): lets an operator match a tunneled /healthz
         # answer to the proxy's per-peer fabric snapshot.
@@ -581,6 +595,7 @@ async def run_serve(
     backend: Optional[Backend] = None,
     max_inflight: int = 0,
     drain: Optional[asyncio.Event] = None,
+    drain_timeout: float = 0.0,
 ) -> None:
     """Run the provider side until the tunnel dies; raises to trigger retry.
 
@@ -592,6 +607,13 @@ async def run_serve(
     request is admitted (503 ``draining``), in-flight responses run to
     completion, then the channel closes and run_serve RETURNS cleanly
     instead of raising — the supervisor sees a clean exit, not a retry.
+
+    ``drain_timeout`` (> 0) bounds how long a drain waits for in-flight
+    streams: past it the still-unfinished streams are abandoned, a
+    postmortem bundle captures WHY the drain could not complete (trigger
+    ``drain`` — a stream that never finishes during shutdown is exactly
+    the wedge an operator needs the black box for), and the channel
+    closes anyway.  0 keeps the historical wait-forever behavior.
     """
     if backend is None:
         backend = http_backend(upstream_url, advertise_prefix)
@@ -640,14 +662,36 @@ async def run_serve(
     async def drainer() -> None:
         """Wait for the drain signal, let in-flight streams finish, then
         close the channel — which pops the recv loop with ChannelClosed
-        and turns into a CLEAN return below."""
+        and turns into a CLEAN return below.  With ``drain_timeout`` set,
+        a drain that cannot finish captures a postmortem and closes
+        anyway (ISSUE 12)."""
         await drain.wait()
         log.info(
             "drain: stopped admitting; %d request(s) in flight",
             len(request_tasks),
         )
+        deadline = (time.monotonic() + drain_timeout
+                    if drain_timeout > 0 else None)
         while request_tasks:
-            await asyncio.wait(set(request_tasks))
+            timeout = None
+            if deadline is not None:
+                timeout = max(0.01, deadline - time.monotonic())
+            await asyncio.wait(set(request_tasks), timeout=timeout)
+            if (request_tasks and deadline is not None
+                    and time.monotonic() >= deadline):
+                log.error(
+                    "drain timeout: %d stream(s) still unfinished after "
+                    "%.1fs; capturing postmortem and closing anyway",
+                    len(request_tasks), drain_timeout,
+                )
+                global_blackbox.capture(
+                    "drain",
+                    attribution=(
+                        f"{len(request_tasks)} stream(s) unfinished "
+                        f"after {drain_timeout:.1f}s drain budget"
+                    ),
+                )
+                break
         log.info("drain complete, closing tunnel")
         channel.close()
 
@@ -738,10 +782,32 @@ async def _serve_dispatch(
                 if "trace=1" in route[1]:
                     # The span journal as Chrome trace-event JSON — load
                     # in chrome://tracing / Perfetto, or summarize with
-                    # scripts/traceview.py.
+                    # scripts/traceview.py.  The engine flight recorder's
+                    # slice/counter tracks ride the same export (ISSUE
+                    # 12): one journal, so the fleet stitcher gives every
+                    # peer its own engine-flight lane for free.
+                    trace = global_tracer.chrome_trace()
+                    trace["traceEvents"] = (
+                        list(trace["traceEvents"])
+                        + global_flight.chrome_events()
+                    )
                     await _send_simple(
                         channel, req.stream_id, 200,
-                        json.dumps(global_tracer.chrome_trace()).encode(),
+                        json.dumps(trace).encode(),
+                        {"content-type": "application/json"},
+                    )
+                    return
+                if "postmortem=1" in route[1]:
+                    # The postmortem black box (ISSUE 12): the most recent
+                    # schema-versioned bundle (null when nothing has
+                    # triggered), plus the capture count and archive
+                    # paths.  Federated per-peer via the proxy's
+                    # ?postmortem=1&fleet=1.
+                    await _send_simple(
+                        channel, req.stream_id, 200,
+                        json.dumps(
+                            global_blackbox.section(), default=str
+                        ).encode(),
                         {"content-type": "application/json"},
                     )
                     return

@@ -41,6 +41,11 @@ from p2p_llm_tunnel_tpu.models.transformer import (
     init_params,
     prefill_into_cache,
 )
+from p2p_llm_tunnel_tpu.utils.flight import (
+    global_blackbox,
+    global_compile_watch,
+    global_flight,
+)
 from p2p_llm_tunnel_tpu.utils.logging import get_logger
 from p2p_llm_tunnel_tpu.utils.metrics import (
     derived_retry_after_s,
@@ -70,6 +75,13 @@ _TIMED_OUT = object()
 #: TenantOverLimit so the response layer emits the typed
 #: ``tenant_overlimit`` error instead of a silently truncated stream.
 _SHED = object()
+
+
+def _program_key(kind: str, shape: Tuple[int, ...]) -> str:
+    """Canonical compiled-program key: ``kind[dim,dim,...]`` — the ONE
+    spelling shared by the AOT phase, the serial warmup pass, and the
+    mid-serve cold-compile check, so readiness bookkeeping cannot split."""
+    return f"{kind}[{','.join(str(s) for s in shape)}]"
 
 
 class DeadlineExceeded(Exception):
@@ -607,6 +619,29 @@ class InferenceEngine:
         self._last_progress = time.monotonic()
         self._watchdog_task: Optional[asyncio.Task] = None
         self.degraded = False
+        # Compile/cold-start profiler (ISSUE 12): the program keys this
+        # process has compiled (decode/prefill/chunk/spec grid), the keys
+        # the parallel AOT phase compiled (the serial pass's cache-hit
+        # evidence), and whether warmup declared the grid complete — a
+        # first-seen key AFTER that is a mid-serve cold compile (a hole in
+        # the bucket grid, counted + journaled instead of only failing
+        # test_warmup_aot).
+        self._programs_ready: set = set()
+        self._aot_keys: set = set()
+        self._warmup_done = False
+        # Flight-recorder scratch (ISSUE 12): per-iteration observations
+        # stashed by the methods that own them (executor-thread dispatchers
+        # and the admission path) and read once per iteration by the loop's
+        # record.  Plain assignments only — no read-modify-write straddles
+        # an await (TC13).
+        self._last_mux: Dict[str, object] = {}
+        self._flight_admitted = 0
+        self._last_burst: Tuple[int, int] = (0, 0)
+        # Postmortem black box: this engine contributes the config +
+        # scheduler/slot/backlog snapshot to captured bundles (latest
+        # engine wins — one serving engine per process is the deployed
+        # shape).
+        global_blackbox.set_engine_provider(self._blackbox_state)
         # Dedicated single thread for blocking XLA calls: sharing the default
         # executor starves decode when other components run blocking work.
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -901,13 +936,26 @@ class InferenceEngine:
             stalled = time.monotonic() - self._last_progress > budget
             if busy and stalled:
                 if not self.degraded:
+                    # Attribution (ISSUE 12): the flight recorder's phase
+                    # marker names the loop phase the stall is wedged in —
+                    # a stuck XLA dispatch leaves it at "decode_dispatch",
+                    # a fetch hang at "decode_fetch" — so the degraded
+                    # verdict says WHERE, not just THAT.
+                    phase = global_flight.current_phase()
                     log.error(
                         "decode-stall watchdog: no token accounted in "
                         "%.1fs with %d request(s) in flight; marking "
-                        "engine degraded", budget, len(self._requests),
+                        "engine degraded (stalled in loop phase %r)",
+                        budget, len(self._requests), phase,
                     )
                     global_metrics.inc("engine_watchdog_stalls_total")
-                self.degraded = True
+                    self.degraded = True
+                    global_metrics.set_gauge("engine_degraded", 1.0)
+                    # Postmortem black box: snapshot the engine AT the
+                    # trip, not minutes later — runs on this task because
+                    # the loop itself is what is stuck (capture never
+                    # raises past its own logging).
+                    global_blackbox.capture("watchdog", attribution=phase)
             elif self.degraded and not stalled:
                 log.info("decode-stall watchdog: progress resumed")
                 self.degraded = False
@@ -988,6 +1036,7 @@ class InferenceEngine:
         if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
             steps.add(self.ecfg.decode_steps_eager)
         t_warm0 = time.monotonic()
+        compile_mark = global_compile_watch.mark()
         await self._warm_aot_parallel(loop, views, sorted(steps))
         t0 = time.monotonic()
         self._warming = True
@@ -1044,6 +1093,21 @@ class InferenceEngine:
         global_metrics.set_gauge(
             "engine_warmup_compile_s", time.monotonic() - t_warm0
         )
+        # Cold-start breakdown (ISSUE 12): the per-program grid this
+        # warmup compiled/loaded — count + slowest single program next to
+        # the wall total, published as gauges (and recorded in the
+        # bench-smoke row).  From here on a first-seen program key on the
+        # serving path is a mid-serve cold compile.
+        warm_events = global_compile_watch.since(compile_mark)
+        global_metrics.set_gauge(
+            "engine_warmup_programs",
+            len({e["key"] for e in warm_events}),
+        )
+        global_metrics.set_gauge(
+            "engine_warmup_compile_max_s",
+            max((e["seconds"] for e in warm_events), default=0.0),
+        )
+        self._warmup_done = True
         await loop.run_in_executor(self._executor, self._set_kernel_gauge)
 
     def decode_launch_report(self, view: Optional[int] = None,
@@ -1099,6 +1163,63 @@ class InferenceEngine:
             report["layer_body_major"], report["layer_body_ops"],
             report["layer_body_pallas"],
         )
+
+    def _note_program(self, kind: str, shape: Tuple[int, ...],
+                      seconds: float) -> None:
+        """Compile/cold-start profiler (ISSUE 12; any thread): account the
+        FIRST execution of program ``(kind, shape)`` in this process.
+
+        During warmup the event lands in the journal as the per-program
+        cold-start breakdown (``cache_hit`` when the parallel AOT phase
+        already compiled the key, so the serial pass only loaded it).
+        After :meth:`warmup` declared the grid complete, a first-seen key
+        is a MID-SERVE COLD COMPILE — a hole in the warmup bucket grid
+        (the ``test_warmup_aot`` bug class) — counted, journaled cold, and
+        stamped on the trace timeline.  ``seconds`` is the dispatch wall,
+        which on a first hit is dominated by trace+compile."""
+        key = _program_key(kind, shape)
+        if key in self._programs_ready:
+            return
+        self._programs_ready.add(key)
+        cold = self._warmup_done
+        global_compile_watch.note(
+            program=kind, key=key, shape=list(shape), seconds=seconds,
+            phase="serve" if cold else "warmup",
+            cache_hit=key in self._aot_keys, cold=cold,
+        )
+        if cold:
+            global_metrics.inc("engine_cold_compiles_total")
+            log.warning(
+                "cold compile on the serving path: %s took %.1fs — a hole "
+                "in the warmup bucket grid (see engine_cold_compiles_total)",
+                key, seconds,
+            )
+            global_tracer.add_event(
+                "engine.cold_compile", trace_id=None, track="engine-loop",
+                attrs={"key": key, "seconds": round(seconds, 3)},
+            )
+
+    def _blackbox_state(self) -> dict:
+        """Engine section of a postmortem bundle (ISSUE 12): config +
+        scheduler/slot/backlog state as plain JSON-able values.  Pure host
+        reads — callable even while the loop is wedged in a dispatch,
+        which is exactly when the watchdog captures."""
+        from dataclasses import asdict
+
+        return {
+            "config": asdict(self.ecfg),
+            "model": self.mcfg.name,
+            "scheduler": self.scheduler.snapshot(),
+            "requests_in_flight": len(self._requests),
+            "segmented_slots": sorted(self._segmented),
+            "pending_plain": len(self._pending_plain),
+            "prefix_waiters": len(self._prefix_waiters),
+            "inflight_prefix_keys": len(self._inflight_prefix),
+            "degraded": self.degraded,
+            "crashed": self._crashed,
+            "warmup_done": self._warmup_done,
+            "programs_ready": sorted(self._programs_ready),
+        }
 
     def _warmup_views(self) -> List[int]:
         """View buckets warmup precompiles.  ``TUNNEL_WARMUP_VIEW_CAP=<n>``
@@ -1199,10 +1320,12 @@ class InferenceEngine:
     def _warm_prefill_program(self, width: int) -> None:
         """Execute-warm the plain-prefill program at prompt bucket
         ``width`` against scratch rows (executor thread)."""
+        t0 = time.monotonic()
         first, _lp, self.kv_cache = self._jit_prefill(
             *self._prefill_warm_args(width)
         )
         jax.block_until_ready(first)
+        self._note_program("prefill", (width,), time.monotonic() - t0)
 
     def _prefill_warm_args(self, width: int):
         """Positional args for the plain batched-prefill program at prompt
@@ -1272,11 +1395,14 @@ class InferenceEngine:
             )
             return
         await loop.run_in_executor(self._executor, self._ensure_decode_carry)
-        jobs: List[Tuple[str, object]] = []
+        # (label, program kind, bucket shape, lower-thunk): kind/shape feed
+        # the compile journal (ISSUE 12) — None kind for the copy ops,
+        # which sit outside the bucket-grid readiness contract.
+        jobs: List[Tuple[str, Optional[str], Tuple[int, ...], object]] = []
         for view in views:
             for k in steps:
                 jobs.append((
-                    f"decode[v{view},k{k}]",
+                    f"decode[v{view},k{k}]", "decode", (view, k),
                     lambda view=view, k=k: self._jit_decode.lower(
                         *self._decode_warm_args(view, k)
                     ),
@@ -1284,23 +1410,26 @@ class InferenceEngine:
         if self.ecfg.spec_ngram > 0:
             for view in views:
                 jobs.append((
-                    f"spec[v{view}]",
+                    f"spec[v{view}]", "spec", (view,),
                     lambda view=view: self._jit_spec.lower(
                         *self._spec_warm_args(view)
                     ),
                 ))
         for w in self._warm_prefill_widths():
             jobs.append((
-                f"prefill[w{w}]",
+                f"prefill[w{w}]", "prefill", (w,),
                 lambda w=w: self._jit_prefill.lower(
                     *self._prefill_warm_args(w)
                 ),
             ))
         if self._prefix is not None:
             in_args, out_args = self._copy_warm_args()
-            jobs.append(("copy_in", lambda: self._copy_in.lower(*in_args)))
             jobs.append(
-                ("copy_out", lambda: self._copy_out.lower(*out_args))
+                ("copy_in", None, (), lambda: self._copy_in.lower(*in_args))
+            )
+            jobs.append(
+                ("copy_out", None, (),
+                 lambda: self._copy_out.lower(*out_args))
             )
         # Chunk-prefill programs are keyed by (tail, view) only: when
         # ecfg.prefill_chunk matches a prefix-cache tail bucket, the
@@ -1320,21 +1449,30 @@ class InferenceEngine:
                     chunk_pairs.add((self.ecfg.prefill_chunk, view))
         for t, view in sorted(chunk_pairs):
             jobs.append((
-                f"chunk[t{t},v{view}]",
+                f"chunk[t{t},v{view}]", "chunk", (t, view),
                 lambda t=t, view=view:
                     self._jit_chunk_prefill.lower(
                         *self._chunk_warm_args(t, view)
                     ),
             ))
 
-        def _one(label, thunk):
+        def _one(label, kind, shape, thunk):
             t1 = time.monotonic()
             try:
                 thunk().compile()
-                log.info(
-                    "warmup aot %s compiled in %.1fs",
-                    label, time.monotonic() - t1,
-                )
+                dt = time.monotonic() - t1
+                log.info("warmup aot %s compiled in %.1fs", label, dt)
+                if kind is not None:
+                    # The per-program cold-start breakdown (ISSUE 12): the
+                    # AOT compile carries the real compile seconds; the
+                    # serial pass then records a cache_hit load of the
+                    # same key (it finds it in _aot_keys).
+                    key = _program_key(kind, shape)
+                    self._aot_keys.add(key)
+                    global_compile_watch.note(
+                        program=kind, key=key, shape=list(shape),
+                        seconds=dt, phase="aot",
+                    )
             except Exception as exc:  # best-effort: serial pass is truth
                 log.warning("warmup aot %s failed: %s", label, exc)
 
@@ -1343,7 +1481,10 @@ class InferenceEngine:
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=par, thread_name_prefix="warm-aot"
             ) as pool:
-                futs = [pool.submit(_one, lbl, fn) for lbl, fn in jobs]
+                futs = [
+                    pool.submit(_one, lbl, kind, shape, fn)
+                    for lbl, kind, shape, fn in jobs
+                ]
                 for f in futs:
                     f.result()
             log.info(
@@ -1356,10 +1497,12 @@ class InferenceEngine:
     def _warm_chunk_program(self, t: int, view: int) -> None:
         """Compile the chunk-prefill program at tail width ``t`` and kv-view
         ``view`` against scratch rows (executor thread)."""
+        t0 = time.monotonic()
         first, _lp, self.kv_cache = self._jit_chunk_prefill(
             *self._chunk_warm_args(t, view)
         )
         jax.block_until_ready(first)
+        self._note_program("chunk", (t, view), time.monotonic() - t0)
 
     def _chunk_view_bucket(self, need: int) -> int:
         """Smallest kv-view bucket covering ``need`` cache positions —
@@ -1790,6 +1933,7 @@ class InferenceEngine:
             seed=jnp.asarray(seeds),
             bias_on=jnp.asarray(bias_on),
         )
+        t_jit0 = time.monotonic()
         if echo:
             first, lp, plp, self.kv_cache = self._jit_prefill(
                 self.params,
@@ -1814,6 +1958,8 @@ class InferenceEngine:
                 samp,
                 self._next_key(),
             )
+        self._note_program("prefill_echo" if echo else "prefill", (t,),
+                           time.monotonic() - t_jit0)
         global_metrics.inc("engine_prefill_tokens_total", total)
         out = first, (lp if lps.any() else None), plp
         self._start_host_copy(out)
@@ -1888,6 +2034,7 @@ class InferenceEngine:
         # attention read cost of an admission tracks the live context, not
         # max_seq (VERDICT r4 item 7).
         view = self._chunk_view_bucket(int(starts.max()) + t)
+        t_jit0 = time.monotonic()
         first, lp, self.kv_cache = self._jit_chunk_prefill(
             self.params,
             self.kv_cache,
@@ -1900,6 +2047,7 @@ class InferenceEngine:
             self._next_key(),
             view,
         )
+        self._note_program("chunk", (t, view), time.monotonic() - t_jit0)
         global_metrics.inc("engine_prefill_tokens_total", total)
         out = first, (lp if lps.any() else None), None
         self._start_host_copy(out)
@@ -2014,6 +2162,9 @@ class InferenceEngine:
         ov_mask = self._ov_mask | inactive
         park = self.ecfg.max_seq
         ov_pos = np.where(inactive, park, self._positions)
+        view = self._kv_view_bucket() if view is None else view
+        steps = self._burst_steps() if steps is None else steps
+        t_jit0 = time.monotonic()
         (sampled, lp_out, self._dev_tokens, self._dev_positions,
          self._dev_counts, self.kv_cache) = self._jit_decode(
             self.params,
@@ -2027,8 +2178,15 @@ class InferenceEngine:
             jnp.array(ov_pos),
             samp,
             self._next_key(),
-            self._kv_view_bucket() if view is None else view,
-            self._burst_steps() if steps is None else steps,
+            view,
+            steps,
+        )
+        # First hit of a (view, steps) pair = trace+compile inside that
+        # call wall; after warmup that is a grid hole (ISSUE 12).
+        self._note_program("decode", (view, steps),
+                           time.monotonic() - t_jit0)
+        self._last_burst = (
+            steps, int(np.count_nonzero(active[: self.ecfg.num_slots]))
         )
         self._ov_mask[:] = False  # patch consumed by this dispatch
         # Rows must ALSO have been active at dispatch time to be accounted:
@@ -2280,6 +2438,8 @@ class InferenceEngine:
             seed=jnp.array(self._sample_seed),
             bias_on=jnp.array(self._slot_bias_on & active),
         )
+        view = self._kv_view_bucket() if view is None else view
+        t_jit0 = time.monotonic()
         emitted, counts, self.kv_cache = self._jit_spec(
             self.params,
             self.kv_cache,
@@ -2287,8 +2447,9 @@ class InferenceEngine:
             jnp.array(tokens),
             jnp.array(positions),
             samp,
-            self._kv_view_bucket() if view is None else view,
+            view,
         )
+        self._note_program("spec", (view,), time.monotonic() - t_jit0)
         assign = [
             run.request.request_id
             if run is not None and self._active_mask[i] else None
@@ -2441,6 +2602,7 @@ class InferenceEngine:
         ≈ engine_ttft_ms, ISSUE 5 observability)."""
         now = time.monotonic()
         global_metrics.inc("engine_admissions_total", len(admitted))
+        self._flight_admitted += len(admitted)  # tunnelcheck: disable=TC13  engine-loop task is the only writer: _note_admission runs only from the loop's admission paths, and the loop resets the counter at iteration start before any of them can run
         for run in admitted:
             st = self._requests.get(run.request.request_id)
             if st is not None and st.t_admitted is None:
@@ -2747,13 +2909,22 @@ class InferenceEngine:
             for run, _owner in self._prefix_waiters
             if run.request.deadline is not None
         ]
+        min_slack = min(slacks) if slacks else None
         tokens = self._mux_ctl.budget_tokens(
             queue_depth=self.scheduler.queue_depth,
             backlog_rows=backlog,
             active_rows=active,
-            min_slack_s=min(slacks) if slacks else None,
+            min_slack_s=min_slack,
         )
         global_metrics.set_gauge("engine_mux_budget_tokens", tokens)
+        # Flight-recorder stash (ISSUE 12): the controller's inputs and
+        # verdict for THIS iteration's record (read once by the loop).
+        self._last_mux = {
+            "backlog_rows": backlog,
+            "min_slack_s": (round(min_slack, 3)
+                            if min_slack is not None else None),
+            "budget_tokens": tokens,
+        }
         return tokens // self._mux_ctl.unit
 
     def _dispatch_segments(self, max_rows: Optional[int] = None):
@@ -2887,6 +3058,50 @@ class InferenceEngine:
             # next (keeps SSE pacing smooth within a burst).
             await asyncio.sleep(0)
 
+    def _flight_record(self, it_t0: float, t_admit: float, t_prefill: float,
+                       t_dispatch: float, t_fetch: float, plain_rows: int,
+                       seg_rows: int, cold0: int) -> None:
+        """One flight-recorder row per non-idle loop iteration (ISSUE 12).
+
+        Pure host bookkeeping: reads the scratch the iteration's own
+        methods stashed (_last_mux/_last_burst/_flight_admitted) plus
+        cheap scheduler state — no device traffic, no allocation beyond
+        the record dict, so the ring can stay always-on."""
+        now = time.monotonic()
+        slots = self.scheduler.slots
+        mux = self._last_mux
+        backlog = mux.get("backlog_rows")
+        if backlog is None:
+            # Non-mux iterations: the row-count proxy (no controller ran).
+            backlog = (len(self._segmented) + len(self._pending_plain)
+                       + len(self._prefix_waiters))
+        global_flight.record_iteration(
+            t=it_t0,
+            dur_ms=round((now - it_t0) * 1000.0, 3),
+            queue_depth=self.scheduler.queue_depth,
+            backlog_rows=int(backlog),
+            min_slack_s=mux.get("min_slack_s"),
+            budget_tokens=int(mux.get("budget_tokens", 0) or 0),
+            admitted=self._flight_admitted,
+            prefill_rows=plain_rows + seg_rows,
+            decode_steps=self._last_burst[0],
+            decode_rows=self._last_burst[1],
+            active_slots=sum(1 for s in slots if s is not None),
+            tenants=len({
+                run.request.tenant for run in slots if run is not None
+            }),
+            waiters=len(self._prefix_waiters),
+            prefix_blocks_used=(
+                self._prefix.used_blocks if self._prefix is not None else 0
+            ),
+            cold_compiles=global_compile_watch.cold_total - cold0,
+            admit_ms=round((t_admit - it_t0) * 1000.0, 3),
+            prefill_ms=round((t_prefill - t_admit) * 1000.0, 3),
+            dispatch_ms=round((t_dispatch - t_prefill) * 1000.0, 3),
+            fetch_ms=round((t_fetch - t_dispatch) * 1000.0, 3),
+            process_ms=round((now - t_fetch) * 1000.0, 3),
+        )
+
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         log.info(
@@ -2905,6 +3120,10 @@ class InferenceEngine:
                 if self.scheduler.idle and in_flight is None:
                     # Idle time is not a stall: keep the watchdog anchored
                     # to "now" so the next request's budget starts fresh.
+                    # Idle parks record NOTHING — the flight ring holds
+                    # iterations that did work, so its tail is dense with
+                    # decisions when a postmortem reads it.
+                    global_flight.set_phase("idle")
                     self._last_progress = time.monotonic()
                     self._wake.clear()
                     try:
@@ -2913,16 +3132,32 @@ class InferenceEngine:
                         continue
                     continue
 
+                # Flight recorder (ISSUE 12): per-iteration scratch reset +
+                # phase markers.  A wedged dispatch leaves the phase at the
+                # stalled step — the watchdog's attribution.
+                it_t0 = time.monotonic()
+                self._flight_admitted = 0  # tunnelcheck: disable=TC13  single-writer contract: only THIS loop task and the admission helpers it awaits touch the per-iteration flight scratch; the reset-here/accumulate-in-_note_admission/read-at-record sequence cannot interleave with another writer
+                self._last_burst = (0, 0)
+                self._last_mux = {}
+                cold0 = global_compile_watch.cold_total
+                plain_rows = 0
+                global_flight.set_phase("admit")
                 self._expire_deadlines()
                 if self.ecfg.mux:
                     await self._admit_mux(loop)
                     await self._mux_wake(loop)
                 else:
+                    # The legacy admission path prefills the whole wave
+                    # inline, so its rows count as this iteration's
+                    # prefill work.
                     await self._admit_pending(loop)
+                    plain_rows += self._flight_admitted
+                t_admit = time.monotonic()
 
                 global_metrics.set_gauge("engine_batch_occupancy", self.scheduler.occupancy)
                 global_metrics.set_gauge("engine_queue_depth", self.scheduler.queue_depth)
                 self._publish_prefix_gauges()
+                global_flight.set_phase("prefill_dispatch")
 
                 # Prefill work for this iteration, dispatched before the
                 # decode burst.  Non-mux: one prefill_rows-wide segment
@@ -2942,6 +3177,7 @@ class InferenceEngine:
                         del self._pending_plain[:take]
                         if batch:
                             await self._dispatch_plain_waves(loop, batch)
+                            plain_rows += len(batch)
                         rows_budget -= take
                     # The budget may span several prefill_rows-wide
                     # sub-batches: dispatch them back-to-back (the device
@@ -2963,12 +3199,15 @@ class InferenceEngine:
                     )
                     if seg is not None:
                         segs.append(seg)
+                t_prefill = time.monotonic()
+                seg_rows = sum(len(s[0]) for s in segs)
 
                 if self._spec_usable() and any(self._active_mask):
                     # Speculative step (opt-in): synchronous dispatch+fetch
                     # — counts must be read before consumers can be fed, so
                     # there is no carry to pipeline.  Drain the pipelined
                     # plain burst first (mode switch mid-stream).
+                    global_flight.set_phase("decode_fetch")
                     if in_flight is not None:
                         outs_dev, assign, t_disp = in_flight
                         outs = await loop.run_in_executor(
@@ -2979,12 +3218,20 @@ class InferenceEngine:
                         await self._process_burst(outs, assign)
                         self._trace_burst(t_disp, assign)
                         in_flight = None
+                    global_flight.set_phase("decode_dispatch")
                     spec_out, spec_assign = await loop.run_in_executor(
                         self._executor, self._dispatch_spec
                     )
+                    t_spec = time.monotonic()
+                    global_flight.set_phase("process")
                     await self._process_spec(spec_out, spec_assign)
+                    global_flight.set_phase("segments")
                     for seg in segs:
                         await self._finish_segments(loop, seg)
+                    self._flight_record(
+                        it_t0, t_admit, t_prefill, t_spec, t_spec,
+                        plain_rows, seg_rows, cold0,
+                    )
                     continue
 
                 # Pipeline: dispatch burst n (returns immediately; carry stays
@@ -2996,12 +3243,15 @@ class InferenceEngine:
                 # dead-peer timeout.  warmup() precompiles every variant; this
                 # is the belt to that suspender for consumers that skip it.
                 current = None
+                global_flight.set_phase("decode_dispatch")
                 if any(self._active_mask):
                     t_disp0 = time.monotonic()
                     outs_dev0, assign0 = await loop.run_in_executor(
                         self._executor, self._dispatch_decode
                     )
                     current = (outs_dev0, assign0, t_disp0)
+                t_dispatch = time.monotonic()
+                global_flight.set_phase("decode_fetch")
                 if in_flight is not None:
                     outs_dev, assign, t_disp = in_flight
                     t0 = time.monotonic()
@@ -3015,18 +3265,33 @@ class InferenceEngine:
                     global_metrics.observe(
                         "engine_decode_fetch_ms", (time.monotonic() - t0) * 1000.0
                     )
+                    t_fetch = time.monotonic()
+                    global_flight.set_phase("process")
                     await self._process_burst(outs, assign)
                     self._trace_burst(t_disp, assign)
+                else:
+                    t_fetch = t_dispatch
+                global_flight.set_phase("segments")
                 for seg in segs:
                     # Fetched after the decode work above, so each segment
                     # sub-batch's device→host RTT rides under real compute
                     # (and under its successor sub-batches').
                     await self._finish_segments(loop, seg)
                 in_flight = current
+                self._flight_record(
+                    it_t0, t_admit, t_prefill, t_dispatch, t_fetch,
+                    plain_rows, seg_rows, cold0,
+                )
         except Exception:
             log.exception(
                 "engine loop crashed; failing %d in-flight requests",
                 len(self._requests),
+            )
+            # Postmortem black box (ISSUE 12): a fatal engine error is the
+            # canonical "what just happened" moment — snapshot before the
+            # consumers are failed, attributing the phase that raised.
+            global_blackbox.capture(
+                "crash", attribution=global_flight.current_phase(),
             )
             self._running = False
             self._crashed = True  # generate() rejects new submissions

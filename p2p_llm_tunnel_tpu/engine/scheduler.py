@@ -640,6 +640,34 @@ class Scheduler:
 
     # -- introspection ----------------------------------------------------
 
+    def snapshot(self) -> dict:
+        """Deterministic state dump for the postmortem black box
+        (ISSUE 12): the waiting queue and slot table as plain JSON-able
+        rows.  Pure host reads — no clocks, so two seeded runs captured at
+        the same logical point produce identical snapshots."""
+        return {
+            "queue_depth": len(self.waiting),
+            "waiting": [
+                {
+                    "rid": req.request_id,
+                    "tenant": req.tenant,
+                    "prompt_tokens": len(req.prompt_ids),
+                    "max_new_tokens": req.max_new_tokens,
+                }
+                for req in self.waiting
+            ],
+            "slots": [
+                None if run is None else {
+                    "rid": run.request.request_id,
+                    "tenant": run.request.tenant,
+                    "generated": len(run.generated),
+                    "cache_len": run.cache_len,
+                }
+                for run in self.slots
+            ],
+            "virtual_time": round(self._vt, 6),
+        }
+
     @property
     def occupancy(self) -> float:
         return sum(s is not None for s in self.slots) / self.num_slots

@@ -53,6 +53,30 @@ METRICS_CATALOG: Dict[str, str] = {
         "wall seconds warmup spent compiling the serving program set "
         "(gauge; the number a chip window must fit before serving)"
     ),
+    # -- engine flight recorder / cold-start profiler (ISSUE 12) ----------
+    "engine_warmup_programs": (
+        "distinct programs the warmup grid compiled/loaded before serving "
+        "(gauge; the per-program breakdown lives in the CompileWatch "
+        "journal and the bench-smoke row)"
+    ),
+    "engine_warmup_compile_max_s": (
+        "wall seconds of the single slowest warmup program compile "
+        "(gauge; the indivisible floor a chip window must fit)"
+    ),
+    "engine_cold_compiles_total": (
+        "programs compiled ON the serving path after warmup declared the "
+        "bucket grid complete (counter; every increment is a hole in the "
+        "warmup grid — the test_warmup_aot bug class surfaced at runtime)"
+    ),
+    "engine_flight_iterations_total": (
+        "engine-loop iterations recorded by the flight recorder (counter; "
+        "exactly one flight-ring record each — the recorder's overhead "
+        "and coverage invariant)"
+    ),
+    "engine_postmortems_total": (
+        "postmortem black-box bundles captured (counter; triggers: "
+        "watchdog trip, SLO breach, drain timeout, engine crash)"
+    ),
     "engine_ttft_ms": "time to first token per request (histogram, ms)",
     "engine_queue_wait_ms": (
         "submit -> decode-slot admission wait per request (histogram, ms; "

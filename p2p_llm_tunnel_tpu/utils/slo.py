@@ -50,7 +50,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Deque, Dict, List, Optional, Sequence
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from p2p_llm_tunnel_tpu.utils.metrics import Metrics, global_metrics
 
@@ -152,6 +152,9 @@ class SloEngine:
         self.min_events = min_events
         self.enabled = enabled
         self.objectives: Dict[str, Objective] = {}
+        #: Last PUBLISHED state per objective — the transition memory
+        #: behind the on_alert hook (worsening edges only fire once).
+        self._published_states: Dict[str, str] = {}
         #: name -> deque of [bucket_start_s, good, bad], oldest first.
         self._buckets: Dict[str, Deque[List[float]]] = {}
         for obj in objectives:
@@ -173,6 +176,12 @@ class SloEngine:
             if objectives is not None:
                 self.objectives = {o.name: o for o in objectives}
                 self._buckets = {o.name: deque() for o in objectives}
+                # A replaced objective set redefines the verdicts, so the
+                # on_alert transition memory starts over with the buckets
+                # — stale "already breached" states from a previous
+                # configuration must not swallow the fresh set's first
+                # worsening edge.
+                self._published_states.clear()
             if burn_threshold is not None:
                 self.burn_threshold = burn_threshold
             if min_events is not None:
@@ -185,6 +194,7 @@ class SloEngine:
         with self._lock:
             for dq in self._buckets.values():
                 dq.clear()
+            self._published_states.clear()
 
     # -- feeding ----------------------------------------------------------
 
@@ -277,6 +287,16 @@ class SloEngine:
                     out[name]["threshold_ms"] = obj.threshold_ms
             return out
 
+    #: Optional worsening-transition hook (ISSUE 12): called as
+    #: ``on_alert(objective, new_state, verdicts)`` when an objective's
+    #: published state WORSENS (ok -> burning/breached, burning ->
+    #: breached).  utils/flight.py wires the postmortem black box here, so
+    #: an SLO incident snapshots the engine at the moment the budget burn
+    #: crossed the alert threshold — not minutes later when an operator
+    #: looks.  Exceptions are swallowed: an alert hook must never take
+    #: down the serving path it observes.
+    on_alert: Optional[Callable[[str, str, dict], None]] = None
+
     def publish(self, metrics: Optional[Metrics] = None) -> Dict[str, Dict[str, object]]:
         """Evaluate and publish the ``slo_*`` catalog series through the
         bounded labeled-gauge helpers; returns the evaluation.  No-op
@@ -286,6 +306,7 @@ class SloEngine:
             return {}
         metrics = metrics if metrics is not None else global_metrics
         verdicts = self.evaluate()
+        worsened: List[Tuple[str, str]] = []
         for name, v in verdicts.items():
             metrics.set_labeled_gauge(
                 "slo_burn_fast", "objective", name, float(v["burn_fast"])
@@ -293,9 +314,21 @@ class SloEngine:
             metrics.set_labeled_gauge(
                 "slo_burn_slow", "objective", name, float(v["burn_slow"])
             )
+            state = str(v["state"])
             metrics.set_labeled_gauge(
-                "slo_state", "objective", name, _STATE_CODE[str(v["state"])]
+                "slo_state", "objective", name, _STATE_CODE[state]
             )
+            prev = self._published_states.get(name, "ok")
+            if _STATE_CODE[state] > _STATE_CODE.get(prev, 0.0):
+                worsened.append((name, state))
+            self._published_states[name] = state
+        hook = self.on_alert
+        if hook is not None:
+            for name, state in worsened:
+                try:
+                    hook(name, state, verdicts)
+                except Exception:
+                    pass  # observability must not break serving
         return verdicts
 
     def section(self) -> Dict[str, object]:

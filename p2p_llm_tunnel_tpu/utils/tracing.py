@@ -109,6 +109,11 @@ SPAN_CATALOG: Dict[str, str] = {
         "the scheduler evicted the request at its deadline — queued or "
         "mid-decode (instant)"
     ),
+    "engine.cold_compile": (
+        "a program compiled ON the serving path after warmup completed — "
+        "a hole in the warmup bucket grid; attrs carry the program key "
+        "(instant; ISSUE 12 cold-start profiler)"
+    ),
 }
 
 #: Optional trace-context request header: ``<trace_id>/<parent_span_id>``,
@@ -519,7 +524,9 @@ def validate_chrome_trace(obj: object) -> bool:
             if key not in ev:
                 raise ValueError(f"traceEvents[{i}] missing {key!r}")
         ph = ev["ph"]
-        if ph not in ("X", "i", "M"):
+        # "C" counter events are the flight recorder's numeric tracks
+        # (ISSUE 12), merged into the same journal export.
+        if ph not in ("X", "i", "M", "C"):
             raise ValueError(f"traceEvents[{i}] unknown phase {ph!r}")
         if ph == "M":
             continue

@@ -214,6 +214,66 @@ def summarize(trace: dict) -> dict:
             "engine_scope": scope}
 
 
+def summarize_flight(trace: dict, tail: int = 12) -> dict:
+    """Rollup of the engine flight-recorder tracks in a capture (ISSUE
+    12): per-iteration scheduler decisions (``engine.flight`` slices on
+    the ``engine-flight`` lane, exported by ``/healthz?trace=1``).
+
+    Returns aggregates over every iteration in the capture — totals of
+    admitted/prefill/decode work, budget and queue-depth distribution,
+    cold-compile count — plus the last ``tail`` raw records (the part a
+    postmortem reader scans first)."""
+    from p2p_llm_tunnel_tpu.utils.tracing import validate_chrome_trace
+
+    validate_chrome_trace(trace)
+    rows = sorted(
+        (
+            ev for ev in trace["traceEvents"]
+            if ev.get("ph") == "X" and ev.get("name") == "engine.flight"
+        ),
+        key=lambda e: e["ts"],
+    )
+    args = [r.get("args", {}) for r in rows]
+
+    def col(key):
+        return [a.get(key) for a in args if a.get(key) is not None]
+
+    budgets = col("budget_tokens")
+    queue = col("queue_depth")
+    return {
+        "iterations": len(rows),
+        "admitted_total": sum(col("admitted")),
+        "prefill_rows_total": sum(col("prefill_rows")),
+        "decode_steps_total": sum(col("decode_steps")),
+        "cold_compiles": sum(col("cold_compiles")),
+        "queue_depth_max": max(queue) if queue else 0,
+        "budget_tokens_p50": _pct([float(b) for b in budgets], 50),
+        "active_slots_max": max(col("active_slots") or [0]),
+        "tail": [dict(a) for a in args[-tail:]],
+    }
+
+
+def _print_flight(out: dict) -> None:
+    print(
+        f"flight: {out['iterations']} iteration(s); admitted "
+        f"{out['admitted_total']}, prefill rows "
+        f"{out['prefill_rows_total']}, decode steps "
+        f"{out['decode_steps_total']}, cold compiles "
+        f"{out['cold_compiles']}; queue depth max "
+        f"{out['queue_depth_max']}, budget p50 "
+        f"{out['budget_tokens_p50']}, active slots max "
+        f"{out['active_slots_max']}"
+    )
+    if not out["tail"]:
+        return
+    cols = ("iter", "queue_depth", "backlog_rows", "budget_tokens",
+            "admitted", "prefill_rows", "decode_steps", "active_slots",
+            "cold_compiles")
+    print("  ".join(f"{c:>13}" for c in cols))
+    for rec in out["tail"]:
+        print("  ".join(f"{rec.get(c, '-')!s:>13}" for c in cols))
+
+
 def _fmt(v: Optional[float]) -> str:
     return f"{v:8.1f}" if v is not None else "       -"
 
@@ -226,9 +286,20 @@ def main(argv=None) -> int:
     ap.add_argument("path", help="trace JSON file ('-' = stdin)")
     ap.add_argument("--json", action="store_true",
                     help="emit the rollup as JSON instead of a table")
+    ap.add_argument("--flight", action="store_true",
+                    help="summarize the engine flight-recorder tracks "
+                         "(per-iteration scheduler decisions) instead of "
+                         "the per-request view")
     args = ap.parse_args(argv)
     raw = (sys.stdin.read() if args.path == "-"
            else open(args.path).read())
+    if args.flight:
+        out = summarize_flight(json.loads(raw))
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            _print_flight(out)
+        return 0
     out = summarize(json.loads(raw))
     if args.json:
         print(json.dumps(out, indent=2))

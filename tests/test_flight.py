@@ -1,0 +1,612 @@
+"""Engine flight recorder, compile/cold-start profiler, postmortem black
+box (ISSUE 12).
+
+Three layers, matching where the machinery lives:
+- pure ring/journal/bundle logic (utils/flight.py) — no asyncio, no JAX;
+- serve-endpoint surfaces over a loopback channel with a fake backend
+  (/healthz?postmortem=1, engine_degraded_reason, flight tracks in the
+  ?trace=1 export, the drain-timeout trigger) — fast;
+- engine-backed behavior: one flight record per loop iteration, the
+  warmup grid in the compile journal, mid-serve cold-compile detection on
+  a deliberately un-warmed bucket, and the two-run seeded postmortem
+  bundle identity `make chaos` pins (CHAOS_TEST_SEED varies the
+  workload; waived wall-clock fields excluded via postmortem_canonical).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+from p2p_llm_tunnel_tpu.testing.frame_client import FrameClient
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+from p2p_llm_tunnel_tpu.utils.flight import (
+    FLIGHT_SCHEMA,
+    POSTMORTEM_SCHEMA,
+    BlackBox,
+    CompileWatch,
+    FlightRecorder,
+    global_blackbox,
+    global_compile_watch,
+    global_flight,
+    postmortem_canonical,
+)
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+from p2p_llm_tunnel_tpu.utils.slo import global_slo
+from p2p_llm_tunnel_tpu.utils.tracing import (
+    global_tracer,
+    validate_chrome_trace,
+)
+
+SEED = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_blackbox_state():
+    """Each test starts from empty global rings (the bench
+    global_metrics.reset() convention, black-box edition)."""
+    global_flight.reset()
+    global_compile_watch.reset()
+    global_blackbox.reset()
+    yield
+    global_flight.reset()
+    global_compile_watch.reset()
+    global_blackbox.reset()
+
+
+# ---------------------------------------------------------------------------
+# pure recorder / journal / bundle logic
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bound_and_unknown_field_rejected():
+    rec = FlightRecorder(capacity=8)
+    for i in range(50):
+        rec.record_iteration(t=float(i), dur_ms=1.0, queue_depth=i)
+    assert rec.iterations == 50
+    rows = rec.records()
+    assert len(rows) == 8  # cap respected
+    assert rows[-1]["iter"] == 50 and rows[0]["iter"] == 43
+    with pytest.raises(ValueError, match="FLIGHT_SCHEMA"):
+        rec.record_iteration(queue_dept=1)  # tunnelcheck: disable=TC16  the typo class, on purpose: pins the runtime guard
+    # Every documented field is accepted.
+    rec.record_iteration(**{
+        k: 0 for k in FLIGHT_SCHEMA if k != "iter"
+    })
+
+
+def test_flight_chrome_events_are_schema_valid_counters_and_slices():
+    rec = FlightRecorder(capacity=16)
+    rec.record_iteration(t=1.5, dur_ms=2.0, queue_depth=3,
+                         budget_tokens=128, active_slots=2,
+                         backlog_rows=1, decode_steps=4)
+    evs = rec.chrome_events()
+    # Slices + counter tracks, all loadable next to the span journal.
+    trace = global_tracer.chrome_trace()
+    trace["traceEvents"] = list(trace["traceEvents"]) + evs
+    assert validate_chrome_trace(trace)
+    phases = {e["ph"] for e in evs}
+    assert "X" in phases and "C" in phases
+    slice_ev = next(e for e in evs if e["ph"] == "X")
+    assert slice_ev["name"] == "engine.flight"
+    assert slice_ev["args"]["queue_depth"] == 3
+    counters = {e["name"] for e in evs if e["ph"] == "C"}
+    assert "flight.queue_depth" in counters
+    assert "flight.budget_tokens" in counters
+
+
+def test_compile_watch_journal_marks_and_cold_counter():
+    cw = CompileWatch(capacity=8)
+    cw.note(program="decode", key="decode[128,4]", shape=[128, 4],
+            seconds=1.25, phase="warmup")
+    mark = cw.mark()
+    cw.note(program="chunk", key="chunk[64,128]", shape=[64, 128],
+            seconds=0.5, phase="serve", cold=True)
+    assert [e["key"] for e in cw.since(mark)] == ["chunk[64,128]"]
+    assert cw.cold_total == 1
+    assert cw.events()[0]["cache_hit"] is False
+
+
+def test_postmortem_canonical_strips_waived_wallclock_fields():
+    bundle = {
+        "trigger": "manual",
+        "captured_unix_s": 1234.5,
+        "flight": [{"iter": 1, "dur_ms": 3.2, "queue_depth": 2,
+                    "min_slack_s": 0.4}],
+        "metrics": {"engine_tokens_total": 8.0, "engine_ttft_ms_p50": 12.0,
+                    "engine_warmup_compile_s": 4.0},
+        "spans": [{"name": "x", "ts": 1.0, "dur": 2.0, "span_id": "a",
+                   "parent_id": "b", "trace_id": "c"}],
+    }
+    canon = postmortem_canonical(bundle)
+    assert canon == {
+        "trigger": "manual",
+        "flight": [{"iter": 1, "queue_depth": 2}],
+        "metrics": {"engine_tokens_total": 8.0},
+        "spans": [{"name": "x"}],
+    }
+
+
+def test_blackbox_capture_schema_store_and_archive(tmp_path):
+    bb = BlackBox(directory=str(tmp_path / "pm"))
+    bundle = bb.capture("manual", attribution="unit test")
+    # The builder and the declared schema move in lockstep (runtime half
+    # of tunnelcheck TC16).
+    assert set(bundle) == set(POSTMORTEM_SCHEMA)
+    assert bundle["schema_version"] == 1
+    assert bundle["trigger"] == "manual"
+    assert bundle["attribution"] == "unit test"
+    assert bb.captured == 1 and bb.last()["trigger"] == "manual"
+    # Archived atomically (off-thread; flush joins the writer): one
+    # parseable JSON file, path recorded.
+    bb.flush()
+    (path,) = bb.paths()
+    assert json.loads(open(path).read())["trigger"] == "manual"
+    assert not path.endswith(".tmp")
+    with pytest.raises(ValueError, match="unknown postmortem trigger"):
+        bb.capture("kaboom")
+
+
+def test_slo_breach_transition_triggers_postmortem_capture():
+    """An objective worsening to burning/breached through publish() is a
+    black-box trigger (the on_alert hook flight.py wires)."""
+    from p2p_llm_tunnel_tpu.utils.slo import default_objectives
+
+    global_slo.configure(enabled=True, objectives=default_objectives(),
+                         min_events=5)
+    try:
+        for _ in range(20):
+            global_slo.record("availability", False)
+        global_slo.publish()
+        assert global_blackbox.captured == 1
+        bundle = global_blackbox.last()
+        assert bundle["trigger"] == "slo"
+        assert bundle["attribution"].startswith("availability:")
+        assert bundle["slo"]["availability"]["state"] in (
+            "burning", "breached"
+        )
+        # Staying bad is not a NEW transition: no capture storm.
+        global_slo.publish()
+        assert global_blackbox.captured == 1
+    finally:
+        global_slo.configure(enabled=False,
+                             objectives=default_objectives())
+        global_slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# serve endpoint surfaces over loopback (fake backend; fast)
+# ---------------------------------------------------------------------------
+
+
+async def _stack(backend, **serve_kwargs):
+    serve_ch, client_ch = loopback_pair()
+    serve_task = asyncio.create_task(
+        run_serve(serve_ch, backend=backend, **serve_kwargs)
+    )
+    client = FrameClient(client_ch)
+    await client.handshake(timeout=10.0)
+    return serve_task, serve_ch, client
+
+
+async def _teardown(serve_task, serve_ch, client):
+    client.close()
+    serve_task.cancel()
+    serve_ch.close()
+    await asyncio.gather(serve_task, return_exceptions=True)
+
+
+def _echo_backend():
+    async def chunks():
+        yield b"ok"
+
+    async def backend(req, body):
+        return 200, {"content-type": "text/plain"}, chunks()
+
+    return backend
+
+
+def test_healthz_postmortem_surface_and_degraded_reason():
+    async def main():
+        serve_task, ch, client = await _stack(_echo_backend())
+        try:
+            # Healthy: no bundle, and the reason field is present + null.
+            h = await client.wait(
+                await client.request("GET", "/healthz"), 10.0
+            )
+            payload = json.loads(h.text)
+            assert "engine_degraded_reason" in payload
+            assert payload["engine_degraded_reason"] is None
+            r = await client.wait(
+                await client.request("GET", "/healthz?postmortem=1"), 10.0
+            )
+            body = json.loads(r.text)
+            assert body == {"postmortem": None, "captured": 0, "paths": []}
+            # A watchdog-degraded engine answers with the reason AND the
+            # captured bundle.
+            global_metrics.set_gauge("engine_degraded", 1.0)
+            global_blackbox.capture("watchdog", attribution="decode_dispatch")
+            try:
+                h = await client.wait(
+                    await client.request("GET", "/healthz"), 10.0
+                )
+                payload = json.loads(h.text)
+                assert payload["status"] == "degraded"
+                assert payload["engine_degraded_reason"] == "watchdog"
+                r = await client.wait(
+                    await client.request("GET", "/healthz?postmortem=1"),
+                    10.0,
+                )
+                body = json.loads(r.text)
+                assert body["captured"] == 1
+                assert body["postmortem"]["trigger"] == "watchdog"
+                assert body["postmortem"]["attribution"] == "decode_dispatch"
+                assert set(body["postmortem"]) == set(POSTMORTEM_SCHEMA)
+            finally:
+                global_metrics.set_gauge("engine_degraded", 0.0)
+        finally:
+            await _teardown(serve_task, ch, client)
+
+    asyncio.run(main())
+
+
+def test_healthz_trace_export_carries_flight_tracks():
+    async def main():
+        serve_task, ch, client = await _stack(_echo_backend())
+        try:
+            global_flight.record_iteration(
+                t=1.0, dur_ms=2.0, queue_depth=5, budget_tokens=64,
+                active_slots=1, backlog_rows=0,
+            )
+            r = await client.wait(
+                await client.request("GET", "/healthz?trace=1"), 10.0
+            )
+            obj = json.loads(r.text)
+            assert validate_chrome_trace(obj)
+            flights = [e for e in obj["traceEvents"]
+                       if e.get("name") == "engine.flight"]
+            assert len(flights) == 1
+            assert flights[0]["args"]["queue_depth"] == 5
+            assert any(e.get("ph") == "C" for e in obj["traceEvents"])
+        finally:
+            await _teardown(serve_task, ch, client)
+
+    asyncio.run(main())
+
+
+def test_drain_timeout_captures_postmortem_and_closes():
+    """A drain that cannot finish (a wedged in-flight stream) abandons it
+    at the budget, captures trigger 'drain', and still closes cleanly."""
+    async def main():
+        hang = asyncio.Event()
+
+        def backend_factory():
+            async def chunks():
+                yield b"first"
+                await hang.wait()  # never set: the wedge
+
+            async def backend(req, body):
+                return 200, {"content-type": "text/plain"}, chunks()
+
+            return backend
+
+        drain = asyncio.Event()
+        serve_ch, client_ch = loopback_pair()
+        serve_task = asyncio.create_task(run_serve(
+            serve_ch, backend=backend_factory(), drain=drain,
+            drain_timeout=0.3,
+        ))
+        client = FrameClient(client_ch)
+        await client.handshake(timeout=10.0)
+        try:
+            sid = await client.request("GET", "/wedge")
+            await asyncio.sleep(0.2)  # stream is mid-body now
+            drain.set()
+            await asyncio.wait_for(serve_task, 10.0)  # clean return
+            assert global_blackbox.captured == 1
+            bundle = global_blackbox.last()
+            assert bundle["trigger"] == "drain"
+            assert "1 stream(s) unfinished" in bundle["attribution"]
+            assert sid is not None
+        finally:
+            client.close()
+            serve_ch.close()
+            if not serve_task.done():
+                serve_task.cancel()
+            await asyncio.gather(serve_task, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+def test_fleet_postmortem_federation_over_stub_peerset():
+    """GET /healthz?postmortem=1&fleet=1: per-peer bundles via the same
+    bounded scrape machinery, stale peers marked — exercised against a
+    stub PeerSet so the zero/dead-peer shape is pinned without a fabric."""
+    from p2p_llm_tunnel_tpu.endpoints.proxy import _fleet_postmortem_response
+
+    class StubState:
+        async def scrape_fleet(self, path):
+            assert path == "/healthz?postmortem=1"
+            return {
+                "p0": json.dumps(
+                    {"postmortem": {"trigger": "watchdog"}, "captured": 1,
+                     "paths": []}
+                ).encode(),
+                "p1": None,  # dead/wedged peer
+            }
+
+    async def main():
+        resp = await _fleet_postmortem_response(StubState())
+        assert resp.status == 200
+        body = json.loads(resp.body)
+        assert body["stale"] == ["p1"]
+        assert body["peers"]["p1"] is None
+        assert body["peers"]["p0"]["postmortem"]["trigger"] == "watchdog"
+        assert body["peers"]["proxy"]["captured"] == 0
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# traceview --flight
+# ---------------------------------------------------------------------------
+
+
+def test_traceview_flight_summary(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "traceview_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "traceview.py"),
+    )
+    traceview = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(traceview)
+
+    for i in range(3):
+        global_flight.record_iteration(
+            t=float(i), dur_ms=1.0, queue_depth=4 - i, budget_tokens=128,
+            admitted=1, prefill_rows=2, decode_steps=4, active_slots=2,
+            cold_compiles=1 if i == 2 else 0, backlog_rows=0,
+        )
+    trace = global_tracer.chrome_trace()
+    trace["traceEvents"] = (
+        list(trace["traceEvents"]) + global_flight.chrome_events()
+    )
+    out = traceview.summarize_flight(trace)
+    assert out["iterations"] == 3
+    assert out["admitted_total"] == 3
+    assert out["prefill_rows_total"] == 6
+    assert out["decode_steps_total"] == 12
+    assert out["cold_compiles"] == 1
+    assert out["queue_depth_max"] == 4
+    assert len(out["tail"]) == 3
+
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(trace))
+    assert traceview.main([str(path), "--flight"]) == 0
+    printed = capsys.readouterr().out
+    assert "flight: 3 iteration(s)" in printed
+    assert "cold compiles 1" in printed
+    # --json twin stays machine-readable.
+    assert traceview.main([str(path), "--flight", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["iterations"] == 3
+
+
+# ---------------------------------------------------------------------------
+# engine-backed behavior (tiny model, CPU)
+# ---------------------------------------------------------------------------
+
+
+def _engine(**overrides):
+    from p2p_llm_tunnel_tpu.engine.engine import (
+        EngineConfig,
+        InferenceEngine,
+    )
+
+    kw = dict(model="tiny", num_slots=2, max_seq=128, dtype="float32",
+              decode_steps=4, decode_steps_eager=0)
+    kw.update(overrides)
+    return InferenceEngine(engine_cfg=EngineConfig(**kw))
+
+
+def _prompt(seed: int, n: int = 12):
+    rng = random.Random(seed)
+    return [rng.randrange(2, 200) for _ in range(n)]
+
+
+def test_engine_records_one_flight_row_per_iteration():
+    async def main():
+        global_flight.configure(capacity=6)  # tiny cap: bound under churn
+        iters0 = global_metrics.counter("engine_flight_iterations_total")
+        try:
+            engine = _engine()
+            await engine.start()
+            try:
+                async for _ in engine.generate(_prompt(1), max_new_tokens=24):
+                    pass
+            finally:
+                await engine.stop()
+            # Exactly one record per non-idle iteration (the counter is
+            # incremented by record_iteration itself), and the ring cap
+            # held while the counter ran past it.
+            iters = (global_metrics.counter("engine_flight_iterations_total")
+                     - iters0)
+            assert global_flight.iterations == iters > 6
+            assert len(global_flight.records()) == 6
+            rows = global_flight.records()
+            # Decode iterations carry the burst shape; the schema is the
+            # registry's (no stray fields can exist — record_iteration
+            # validated them).
+            assert any(r["decode_steps"] == 4 and r["decode_rows"] == 1
+                       for r in rows)
+            assert all(set(r) <= set(FLIGHT_SCHEMA) for r in rows)
+        finally:
+            global_flight.configure(capacity=1024)
+
+    asyncio.run(main())
+
+
+def test_warmup_compile_journal_covers_grid_and_gauges():
+    async def main():
+        engine = _engine()
+        await engine.start()
+        try:
+            await engine.warmup()
+            events = global_compile_watch.events()
+            keys = {e["key"] for e in events}
+            # The full decode (view x steps) grid appears in the journal.
+            for view in engine._warmup_views():
+                assert f"decode[{view},{engine.ecfg.decode_steps}]" in keys
+            assert all(e["phase"] in ("warmup", "aot") for e in events)
+            assert not any(e["cold"] for e in events)
+            # total/count/max published as catalogued gauges.
+            assert global_metrics.gauge("engine_warmup_compile_s") > 0
+            n = global_metrics.gauge("engine_warmup_programs")
+            assert n == len(keys) >= 1
+            mx = global_metrics.gauge("engine_warmup_compile_max_s")
+            assert 0 < mx <= global_metrics.gauge("engine_warmup_compile_s")
+            assert engine._warmup_done
+            assert global_metrics.counter("engine_cold_compiles_total") == 0
+        finally:
+            await engine.stop()
+
+    asyncio.run(main())
+
+
+def test_midserve_cold_compile_detected_on_unwarmed_bucket(monkeypatch):
+    """A deliberately-capped warmup leaves the big kv-view bucket out of
+    the grid; a long generation then reaches it on the serving path — the
+    cold compile must be counted, journaled cold, and stamped on the
+    flight record (the test_warmup_aot bug class, surfaced at runtime)."""
+    monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "1")
+
+    async def main():
+        cold0 = global_metrics.counter("engine_cold_compiles_total")
+        engine = _engine(max_seq=512, decode_steps=8)
+        await engine.start()
+        try:
+            await engine.warmup()
+            assert engine._warmup_done
+            # The cap kept warmup to the smallest bucket only.
+            warmed = {k for k in engine._programs_ready
+                      if k.startswith("decode[")}
+            assert warmed == {"decode[128,8]"}
+            # Generate far enough that the view bucket grows past 128:
+            # need = pos + 2*8 + 1 > 128 -> ~110 tokens of context.
+            async for _ in engine.generate(_prompt(2, n=16),
+                                           max_new_tokens=160):
+                pass
+        finally:
+            await engine.stop()
+        assert global_metrics.counter("engine_cold_compiles_total") > cold0
+        cold_events = [e for e in global_compile_watch.events() if e["cold"]]
+        assert cold_events
+        assert all(e["phase"] == "serve" for e in cold_events)
+        # The capped-out decode view bucket is among the detected holes
+        # (so is the never-hinted prefill prompt bucket — warmup without
+        # TUNNEL_WARMUP_PREFILL_TOKENS compiles no prefill program, a
+        # real grid hole this profiler now surfaces).
+        assert any(e["key"].startswith("decode[256") for e in cold_events)
+        assert any(r["cold_compiles"] for r in global_flight.records())
+
+    asyncio.run(main())
+
+
+def _wedge_second_decode(engine, release: threading.Event):
+    """Monkeypatch: the SECOND decode-burst dispatch blocks the executor
+    thread until ``release`` — a deterministic stand-in for a wedged XLA
+    dispatch (the decode-stall watchdog's incident class)."""
+    orig = engine._dispatch_decode
+    calls = {"n": 0}
+
+    def wedged(**kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            release.wait(timeout=30)
+        return orig(**kw)
+
+    engine._dispatch_decode = wedged
+
+
+async def _watchdog_incident_bundle(seed: int) -> dict:
+    """One seeded watchdog incident: two requests admitted, first burst
+    dispatched, second dispatch wedges, watchdog trips and captures.
+
+    The engine is WARMED first (prefill width hinted) so no compile stall
+    can trip the tight watchdog budget before the deliberate wedge — the
+    wedge is the incident."""
+    global_metrics.reset()
+    global_flight.reset()
+    global_compile_watch.reset()
+    global_blackbox.reset()
+    global_tracer.configure(enabled=False)
+    global_tracer.clear()
+    engine = _engine(watchdog_budget_s=0.25)
+    release = threading.Event()
+    os.environ["TUNNEL_WARMUP_PREFILL_TOKENS"] = "12"
+    try:
+        await engine.start()
+        await engine.warmup()
+    finally:
+        del os.environ["TUNNEL_WARMUP_PREFILL_TOKENS"]
+    _wedge_second_decode(engine, release)
+    consumers = []
+    try:
+        async def consume(p):
+            async for _ in engine.generate(p, max_new_tokens=16):
+                pass
+
+        consumers = [
+            asyncio.create_task(consume(_prompt(seed))),
+            asyncio.create_task(consume(_prompt(seed + 1))),
+        ]
+        for _ in range(400):
+            if global_blackbox.captured:
+                break
+            await asyncio.sleep(0.025)
+        bundle = global_blackbox.last()
+        assert bundle is not None, "watchdog never captured"
+        return bundle
+    finally:
+        release.set()
+        for t in consumers:
+            t.cancel()
+        await asyncio.gather(*consumers, return_exceptions=True)
+        await engine.stop()
+
+
+def test_postmortem_bundle_identity_two_seeded_runs():
+    """The acceptance pin: the same seeded watchdog incident yields a
+    bundle IDENTICAL across two runs once the explicitly-waived
+    wall-clock fields are stripped — flight tail, compile journal,
+    scheduler/slot snapshot, config, metrics counters, attribution, all
+    byte-for-byte.  (`make chaos` runs this at two seeds with
+    TUNNEL_POSTMORTEM_DIR=artifacts/postmortem to archive the bundles.)"""
+    async def main():
+        b1 = await _watchdog_incident_bundle(SEED)
+        b2 = await _watchdog_incident_bundle(SEED)
+        assert b1["trigger"] == "watchdog"
+        # Attribution: the loop phase the stall wedged in.
+        assert b1["attribution"] in (
+            "decode_dispatch", "decode_fetch", "process", "segments",
+        )
+        c1, c2 = postmortem_canonical(b1), postmortem_canonical(b2)
+        assert c1 == c2, "postmortem bundles diverged across seeded runs"
+        # The bundle is substantive, not vacuously equal: flight rows,
+        # compile events, the slot table, and real token counters.
+        assert c1["flight"], "no flight records in the bundle"
+        assert c1["compile_events"]
+        assert any(s is not None for s in c1["engine"]["scheduler"]["slots"])
+        assert c1["metrics"]["engine_tokens_total"] > 0
+        assert c1["engine"]["config"]["model"] == "tiny"
+        # And JSON-serializable end to end (the /healthz + archive form).
+        json.dumps(b1, default=str)
+
+    asyncio.run(main())

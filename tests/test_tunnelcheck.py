@@ -2275,6 +2275,156 @@ def test_tc15_waiver_names_releasing_owner(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# TC16 — flight/postmortem schema registries + ops routing via ops_route
+# ---------------------------------------------------------------------------
+
+
+def test_tc16_flags_unknown_flight_field(tmp_path):
+    # The registry resolves from the REPO's own utils/flight.py even when
+    # the fixture tree doesn't carry a copy (the TC06 fallback pattern).
+    active, _ = check(
+        tmp_path,
+        """
+        def loop_tick(flight):
+            flight.record_iteration(queue_depth=3, queue_dept=4)
+        """,
+        rules=["TC16"],
+    )
+    assert rules_of(active) == ["TC16"]
+    assert "queue_dept" in active[0].message
+    assert "FLIGHT_SCHEMA" in active[0].message
+
+
+def test_tc16_declared_flight_fields_are_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def loop_tick(flight):
+            flight.record_iteration(
+                queue_depth=3, budget_tokens=64, decode_steps=8,
+            )
+        """,
+        rules=["TC16"],
+    )
+    assert active == []
+
+
+def test_tc16_flags_undeclared_postmortem_extra_key(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def on_incident(bb):
+            bb.capture("manual", extra={"trigger": "x", "vibes": 1})
+        """,
+        rules=["TC16"],
+    )
+    assert rules_of(active) == ["TC16"]
+    assert "vibes" in active[0].message
+    assert "POSTMORTEM_SCHEMA" in active[0].message
+
+
+def test_tc16_flags_handrolled_ops_path_matching_in_endpoints(tmp_path):
+    # All three hand-rolled shapes the pre-ISSUE-9 copies used: equality,
+    # startswith, and a raw query-token membership test against .path.
+    active, _ = check(
+        tmp_path,
+        """
+        async def handler(req):
+            if req.path == "/healthz":
+                return 1
+            if req.path.startswith("/metrics"):
+                return 2
+            if "trace=1" in req.path:
+                return 3
+        """,
+        filename="p2p_llm_tunnel_tpu/endpoints/custom_ops.py",
+        rules=["TC16"],
+    )
+    assert rules_of(active) == ["TC16", "TC16", "TC16"]
+    assert "ops_route" in active[0].message
+
+
+def test_tc16_ops_route_flag_set_and_non_endpoint_files_are_clean(tmp_path):
+    # The sanctioned pattern — flags tested against ops_route's returned
+    # set — and the same strings outside endpoints/ (tests, scripts,
+    # client-side fetch paths) are out of scope.
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.endpoints.http11 import ops_route
+
+        async def handler(req):
+            route = ops_route(req.method, req.path)
+            if route is not None and "trace=1" in route[1]:
+                return 1
+        """,
+        filename="p2p_llm_tunnel_tpu/endpoints/custom_ops.py",
+        rules=["TC16"],
+    )
+    assert active == []
+    active, _ = check(
+        tmp_path,
+        """
+        async def scrape(fetch):
+            return await fetch("/healthz?trace=1")
+
+        def assert_path(path):
+            assert path == "/healthz"
+        """,
+        filename="scripts/poker.py",
+        rules=["TC16"],
+    )
+    assert active == []
+
+
+def test_tc16_http11_is_the_one_legal_matcher_and_waiver_works(tmp_path):
+    # ops_route's own implementation necessarily string-matches.
+    active, _ = check(
+        tmp_path,
+        """
+        def ops_route(method, path):
+            base = path.partition("?")[0]
+            if base not in ("/healthz", "/metrics"):
+                return None
+            return base[1:]
+        """,
+        filename="p2p_llm_tunnel_tpu/endpoints/http11.py",
+        rules=["TC16"],
+    )
+    assert active == []
+    active, waived = check(
+        tmp_path,
+        """
+        async def handler(req):
+            if req.path == "/healthz":  # tunnelcheck: disable=TC16  fixture
+                return 1
+        """,
+        filename="p2p_llm_tunnel_tpu/endpoints/custom_ops.py",
+        rules=["TC16"],
+    )
+    assert active == [] and rules_of(waived) == ["TC16"]
+
+
+def test_tc16_runtime_registry_agrees_with_static_rule():
+    """The runtime guard TC16 statically mirrors: record_iteration
+    rejects undeclared fields, capture builds exactly the declared
+    schema (both raise loudly on drift)."""
+    from p2p_llm_tunnel_tpu.utils.flight import (
+        FLIGHT_SCHEMA,
+        POSTMORTEM_SCHEMA,
+        BlackBox,
+        FlightRecorder,
+    )
+
+    rec = FlightRecorder(capacity=4)
+    with pytest.raises(ValueError):
+        rec.record_iteration(not_a_field=1)  # tunnelcheck: disable=TC16  deliberate drift: pins the runtime guard
+    rec.record_iteration(**{k: 0 for k in FLIGHT_SCHEMA if k != "iter"})
+    bundle = BlackBox(directory="").capture("manual")
+    assert set(bundle) == set(POSTMORTEM_SCHEMA)
+
+
+# ---------------------------------------------------------------------------
 # SARIF export, --list-rules pin, TC00 counting, parallel + changed-only
 # ---------------------------------------------------------------------------
 
@@ -2338,14 +2488,14 @@ def test_sarif_includes_tc00(tmp_path):
 
 def test_list_rules_pinned_against_code_and_readme(capsys):
     """Rule-id drift (docs vs code) fails fast: --list-rules must show
-    exactly TC00..TC15, every runnable rule must have a summary, and the
+    exactly TC00..TC16, every runnable rule must have a summary, and the
     README rule table must carry a row for every rule."""
     from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
 
     assert tunnelcheck_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     listed = [line.split()[0] for line in out.strip().splitlines()]
-    assert listed == [f"TC{i:02d}" for i in range(16)]
+    assert listed == [f"TC{i:02d}" for i in range(17)]
     assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
 
     readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")

@@ -333,6 +333,15 @@ class ProjectContext:
         self.span_names: Set[str] = (
             _str_collection(tracing, "SPAN_CATALOG") if tracing else set()
         )
+        flight = _parse_registry_file(
+            "p2p_llm_tunnel_tpu/utils/flight.py", self.files
+        )
+        self.flight_fields: Set[str] = (
+            _str_collection(flight, "FLIGHT_SCHEMA") if flight else set()
+        )
+        self.postmortem_fields: Set[str] = (
+            _str_collection(flight, "POSTMORTEM_SCHEMA") if flight else set()
+        )
 
     @property
     def callgraph(self):
@@ -384,6 +393,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_config,
         rules_deps,
         rules_dispatch,
+        rules_flight,
         rules_jax,
         rules_labels,
         rules_lifecycle,
@@ -411,6 +421,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC13": rules_atomicity.check_tc13,
         "TC14": rules_taint.check_tc14,
         "TC15": rules_lifecycle.check_tc15,
+        "TC16": rules_flight.check_tc16,
     }
 
 
@@ -431,6 +442,7 @@ RULE_SUMMARIES = {
     "TC13": "read-modify-write of shared state straddles an await/yield without a lock",
     "TC14": "client-controlled header/body bytes reach a trusted sink unsanitized",
     "TC15": "span/slot/in-flight registration not released on every exit path (incl. generator aclose)",
+    "TC16": "flight/postmortem field not in the flight.py registries / ops path matched outside http11.ops_route",
 }
 
 
