@@ -51,7 +51,7 @@ test-race:         ## concurrency suites under asyncio debug mode + native sanit
 		tests/test_seeded_sampling.py tests/test_logit_bias.py \
 		tests/test_spmd_serve.py tests/test_chaos.py \
 		tests/test_deadlines.py tests/test_fabric.py \
-		tests/test_fleet.py -q
+		tests/test_fleet.py tests/test_resume.py -q
 
 # Three fixed seeds: each pins a different deterministic fault schedule
 # (drops land on different frames); the e2e scenario asserts identical
@@ -103,6 +103,17 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	CHAOS_TEST_SEED=19 TUNNEL_POSTMORTEM_DIR=artifacts/postmortem \
 		python -m pytest tests/test_flight.py -k postmortem -q
 	@echo "postmortem bundles archived:"; ls -1 artifacts/postmortem 2>/dev/null || true
+	@# ISSUE 13 matrix rows: mid-stream continuity under the seeded kill=
+	@# fault — a stream murdered mid-flight and recovered inside the grace
+	@# window reaches the client BYTE-IDENTICAL to an unfaulted run with
+	@# exactly one serve_stream_resumes_total increment, identical across
+	@# two seeded runs (asserted INSIDE the test); composed with the bw=
+	@# slow-reader fault the replay-journal memory bound holds; the
+	@# grace-expiry and resume-disabled twins assert today's typed
+	@# [peer_lost] still fires; and the post-run registry/gauge leak
+	@# checks are clean.
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_resume.py -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_resume.py -k "midstream or journal" -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

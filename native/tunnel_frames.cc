@@ -43,6 +43,7 @@ inline bool known_type(uint8_t t) {
     case 1: case 2: case 3: case 4:        // HELLO AGREE PING PONG
     case 10: case 11: case 12:             // REQ_*
     case 20: case 21: case 22:             // RES_*
+    case 23: case 24:                      // RES_RESUME / RES_RESUMED (mid-stream continuity)
     case 30:                               // FLOW (credit grant, v1+flow)
     case 99:                               // ERROR
       return true;

@@ -160,6 +160,31 @@ def build_parser() -> argparse.ArgumentParser:
                             "'drain') and the tunnel closes anyway "
                             "(0 = wait forever, the historical behavior; "
                             "env TUNNEL_DRAIN_TIMEOUT)")
+    serve.add_argument("--stream-grace-s", type=float,
+                       default=float(_env("TUNNEL_STREAM_GRACE_S", "5")),
+                       help="mid-stream continuity (ISSUE 13): how long a "
+                            "token stream whose tunnel link died parks in "
+                            "the detached-stream registry — engine "
+                            "generation still running, replay journal "
+                            "still filling — awaiting a RES_RESUME from "
+                            "the reattached proxy before the generation "
+                            "is cancelled and the client gets the typed "
+                            "peer_lost terminal (0 disables resume "
+                            "entirely: legacy wire, legacy failure mode; "
+                            "env TUNNEL_STREAM_GRACE_S)")
+    serve.add_argument("--stream-journal-bytes", type=int,
+                       default=int(_env("TUNNEL_STREAM_JOURNAL_BYTES",
+                                        str(512 * 1024))),
+                       help="per-stream replay-journal cap in bytes: "
+                            "response bytes retained until the proxy's "
+                            "FLOW grants ack them, so a resume can splice "
+                            "at the delivered offset; also the journal's "
+                            "backpressure bound while detached (memory "
+                            "cost: up to this many bytes per in-flight "
+                            "resumable stream; keep it above the 256 KiB "
+                            "flow-credit window or resumes of a lagging "
+                            "client fall back to peer_lost; env "
+                            "TUNNEL_STREAM_JOURNAL_BYTES)")
     serve.add_argument("--postmortem-dir",
                        default=_env("TUNNEL_POSTMORTEM_DIR",
                                     "artifacts/postmortem"),
@@ -485,6 +510,8 @@ async def _serve_once(args, drain: "Optional[asyncio.Event]" = None) -> None:
         kwargs = dict(
             max_inflight=getattr(args, "max_inflight", 0), drain=drain,
             drain_timeout=getattr(args, "drain_timeout", 0.0),
+            stream_grace_s=getattr(args, "stream_grace_s", -1.0),
+            stream_journal_bytes=getattr(args, "stream_journal_bytes", 0),
         )
         if backend is not None:
             await run_serve(channel, backend=backend, **kwargs)

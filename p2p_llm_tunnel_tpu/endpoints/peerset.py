@@ -44,6 +44,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     ProtocolError,
     RequestHeaders,
     ResponseHeaders,
+    ResumeFrame,
     TunnelMessage,
 )
 from p2p_llm_tunnel_tpu.transport.base import Channel, ChannelClosed
@@ -108,7 +109,17 @@ class _End:
     pass
 
 
-_StreamEvent = Union[_Headers, _Body, _Error, _End]
+@dataclass
+class _Resumed:
+    """RES_RESUMED: the serve peer accepted a mid-stream resume and will
+    splice its replay journal at ``offset`` (ISSUE 13)."""
+
+    offset: int
+    epoch: int
+    token: str
+
+
+_StreamEvent = Union[_Headers, _Body, _Error, _End, _Resumed]
 
 
 class PeerLink:
@@ -307,6 +318,36 @@ class PeerSet:
             chosen.half_open_inflight = True
         return chosen
 
+    def resume_candidates(
+        self, prefer_peer_id: str, exclude: Iterable[str] = (),
+        died_at: float = 0.0,
+    ) -> List[PeerLink]:
+        """Links worth sending a RES_RESUME to, best-first (ISSUE 13).
+
+        The replay journal lives in one serve PROCESS, so the best
+        candidate is a link that re-dialed under the dead peer's id;
+        next, links admitted AFTER the death (a rejoined process gets a
+        fresh id from the fabric); lastly any other ready link — a wrong
+        process answers the unknown token with a fast typed refusal, so
+        probing it costs one round trip, never the grace window.
+        ``exclude`` holds peer ids already refused for this resume.
+        """
+        excluded = set(exclude)
+        out = [
+            l for l in self.peers.values()
+            if l.ready and l.state not in (PEER_DEAD, PEER_DRAINING)
+            and l.peer_id not in excluded
+        ]
+
+        def rank(l: PeerLink) -> Tuple[int, float]:
+            if l.peer_id == prefer_peer_id:
+                return (0, 0.0)
+            if l.admitted_at >= died_at:
+                return (1, -l.admitted_at)  # newest rejoin first
+            return (2, 0.0)
+
+        return sorted(out, key=rank)
+
     # -- circuit breaker --------------------------------------------------
 
     def record_failure(self, link: PeerLink) -> None:
@@ -426,6 +467,15 @@ class PeerSet:
                 if q is not None:
                     q.put_nowait(_End())
                     self._publish_gauges()
+            elif msg.msg_type == MessageType.RES_RESUMED:
+                try:
+                    rf = ResumeFrame.from_json(msg.payload)
+                except ProtocolError as e:
+                    log.warning("bad RES_RESUMED payload: %s", e)
+                    continue
+                q = link.pending.get(msg.stream_id)
+                if q is not None:
+                    q.put_nowait(_Resumed(rf.offset, rf.epoch, rf.token))
             elif msg.msg_type == MessageType.ERROR:
                 text = msg.payload.decode("utf-8", "replace")
                 code = msg.error_code()
@@ -694,6 +744,12 @@ class PeerSet:
             ),
             "failover_p50_ms": round(
                 global_metrics.percentile("proxy_failover_ms", 50), 1
+            ),
+            # Mid-stream continuity (ISSUE 13): link-death -> first
+            # resumed byte, for streams that reattached instead of
+            # surfacing the typed peer_lost terminal.
+            "stream_resume_p50_ms": round(
+                global_metrics.percentile("proxy_stream_resume_ms", 50), 1
             ),
             "peers": {
                 pid: link.describe(now) for pid, link in self.peers.items()

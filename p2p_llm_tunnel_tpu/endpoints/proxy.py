@@ -49,6 +49,7 @@ from p2p_llm_tunnel_tpu.endpoints.peerset import (  # noqa: F401  (re-exported)
     _End,
     _Error,
     _Headers,
+    _Resumed,
     _StreamEvent,
 )
 from p2p_llm_tunnel_tpu.protocol.frames import (
@@ -56,6 +57,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     TENANT_HEADER,
     MessageType,
     RequestHeaders,
+    ResumeFrame,
     TunnelMessage,
     encode_body_frames,
     parse_deadline_ms,
@@ -89,6 +91,14 @@ REDISPATCH_BACKOFF_MAX_S = 1.0
 #: Advisory Retry-After attached to terminal peer_lost/no-peer failures —
 #: the serve peers' supervisors redial on this order of backoff.
 PEER_LOST_RETRY_AFTER_S = 2
+
+#: Per-candidate budget for one RES_RESUME round trip (ISSUE 13): a peer
+#: that holds the journal answers immediately; a wrong or wedged peer
+#: must cost one bounded probe, never the whole grace window.
+RESUME_PROBE_TIMEOUT = 2.0
+#: Poll interval while waiting for a resume candidate (a re-dialed peer)
+#: to appear in the PeerSet.
+RESUME_POLL_S = 0.05
 
 _HOP_BY_HOP_RESPONSE = {"transfer-encoding", "connection"}
 
@@ -486,8 +496,14 @@ async def _dispatch_once(
     link.pending[stream_id] = events
     global_metrics.set_gauge("proxy_streams_in_flight", state.total_pending())
 
-    def drop_stream() -> None:
-        link.pending.pop(stream_id, None)
+    def drop_stream(l: Optional[PeerLink] = None,
+                    sid: Optional[int] = None) -> None:
+        """Forget one stream registration — defaults to the original
+        (link, stream_id); a resumed stream passes its CURRENT binding."""
+        if l is None:
+            link.pending.pop(stream_id, None)
+        else:
+            l.pending.pop(sid, None)
         global_metrics.set_gauge(
             "proxy_streams_in_flight", state.total_pending())
 
@@ -593,12 +609,26 @@ async def _dispatch_once(
     # line — the OTHER streaming vocabulary a typed terminal error can ride.
     is_ndjson = "ndjson" in ctype
 
+    # Mid-stream continuity (ISSUE 13): a resumable stream's RES_HEADERS
+    # carries a serve-minted resume token + the serve side's grace window.
+    # On a mid-stream peer loss the response is held open while the fabric
+    # re-dial / breaker half-open probe recovers the peer, then RES_RESUME
+    # splices the replay journal at exactly the delivered-byte offset —
+    # the client-observed body is byte-identical to an uninterrupted run.
+    resume_token = res_headers.resume
+    resume_grace = res_headers.grace
+
     async def body_stream() -> AsyncIterator[bytes]:
+        cur_link = link
+        cur_sid = stream_id
+        cur_events = events
         first = True
+        delivered = 0  # absolute body bytes the HTTP client has consumed
+        epoch = 0      # last RES_RESUMED epoch (0 = original attachment)
         ungranted = 0  # bytes relayed since the last FLOW grant
         try:
             while True:
-                event = await events.get()
+                event = await cur_events.get()
                 if isinstance(event, _Body):
                     if first:
                         global_metrics.observe(
@@ -613,24 +643,51 @@ async def _dispatch_once(
                     global_metrics.inc("proxy_body_bytes_total", len(event.data))
                     yield event.data
                     # The chunk reached the HTTP client (yield resumes after
-                    # the writer drains) — replenish the serve side's credit
-                    # in CREDIT_BATCH steps.
-                    if link.flow_enabled:
+                    # the writer drains) — count it delivered (the offset a
+                    # resume splices at) and replenish the serve side's
+                    # credit in CREDIT_BATCH steps.
+                    delivered += len(event.data)
+                    if cur_link.flow_enabled:
                         ungranted += len(event.data)
                         if ungranted >= CREDIT_BATCH:
                             try:
-                                await channel.send(
-                                    TunnelMessage.flow(stream_id, ungranted).encode()
+                                await cur_link.channel.send(
+                                    TunnelMessage.flow(cur_sid, ungranted).encode()
                                 )
                                 ungranted = 0
                             except ChannelClosed:
-                                return
+                                pass  # the reader will surface the death
                 elif isinstance(event, (_End, _Error)):
                     # ERROR mid-stream truncates the body silently
                     # (proxy.rs:408-412) — HTTP status already went out.
                     if isinstance(event, _Error):
+                        if (event.code == "peer_lost" and resume_token
+                                and resume_grace > 0
+                                and not state.closed.is_set()):
+                            # Hold the response open for the grace window
+                            # and try to reattach; only when that fails
+                            # does today's typed terminal fire — the
+                            # failure mode narrows, never changes shape.
+                            t_died = time.monotonic()
+                            got = await _attempt_resume(
+                                state, cur_link.peer_id, resume_token,
+                                delivered, epoch, resume_grace, t_died,
+                            )
+                            if got is not None:
+                                cur_link, cur_sid, cur_events, epoch = got
+                                ungranted = 0
+                                global_metrics.observe(
+                                    "proxy_stream_resume_ms",
+                                    (time.monotonic() - t_died) * 1000.0,
+                                )
+                                log.info(
+                                    "stream %d resumed on peer %s at byte "
+                                    "%d (epoch %d)", cur_sid,
+                                    cur_link.peer_id, delivered, epoch,
+                                )
+                                continue
                         log.warning(
-                            "tunnel error mid-stream for %d: %s", stream_id, event.message
+                            "tunnel error mid-stream for %d: %s", cur_sid, event.message
                         )
                         if ((is_sse or is_ndjson) and not first
                                 and event.code in ("peer_lost",
@@ -656,14 +713,120 @@ async def _dispatch_once(
                             yield ((f"data: {payload}\n\n" if is_sse
                                     else payload + "\n").encode())
                     return
+                elif isinstance(event, _Resumed):
+                    log.warning("unexpected RES_RESUMED for stream %d", cur_sid)
                 else:
-                    log.warning("unexpected duplicate headers for stream %d", stream_id)
+                    log.warning("unexpected duplicate headers for stream %d", cur_sid)
         finally:
-            drop_stream()
-            finish_span(res_headers.status, peer_id=link.peer_id,
+            drop_stream(cur_link, cur_sid)
+            finish_span(res_headers.status, peer_id=cur_link.peer_id,
                         attempts=prior_failures)
 
     return HttpResponse(res_headers.status, headers_out, body_stream())
+
+
+async def _attempt_resume(
+    state: ProxyState, dead_peer_id: str, token: str, delivered: int,
+    epoch: int, grace_s: float, died_at: float,
+):
+    """Reattach a mid-stream request after its peer link died (ISSUE 13).
+
+    Waits up to the serve-advertised grace window for a candidate link
+    (the dead peer's id re-dialed, a freshly-admitted rejoin, or any
+    ready peer — a wrong process refuses the token in one bounded round
+    trip), sends RES_RESUME with the DELIVERED byte offset, and returns
+    ``(link, stream_id, events_queue, epoch)`` on RES_RESUMED — the
+    journal tail then arrives as ordinary RES_BODY frames.  None when the
+    window expires or every candidate refused: the caller falls back to
+    today's typed ``peer_lost`` terminal.
+    """
+    deadline = died_at + grace_s
+    refused: set = set()      # id(link) of links that REFUSED the token
+    probes: dict = {}         # id(link) -> (link, sid, queue) awaiting answer
+    accepted = None
+
+    def _probe_answer(link2, sid, q, ev):
+        """Fold one demux event into the probe bookkeeping."""
+        nonlocal accepted
+        if (isinstance(ev, _Resumed) and ev.token == token
+                and ev.offset == delivered):
+            accepted = (link2, sid, q, ev.epoch)
+            return
+        link2.pending.pop(sid, None)
+        probes.pop(id(link2), None)
+        refused.add(id(link2))
+        if isinstance(ev, _Error):
+            log.info("peer %s refused resume: %s", link2.peer_id, ev.message)
+
+    async def _abandon(link2, sid) -> None:
+        """Tell the serve peer this probe is dead — if it had already
+        ACCEPTED (answer in flight), its relay must re-park rather than
+        pump a stream id nobody demuxes until credit exhaustion."""
+        link2.pending.pop(sid, None)
+        try:
+            await link2.channel.send(TunnelMessage.typed_error(
+                sid, "peer_lost", "resume abandoned by proxy",
+            ).encode())
+        except ChannelClosed:
+            pass
+
+    try:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or state.closed.is_set():
+                return None
+            # A slow probe's answer may land AFTER its wait below timed
+            # out — keep polling every outstanding queue, so a late
+            # accept is taken instead of orphaned.
+            for lid, (link2, sid, q) in list(probes.items()):
+                while accepted is None and not q.empty():
+                    _probe_answer(link2, sid, q, q.get_nowait())
+            if accepted is not None:
+                return accepted
+            # Exclusions are per LINK, not per peer id: a peer that
+            # re-dials under its old id is a fresh link holding the
+            # journal — a transient failure on its previous incarnation
+            # must not bar it for the rest of the window.
+            candidates = [
+                l for l in state.resume_candidates(
+                    dead_peer_id, died_at=died_at)
+                if id(l) not in refused and id(l) not in probes
+            ]
+            if not candidates:
+                await asyncio.sleep(min(RESUME_POLL_S, remaining))
+                continue
+            link2 = candidates[0]
+            sid = state.alloc_stream_id()
+            q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in BYTES by FLOW credit once resumed (the serve relay stops at INITIAL_CREDIT unacked bytes); pre-resume it holds exactly one RES_RESUMED/ERROR answer
+            link2.pending[sid] = q  # tunnelcheck: disable=TC15  released on every path: refusal/timeout/give-up pop via _probe_answer/_abandon (the finally below sweeps outstanding probes); on success ownership transfers to body_stream, whose finally pops the CURRENT (link, sid)
+            probes[id(link2)] = (link2, sid, q)
+            try:
+                await link2.channel.send(TunnelMessage.res_resume(
+                    ResumeFrame(sid, token, delivered, epoch)
+                ).encode())
+                ev = await asyncio.wait_for(
+                    q.get(), min(remaining, RESUME_PROBE_TIMEOUT)
+                )
+            except ChannelClosed:
+                link2.pending.pop(sid, None)
+                probes.pop(id(link2), None)
+                continue
+            except asyncio.TimeoutError:
+                # Leave the probe outstanding: its answer may still come
+                # (polled above); meanwhile try another candidate.
+                continue
+            _probe_answer(link2, sid, q, ev)
+            if accepted is not None:
+                return accepted
+    finally:
+        # Give-up or success: no probe may stay half-open.  An accepted
+        # attachment we are NOT taking is explicitly cancelled so the
+        # serve side re-parks it (grace window) instead of wedging.
+        for link2, sid, q in list(probes.values()):
+            if accepted is not None and link2 is accepted[0] \
+                    and sid == accepted[1]:
+                continue
+            await _abandon(link2, sid)
 
 
 async def run_proxy(

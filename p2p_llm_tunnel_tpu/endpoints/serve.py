@@ -23,6 +23,12 @@ import time
 from typing import AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from p2p_llm_tunnel_tpu.endpoints import http11
+from p2p_llm_tunnel_tpu.endpoints.resume import (
+    ResumeConfig,
+    ResumeExpired,
+    StreamRelay,
+    global_streams,
+)
 from p2p_llm_tunnel_tpu.protocol.frames import (
     DEADLINE_HEADER,  # noqa: F401  (re-exported: the serve-side surface)
     ERROR_CODE_HEADER,
@@ -35,6 +41,7 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     ProtocolError,
     RequestHeaders,
     ResponseHeaders,
+    ResumeFrame,
     TunnelMessage,
     encode_body_frames,
     parse_deadline_ms,
@@ -233,6 +240,7 @@ async def _coalesce(
 async def _handle_request(
     channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
     flow: FlowControl, peer_label: str = "",
+    resume_cfg: Optional[ResumeConfig] = None,
 ) -> None:
     t0 = time.monotonic()
     ctx = parse_trace_context(req.headers)
@@ -247,7 +255,8 @@ async def _handle_request(
         req.headers[TRACE_HEADER] = f"{ctx.trace_id}/{span}"
     try:
         flow.open(req.stream_id)
-        await _handle_request_inner(channel, backend, req, body, flow)
+        await _handle_request_inner(channel, backend, req, body, flow,
+                                    resume_cfg)
     except ChannelClosed:
         # Tunnel died while responding; the serve loop notices separately.
         log.debug("channel closed while responding to stream %d", req.stream_id)
@@ -273,7 +282,7 @@ async def _handle_request(
 
 async def _handle_request_inner(
     channel: Channel, backend: Backend, req: RequestHeaders, body: bytes,
-    flow: FlowControl,
+    flow: FlowControl, resume_cfg: Optional[ResumeConfig] = None,
 ) -> None:
     stream_id = req.stream_id
     global_metrics.inc("serve_requests_total")
@@ -356,9 +365,31 @@ async def _handle_request_inner(
             else:
                 log.warning("backend sent unknown %s %r; dropping",
                             ERROR_CODE_HEADER, v)
-    await channel.send(
-        TunnelMessage.res_headers(ResponseHeaders(stream_id, status, headers)).encode()
-    )
+    # Mid-stream continuity (ISSUE 13): token-stream responses (SSE and
+    # NDJSON — the two streaming vocabularies) get a resume token in the
+    # RES_HEADERS extension and their frames routed through a StreamRelay
+    # whose replay journal lets a reattaching proxy splice the stream at
+    # its delivered-byte offset after a tunnel reset.  Everything else
+    # keeps the exact legacy frame path (wire byte-identical).
+    ctype = ""
+    for k, v in headers.items():
+        if k.lower() == "content-type":
+            ctype = v.lower()
+    relay: Optional[StreamRelay] = None
+    rh = ResponseHeaders(stream_id, status, headers)
+    if (resume_cfg is not None and resume_cfg.enabled and status == 200
+            and shed_code is None
+            and ("text/event-stream" in ctype or "ndjson" in ctype)):
+        relay = StreamRelay(
+            resume_cfg.journal_bytes, resume_cfg.grace_s, global_streams,
+            trace_id=tctx.trace_id if tctx is not None else "",
+            parent_span=(tctx.span_id or None) if tctx is not None else None,
+        )
+        rh.resume = relay.token
+        rh.grace = resume_cfg.grace_s
+    await channel.send(TunnelMessage.res_headers(rh).encode())
+    if relay is not None:
+        relay.start(channel, stream_id, flow)
     agen = _coalesce(chunks)
 
     async def bounded(awaitable):
@@ -373,6 +404,13 @@ async def _handle_request_inner(
         return await asyncio.wait_for(awaitable, remaining)
 
     served_ok = True  # flipped by any mid-stream failure below
+    if relay is not None:
+        served_ok = await _relay_body(
+            relay, agen, bounded, deadline, stream_id, dl_ms, trace_timeout,
+        )
+        global_slo.record("availability", served_ok and status < 500)
+        log.debug("response %d complete: status=%d", stream_id, status)
+        return
     try:
         while True:
             try:
@@ -446,6 +484,84 @@ async def _handle_request_inner(
         "availability", served_ok and shed_code is None and status < 500
     )
     log.debug("response %d complete: status=%d", stream_id, status)
+
+
+async def _relay_body(
+    relay: StreamRelay, agen, bounded, deadline, stream_id: int,
+    dl_ms, trace_timeout,
+) -> bool:
+    """Drain the backend through a resumable StreamRelay (ISSUE 13).
+
+    The handler only ever touches the JOURNAL (relay.write blocks at the
+    cap — the stream's backpressure); the relay's pump owns every channel
+    send, so a mid-stream tunnel reset detaches the stream instead of
+    killing it and a later RES_RESUME splices the journal tail with no
+    interleaving hazard.  Returns served_ok (RES_END flushed cleanly).
+    The typed-error/timeout vocabulary matches the legacy frame path
+    exactly — when no resume happens the wire is the same conversation.
+    """
+    served_ok = True
+    try:
+        while True:
+            try:
+                chunk = await bounded(agen.__anext__())
+            except StopAsyncIteration:
+                break
+            await bounded(relay.write(chunk))
+        relay.close()
+    except asyncio.TimeoutError:
+        served_ok = False
+        if deadline is None:
+            log.error("upstream stream timed out for stream %d", stream_id)
+            global_metrics.inc("serve_upstream_errors_total")
+            relay.close((None, "upstream error: timeout"))
+        else:
+            log.warning("stream %d hit its %.0fms deadline mid-stream",
+                        stream_id, dl_ms)
+            global_metrics.inc("serve_timeouts_total")
+            trace_timeout("mid-stream")
+            relay.cut("timeout", "deadline exceeded")
+    except ResumeExpired:
+        # The stream died parked: the proxy's own grace timer has already
+        # fired the typed peer_lost terminal toward the client — nothing
+        # left to say, just stop generating (agen.aclose below).
+        return False
+    except Exception as e:
+        served_ok = False
+        log.error("upstream stream error for stream %d: %s", stream_id, e)
+        code = getattr(e, "tunnel_code", None)
+        if code == "timeout":
+            global_metrics.inc("serve_timeouts_total")
+            trace_timeout("backend")
+        relay.close((
+            code, str(e) if code is not None else f"upstream error: {e}",
+        ))
+    finally:
+        await agen.aclose()
+    try:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise asyncio.TimeoutError
+            flushed = await asyncio.wait_for(relay.wait_done(), remaining)
+        else:
+            flushed = await relay.wait_done()
+        return served_ok and flushed
+    except asyncio.TimeoutError:
+        # Deadline hit while the flush was parked or credit-starved:
+        # truncate NOW (same contract as the legacy path's bounded flow
+        # debit) and let the pump emit the typed frame if a channel is
+        # still attached — bounded by the grace window otherwise.
+        global_metrics.inc("serve_timeouts_total")
+        trace_timeout("mid-stream")
+        relay.cut("timeout", "deadline exceeded")
+        try:
+            await relay.wait_done()
+        except ResumeExpired:
+            pass
+        return False
+    except ResumeExpired:
+        return False
 
 
 async def _send_simple(
@@ -576,6 +692,19 @@ async def _send_healthz(
         # token rate, sheds) and the advisory Retry-After the 429 paths
         # are currently quoting — the numbers that say WHO is loading the
         # server and whether fairness is biting.
+        # ISSUE 13 observability: mid-stream continuity accounting — how
+        # many streams are parked in the grace window right now, resident
+        # replay-journal bytes (the memory cost of resumability), and how
+        # many resumes this process has served.  loadgen's post-run leak
+        # check asserts detached == 0 and replay_buffer_bytes == 0.
+        "streams": {
+            "detached": global_streams.count_detached(),
+            "resumable_live": global_streams.live_count(),
+            "replay_buffer_bytes": global_streams.replay_bytes(),
+            "resumes_total": int(
+                global_metrics.counter("serve_stream_resumes_total")
+            ),
+        },
         "tenants": global_metrics.tenant_snapshot(),
         "retry_after_s": {
             "engine": round(global_metrics.gauge("engine_retry_after_s"), 1),
@@ -596,6 +725,8 @@ async def run_serve(
     max_inflight: int = 0,
     drain: Optional[asyncio.Event] = None,
     drain_timeout: float = 0.0,
+    stream_grace_s: float = -1.0,
+    stream_journal_bytes: int = 0,
 ) -> None:
     """Run the provider side until the tunnel dies; raises to trigger retry.
 
@@ -614,9 +745,26 @@ async def run_serve(
     ``drain`` — a stream that never finishes during shutdown is exactly
     the wedge an operator needs the black box for), and the channel
     closes anyway.  0 keeps the historical wait-forever behavior.
+
+    ``stream_grace_s`` / ``stream_journal_bytes`` are the mid-stream
+    continuity knobs (ISSUE 13): token streams (SSE/NDJSON) carry a
+    resume token, their bytes are journaled (bounded per stream by the
+    journal cap), and a stream whose channel dies mid-flight PARKS for
+    the grace window — engine generation still running — until a
+    RES_RESUME on a fresh channel splices the journal at the proxy's
+    delivered offset, or the window expires and the generation is
+    cancelled (today's typed ``peer_lost`` outcome, strictly narrowed).
+    Defaults: resume.DEFAULT_GRACE_S / DEFAULT_JOURNAL_BYTES;
+    ``stream_grace_s=0`` disables resume entirely (legacy wire).
     """
     if backend is None:
         backend = http_backend(upstream_url, advertise_prefix)
+    resume_cfg = ResumeConfig(
+        grace_s=(stream_grace_s if stream_grace_s >= 0
+                 else ResumeConfig().grace_s),
+        journal_bytes=(stream_journal_bytes if stream_journal_bytes > 0
+                       else ResumeConfig().journal_bytes),
+    )
 
     if not channel.connected.is_set():
         log.info("waiting for channel to be ready...")
@@ -672,6 +820,7 @@ async def run_serve(
         )
         deadline = (time.monotonic() + drain_timeout
                     if drain_timeout > 0 else None)
+        timed_out = False
         while request_tasks:
             timeout = None
             if deadline is not None:
@@ -679,19 +828,42 @@ async def run_serve(
             await asyncio.wait(set(request_tasks), timeout=timeout)
             if (request_tasks and deadline is not None
                     and time.monotonic() >= deadline):
-                log.error(
-                    "drain timeout: %d stream(s) still unfinished after "
-                    "%.1fs; capturing postmortem and closing anyway",
-                    len(request_tasks), drain_timeout,
-                )
-                global_blackbox.capture(
-                    "drain",
-                    attribution=(
-                        f"{len(request_tasks)} stream(s) unfinished "
-                        f"after {drain_timeout:.1f}s drain budget"
-                    ),
-                )
+                timed_out = True
                 break
+        # Detached streams (ISSUE 13) are NOT in request_tasks — they
+        # belong to the registry and its grace windows.  A drain must
+        # either flush them (reattach-and-finish, or grace expiry frees
+        # them — both bounded by the grace window) inside the budget, or
+        # NAME them in the postmortem attribution: silently extending the
+        # drain on a parked stream, or silently vanishing one, are both
+        # wrong.  Scoped to THIS session (streams attached to this
+        # channel + unowned detached ones): a multi-session process must
+        # not have one peer's drain block on another peer's healthy
+        # streams.
+        while not timed_out and global_streams.live_count_for(channel) > 0:
+            if deadline is not None and time.monotonic() >= deadline:
+                timed_out = True
+                break
+            await asyncio.sleep(0.05)
+        if timed_out:
+            abandoned = global_streams.live_tokens_for(channel)
+            attribution = (
+                f"{len(request_tasks)} stream(s) unfinished "
+                f"after {drain_timeout:.1f}s drain budget"
+            )
+            if abandoned:
+                attribution += (
+                    f"; {len(abandoned)} resumable stream(s) abandoned "
+                    f"(detached mid-grace or still flushing): "
+                    f"{', '.join(abandoned)}"
+                )
+            log.error(
+                "drain timeout: %d stream(s) still unfinished (+%d "
+                "detached) after %.1fs; capturing postmortem and closing "
+                "anyway", len(request_tasks),
+                len(abandoned), drain_timeout,
+            )
+            global_blackbox.capture("drain", attribution=attribution)
         log.info("drain complete, closing tunnel")
         channel.close()
 
@@ -715,7 +887,7 @@ async def run_serve(
             try:
                 await _serve_dispatch(
                     channel, backend, flow, pending, request_tasks,
-                    max_inflight, drain, msg, peer_label,
+                    max_inflight, drain, msg, peer_label, resume_cfg,
                 )
             except ChannelClosed:
                 # The drainer can close the channel between our recv and a
@@ -729,8 +901,17 @@ async def run_serve(
         ping_task.cancel()
         if drain_task is not None:
             drain_task.cancel()
+        # Mid-stream continuity (ISSUE 13): streams attached to this dying
+        # channel PARK in the detached-stream registry (engine generation
+        # still running, journal still filling) instead of being killed —
+        # their handler tasks now belong to the registry's grace windows,
+        # so this session must not cancel them.  Everything else (plain
+        # responses, pre-stream dispatches) is cancelled exactly as
+        # before.
+        parked = global_streams.detach_channel(channel)
         for t in request_tasks:
-            t.cancel()
+            if t not in parked:
+                t.cancel()
 
 
 async def _serve_dispatch(
@@ -743,6 +924,7 @@ async def _serve_dispatch(
     drain: Optional[asyncio.Event],
     msg: TunnelMessage,
     peer_label: str = "",
+    resume_cfg: Optional[ResumeConfig] = None,
 ) -> None:
     """Handle one decoded inbound frame for the serve loop.
 
@@ -878,15 +1060,60 @@ async def _serve_dispatch(
                 return
             task = asyncio.create_task(
                 _handle_request(channel, backend, req, bytes(body), flow,
-                                peer_label)
+                                peer_label, resume_cfg)
             )
             request_tasks.add(task)
             task.add_done_callback(request_tasks.discard)
     elif msg.msg_type == MessageType.FLOW:
         try:
-            flow.grant(msg.stream_id, msg.flow_credit())
+            credit = msg.flow_credit()
         except ProtocolError as e:
             log.warning("bad FLOW frame: %s", e)
+            return
+        flow.grant(msg.stream_id, credit)
+        # A FLOW grant is also the delivered-bytes ack the replay journal
+        # trims on (the proxy grants as its HTTP client consumes): route
+        # the watermark to the stream's relay, if it has one.
+        global_streams.on_flow(channel, msg.stream_id, credit)
+    elif msg.msg_type == MessageType.RES_RESUME:
+        # Mid-stream continuity (ISSUE 13): a reattaching proxy asks for
+        # a parked stream spliced at its delivered-byte offset onto THIS
+        # stream id.  A resume this peer cannot honor — unknown/expired
+        # token, trimmed offset, stale epoch — answers with the typed
+        # peer_lost frame the proxy's grace timer would have minted
+        # anyway: the failure mode narrows, it never changes shape.
+        try:
+            rf = ResumeFrame.from_json(msg.payload)
+        except ProtocolError as e:
+            log.warning("bad RES_RESUME payload: %s", e)
+            return
+        relay = global_streams.get(rf.token)
+        if relay is None:
+            await channel.send(TunnelMessage.typed_error(
+                msg.stream_id, "peer_lost",
+                "unknown or expired resume token",
+            ).encode())
+            return
+        flow.open(msg.stream_id)  # tunnelcheck: disable=TC15  released by StreamRelay: detach/_finish/_fail each close the attachment's flow entry on every pump exit path (the failure branch below closes it inline)
+        ok, reason = relay.attach(
+            channel, msg.stream_id, flow, rf.offset, rf.epoch,
+        )
+        if not ok:
+            flow.close(msg.stream_id)
+            log.warning("refusing resume of %s: %s", rf.token, reason)
+            await channel.send(TunnelMessage.typed_error(
+                msg.stream_id, "peer_lost", f"cannot resume: {reason}",
+            ).encode())
+    elif msg.msg_type == MessageType.ERROR:
+        # The proxy cancelled one of OUR response streams (ISSUE 13: it
+        # abandoned a resume probe after this peer had already accepted,
+        # or gave up on a resumed attachment inside its grace window) —
+        # park the relay again instead of pumping frames nobody demuxes,
+        # which would wedge the stream at flow-credit exhaustion forever.
+        # Stream ids with no attached relay keep the legacy ignore.
+        if global_streams.detach_attachment(channel, msg.stream_id):
+            log.info("proxy cancelled resumed stream %d: %s; re-parking",
+                     msg.stream_id, msg.payload.decode("utf-8", "replace"))
     elif msg.msg_type == MessageType.PING:
         await channel.send(TunnelMessage.pong().encode())
     elif msg.msg_type == MessageType.PONG:

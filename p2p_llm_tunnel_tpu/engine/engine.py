@@ -3095,6 +3095,13 @@ class InferenceEngine:
                 self._prefix.used_blocks if self._prefix is not None else 0
             ),
             cold_compiles=global_compile_watch.cold_total - cold0,
+            # Detached-stream count (ISSUE 13): how many of this
+            # iteration's generations are filling replay journals with no
+            # channel attached — a postmortem's flight tail shows whether
+            # the engine was working for parked clients when it wedged.
+            streams_detached=int(
+                global_metrics.gauge("serve_streams_detached")
+            ),
             admit_ms=round((t_admit - it_t0) * 1000.0, 3),
             prefill_ms=round((t_prefill - t_admit) * 1000.0, 3),
             dispatch_ms=round((t_dispatch - t_prefill) * 1000.0, 3),

@@ -5,7 +5,10 @@ Frame layout (wire-compatible with reference tunnel/src/protocol.rs:148-172):
     [type: u8][stream_id: u32 big-endian][payload: bytes]
 
 Control payloads (Hello/Agree/Req-/ResHeaders/Error) are UTF-8 JSON; body
-payloads are raw bytes. Eleven message types (reference protocol.rs:88-100).
+payloads are raw bytes. Eleven message types match the reference
+(protocol.rs:88-100); FLOW (per-stream credit) and RES_RESUME/RES_RESUMED
+(mid-stream continuity, ISSUE 13) are protocol-v2 extensions the HELLO/
+AGREE negotiation was designed to allow.
 
 The handshake (reference protocol.rs:17-81): the proxy peer sends HELLO
 advertising a protocol name, a [min_version, max_version] range, and a feature
@@ -103,6 +106,16 @@ class MessageType(enum.IntEnum):
     RES_HEADERS = 20
     RES_BODY = 21
     RES_END = 22
+    #: Mid-stream continuity (ISSUE 13): the proxy asks a serve peer to
+    #: splice a parked stream's replay journal at its delivered-byte
+    #: offset onto THIS stream id; payload = JSON (token, offset, epoch).
+    RES_RESUME = 23
+    #: The serve peer's acceptance: journal bytes >= offset follow as
+    #: ordinary RES_BODY frames on the same stream id, then RES_END.
+    #: A resume the serve peer cannot honor (unknown/expired token,
+    #: trimmed offset) is answered with a typed ``peer_lost`` ERROR
+    #: frame instead — never silence.
+    RES_RESUMED = 24
     FLOW = 30  # per-stream credit grant: payload = u32 BE byte count
     ERROR = 99
 
@@ -231,20 +244,33 @@ class RequestHeaders:
 
 @dataclass
 class ResponseHeaders:
-    """RES_HEADERS JSON payload (reference protocol.rs:132-136)."""
+    """RES_HEADERS JSON payload (reference protocol.rs:132-136).
+
+    ``resume``/``grace`` are the OPTIONAL mid-stream-continuity extension
+    (ISSUE 13): for a resumable stream the serve peer mints a resume
+    token and advertises how long a detached stream parks before its
+    engine generation is cancelled.  Omitted from the wire when empty —
+    non-resumable responses stay byte-identical to the reference — and
+    carried as payload extension keys (unknown-key-tolerant JSON), so
+    legacy peers relay the response unchanged and never see the token.
+    """
 
     stream_id: int
     status: int
     headers: Dict[str, str] = field(default_factory=dict)
+    resume: str = ""
+    grace: float = 0.0
 
     def to_json(self) -> bytes:
-        return json.dumps(
-            {
-                "stream_id": self.stream_id,
-                "status": self.status,
-                "headers": self.headers,
-            }
-        ).encode()
+        obj = {
+            "stream_id": self.stream_id,
+            "status": self.status,
+            "headers": self.headers,
+        }
+        if self.resume:
+            obj["resume"] = self.resume
+            obj["grace"] = self.grace
+        return json.dumps(obj).encode()
 
     @classmethod
     def from_json(cls, data: bytes) -> "ResponseHeaders":
@@ -254,9 +280,66 @@ class ResponseHeaders:
                 stream_id=int(obj["stream_id"]),
                 status=int(obj["status"]),
                 headers=dict(obj["headers"]),
+                resume=str(obj.get("resume", "")),
+                grace=float(obj.get("grace", 0.0) or 0.0),
             )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             raise ProtocolError(f"bad RES_HEADERS payload: {e}") from e
+
+
+#: Longest resume token accepted off the wire: tokens are serve-minted
+#: (short), so anything longer is a malformed or hostile frame — bounding
+#: it keeps the detached-stream registry lookup key small.
+MAX_RESUME_TOKEN_LEN = 64
+
+
+@dataclass
+class ResumeFrame:
+    """RES_RESUME / RES_RESUMED JSON payload (ISSUE 13).
+
+    ``token`` names the parked stream in the serve peer's detached-stream
+    registry; ``offset`` is an absolute response-body byte offset — the
+    proxy sends the bytes it has DELIVERED to its HTTP client, and the
+    serve peer splices its replay journal at exactly that byte, so the
+    client-observed body is byte-identical to an uninterrupted run.
+    ``epoch`` counts successful reattachments: the proxy echoes the last
+    epoch it saw (0 for the original attachment) and the serve peer
+    answers with the incremented value, so a stale or duplicate
+    RES_RESUME can never splice a stream twice.
+    """
+
+    stream_id: int
+    token: str
+    offset: int
+    epoch: int = 0
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "stream_id": self.stream_id,
+                "token": self.token,
+                "offset": self.offset,
+                "epoch": self.epoch,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "ResumeFrame":
+        try:
+            obj = json.loads(data)
+            token = str(obj["token"])
+            offset = int(obj["offset"])
+            epoch = int(obj.get("epoch", 0))
+            if len(token) > MAX_RESUME_TOKEN_LEN or offset < 0 or epoch < 0:
+                raise ValueError("token/offset/epoch out of bounds")
+            return cls(
+                stream_id=int(obj["stream_id"]),
+                token=token,
+                offset=offset,
+                epoch=epoch,
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad RES_RESUME payload: {e}") from e
 
 
 @dataclass
@@ -355,6 +438,14 @@ class TunnelMessage:
         if text.startswith("[") and "]" in text:
             return text[1 : text.index("]")]
         return None
+
+    @classmethod
+    def res_resume(cls, frame: ResumeFrame) -> "TunnelMessage":
+        return cls(MessageType.RES_RESUME, frame.stream_id, frame.to_json())
+
+    @classmethod
+    def res_resumed(cls, frame: ResumeFrame) -> "TunnelMessage":
+        return cls(MessageType.RES_RESUMED, frame.stream_id, frame.to_json())
 
     @classmethod
     def flow(cls, stream_id: int, credit: int) -> "TunnelMessage":

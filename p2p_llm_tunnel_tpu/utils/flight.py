@@ -86,6 +86,11 @@ FLIGHT_SCHEMA: Dict[str, str] = {
     "waiters": "requests parked behind an in-flight shared-prefix owner",
     "prefix_blocks_used": "prefix-pool blocks in use (0 when the pool is off)",
     "cold_compiles": "mid-serve cold compiles detected during this iteration",
+    "streams_detached": (
+        "streams parked in the detached-stream registry's grace window "
+        "at iteration end (ISSUE 13; nonzero while the engine is "
+        "generating into replay journals with no channel attached)"
+    ),
     "admit_ms": "expire + admission host wall (waived)",
     "prefill_ms": "prefill dispatch host wall (waived)",
     "dispatch_ms": "decode-burst dispatch host wall (waived)",

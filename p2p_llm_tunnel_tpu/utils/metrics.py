@@ -94,6 +94,29 @@ METRICS_CATALOG: Dict[str, str] = {
     "serve_timeouts_total": "requests cut by x-tunnel-deadline-ms (counter)",
     "serve_upstream_errors_total": "backend failures before headers (counter)",
     "serve_shed_total": "requests shed by admission control or drain (counter)",
+    # -- mid-stream continuity (ISSUE 13) --------------------------------
+    "serve_stream_resumes_total": (
+        "parked streams spliced onto a fresh channel by RES_RESUME "
+        "(counter; one per successful mid-stream reattach — the chaos "
+        "proof asserts exactly 1 under a seeded kill)"
+    ),
+    "serve_streams_detached": (
+        "streams currently parked in the detached-stream registry's "
+        "grace window — channel died, engine generation still running, "
+        "replay journal still filling (gauge; nonzero after every client "
+        "finished is a leak)"
+    ),
+    "serve_replay_buffer_bytes": (
+        "resident response bytes across every replay journal (gauge; "
+        "bounded per stream by --stream-journal-bytes — the memory cost "
+        "of resumability, and the journal bound the bw= chaos row "
+        "asserts under a lagging client)"
+    ),
+    "proxy_stream_resume_ms": (
+        "mid-stream link death -> RES_RESUMED accepted on a recovered "
+        "peer, for streams that reattached instead of surfacing the "
+        "typed peer_lost terminal (histogram, ms)"
+    ),
     # -- proxy endpoint --------------------------------------------------
     "proxy_requests_total": "HTTP requests entering the tunnel (counter)",
     "proxy_body_bytes_total": "response body bytes relayed to clients (counter)",

@@ -74,6 +74,18 @@ SPAN_CATALOG: Dict[str, str] = {
         "request refused because the server is draining: 503 + typed "
         "[draining] (instant)"
     ),
+    "serve.stream_detach": (
+        "a resumable stream's channel died mid-flight: the stream parks "
+        "in the detached-stream registry for the grace window, engine "
+        "generation still running (instant; attrs carry token, sent "
+        "offset, grace)"
+    ),
+    "serve.stream_resume": (
+        "a parked stream was spliced onto a fresh channel at the "
+        "proxy's delivered-byte offset via RES_RESUME (instant; attrs "
+        "carry token, offset, epoch — the pair-closer of "
+        "serve.stream_detach)"
+    ),
     # -- engine ----------------------------------------------------------
     "engine.request": (
         "submit -> stream end for one generation (parent of the "

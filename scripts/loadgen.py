@@ -115,6 +115,16 @@ class ReqResult:
 #: read as "victim N/N ok" and pass the gate.
 SHED_FINISH_REASONS = frozenset({"tenant_overlimit", "busy", "draining"})
 
+#: Typed TERMINAL error events a stream can end with (ISSUE 13: the proxy
+#: emits data: {"error": {code, ...}} when a mid-stream peer loss could
+#: not be resumed inside the grace window).  These are failures, not
+#: clean completions — and note what is absent: a stream that RESUMED
+#: mid-run completes byte-identically with no marker at all, so it
+#: counts "ok" (and never "stuck": the only stuck criteria are the
+#: whole-run --timeout and client crashes, so a stream parked in the
+#: grace window is simply a slower success).
+TERMINAL_ERROR_CODES = frozenset({"peer_lost", "tunnel_reset"})
+
 
 async def one_request(host: str, port: int, tenant: str, rid: str,
                       prompt: str, max_tokens: int) -> ReqResult:
@@ -160,6 +170,11 @@ async def one_request(host: str, port: int, tenant: str, rid: str,
                 if data == b"[DONE]":
                     continue
                 payload = json.loads(data)
+                err = payload.get("error")
+                if isinstance(err, dict) and err.get("code"):
+                    # Typed terminal event: the stream is over, failed.
+                    out.finish = str(err["code"])
+                    continue
                 choices = payload.get("choices") or []
                 if not choices:
                     continue
@@ -173,9 +188,14 @@ async def one_request(host: str, port: int, tenant: str, rid: str,
         if status == 200:
             # A 200 is not automatically a success: a stream displaced
             # after admission ends with a typed shed finish_reason on an
-            # otherwise-clean SSE body.
-            out.outcome = ("shed" if out.finish in SHED_FINISH_REASONS
-                           else "ok")
+            # otherwise-clean SSE body, and an unresumable mid-stream
+            # peer loss ends with a typed terminal error event.
+            if out.finish in SHED_FINISH_REASONS:
+                out.outcome = "shed"
+            elif out.finish in TERMINAL_ERROR_CODES:
+                out.outcome = "error"
+            else:
+                out.outcome = "ok"
         elif status == 429:
             out.outcome = "shed"
         else:
@@ -247,6 +267,9 @@ POLL_KEYS = (
     "engine_queue_depth",
     "engine_batch_occupancy",
     "proxy_requests_total",
+    "serve_stream_resumes_total",
+    "serve_streams_detached",
+    "serve_replay_buffer_bytes",
 )
 POLL_QUANTILES = {
     "engine_ttft_ms": ("0.5", "0.99"),
@@ -376,6 +399,14 @@ async def run_load(args) -> dict:
     t0 = time.monotonic()
     timeline: List[dict] = []
     poller = None
+    # Streams that resume mid-run complete byte-identically with no
+    # client-visible marker — the serve-side counter is the only honest
+    # source for the `resumed` summary column (ISSUE 13).
+    resumes0 = None
+    pre_text = await fetch_metrics(args.host, args.port, "/metrics", 5.0)
+    if pre_text is not None:
+        resumes0 = parse_metrics_sample(pre_text).get(
+            "serve_stream_resumes_total")
     if args.metrics_poll > 0:
         poller = asyncio.create_task(metrics_poller(
             args.host, args.port, args.metrics_poll, t0, timeline,
@@ -417,18 +448,33 @@ async def run_load(args) -> dict:
     if not args.no_healthz:
         await asyncio.sleep(0.5)  # let the server settle before leak check
         healthz = await fetch_healthz(args.host, args.port)
+    resumed = None
+    post_text = await fetch_metrics(args.host, args.port, "/metrics", 5.0)
+    if post_text is not None and resumes0 is not None:
+        resumes1 = parse_metrics_sample(post_text).get(
+            "serve_stream_resumes_total")
+        if resumes1 is not None:
+            resumed = int(resumes1 - resumes0)
+    streams_hz = (healthz or {}).get("streams") or {}
     out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
         "stuck_tasks": stuck,
+        # Streams that reattached mid-run after a tunnel reset (ISSUE
+        # 13): byte-identical to the client, so only the server counter
+        # can report them; None = the scrape was unavailable.
+        "resumed": resumed,
         "tenants": tenant_rows(per_tenant),
-        # Leak check: in-flight and occupancy must be back to zero once
-        # every client is done — a nonzero value here is a leaked slot.
+        # Leak check: in-flight, occupancy, AND the detached-stream
+        # registry must be back to zero once every client is done — a
+        # nonzero value here is a leaked slot or a leaked replay journal.
         "healthz_after": None if healthz is None else {
             "status": healthz.get("status"),
             "inflight_requests": healthz.get("inflight_requests"),
             "queue_depth": healthz.get("queue_depth"),
             "slot_occupancy": healthz.get("slot_occupancy"),
+            "streams_detached": streams_hz.get("detached"),
+            "replay_buffer_bytes": streams_hz.get("replay_buffer_bytes"),
             "tenants": healthz.get("tenants"),
             "retry_after_s": healthz.get("retry_after_s"),
         },
@@ -548,7 +594,8 @@ def main(argv=None) -> int:
         hz = out.get("healthz_after")
         leaked = hz is None or any(
             hz.get(k) or 0
-            for k in ("inflight_requests", "queue_depth", "slot_occupancy")
+            for k in ("inflight_requests", "queue_depth", "slot_occupancy",
+                      "streams_detached", "replay_buffer_bytes")
         )
         if leaked:
             detail = ("unreachable" if hz is None
@@ -564,6 +611,9 @@ def main(argv=None) -> int:
                 f"{r['ttft_p999_ms']} ms",
                 file=sys.stderr,
             )
+        if out.get("resumed") is not None:
+            print(f"# resumed mid-run (tunnel resets survived): "
+                  f"{out['resumed']}", file=sys.stderr)
     return 1 if (total_stuck or leaked) else 0
 
 

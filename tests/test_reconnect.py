@@ -173,10 +173,18 @@ def _fabric_kill_run(seed: int) -> dict:
             port = await asyncio.wait_for(ready, 5)
             base = f"http://127.0.0.1:{port}"
 
-            # peer0 joins first, under the seeded kill schedule.
+            # peer0 joins first, under the seeded kill schedule.  Resume
+            # is disabled (stream_grace_s=0): this test pins the THIRD
+            # tier of the failover contract — the typed peer_lost
+            # terminal — and in a single-process fabric every serve peer
+            # shares the detached-stream registry, so the mid-stream
+            # victim would otherwise resume onto a survivor and park on
+            # the never-set `hold` gate forever (tier 2 has its own
+            # seeded suite: tests/test_resume.py).
             serve0, proxy0 = loopback_pair()
             serve_tasks.append(asyncio.create_task(
-                run_serve(serve0, backend=make_backend("peer0"))))
+                run_serve(serve0, backend=make_backend("peer0"),
+                          stream_grace_s=0)))
             chaos0 = ChaosChannel(
                 proxy0, ChaosSpec.parse(f"kill={_KILL_AFTER},seed={seed}"))
             await state.admit(chaos0, peer_id="peer0")
@@ -193,7 +201,8 @@ def _fabric_kill_run(seed: int) -> dict:
             for i in (1, 2):
                 s_ch, p_ch = loopback_pair()
                 serve_tasks.append(asyncio.create_task(
-                    run_serve(s_ch, backend=make_backend(f"peer{i}"))))
+                    run_serve(s_ch, backend=make_backend(f"peer{i}"),
+                              stream_grace_s=0)))
                 await state.admit(p_ch, peer_id=f"peer{i}")
 
             # The herd: 5 gated requests dispatched one at a time.  The

@@ -1,0 +1,613 @@
+"""Mid-stream continuity (ISSUE 13): resumable token streams across
+tunnel resets.
+
+The contract under test, end to end:
+
+- a seeded ``kill=`` chaos schedule murders the channel carrying an SSE
+  stream MID-FLIGHT; the serve side parks the stream (engine generation
+  uncancelled, replay journal filling), the proxy holds the client
+  response open, and a re-dialed peer splices the journal at exactly the
+  delivered-byte offset — the client-observed body is BYTE-IDENTICAL to
+  an unfaulted run, with exactly one ``serve_stream_resumes_total``
+  increment, identical across two seeded runs;
+- with resume disabled (grace 0) or the grace window expired, the
+  behavior is exactly today's typed ``peer_lost`` terminal — the failure
+  mode narrows, it never changes shape;
+- the replay journal is a hard per-stream memory bound, held under a
+  ``bw=`` slow-reader fault composed with the kill;
+- a draining serve either flushes detached journals inside the
+  ``--drain-timeout`` budget or NAMES the abandoned streams in the
+  drain postmortem attribution;
+- registrations leak nothing: post-run the detached gauge and replay
+  bytes are zero (loadgen's /healthz leak check reads the same section).
+"""
+
+import asyncio
+import json
+import os
+import random
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints.http11 import http_request
+from p2p_llm_tunnel_tpu.endpoints.proxy import ProxyState, run_proxy_fabric
+from p2p_llm_tunnel_tpu.endpoints.resume import (
+    ReplayJournal,
+    global_streams,
+)
+from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    Agree,
+    Hello,
+    MessageType,
+    ResponseHeaders,
+    ResumeFrame,
+    TunnelMessage,
+)
+from p2p_llm_tunnel_tpu.transport import loopback_pair
+from p2p_llm_tunnel_tpu.transport.chaos import ChaosChannel, ChaosSpec
+from p2p_llm_tunnel_tpu.utils.flight import global_blackbox
+from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+SEED = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+
+#: Serve-side chaos kill index: sends are AGREE(0), RES_HEADERS(1),
+#: RES_BODY "start"(2) — the gate guarantees those land, so kill at 6
+#: always fires MID-BODY, a few coalesced frames into the tail.
+KILL_AFTER = 6
+
+
+# ---------------------------------------------------------------------------
+# units: replay journal + wire codec
+# ---------------------------------------------------------------------------
+
+def test_replay_journal_offsets_trim_and_meter():
+    seen = []
+    j = ReplayJournal(meter=seen.append)
+    j.append(b"abcdef")
+    j.append(b"ghij")
+    assert (j.base, j.end, j.size) == (0, 10, 10)
+    assert j.slice_from(3, 4) == b"defg"
+    j.trim_to(4)
+    assert (j.base, j.end, j.size) == (4, 10, 6)
+    assert j.covers(4) and j.covers(10) and not j.covers(3)
+    assert j.slice_from(4) == b"efghij"
+    # trim below base is a no-op; truncate drops the unsent tail
+    j.trim_to(2)
+    assert j.base == 4
+    j.truncate_to(7)
+    assert (j.base, j.end) == (4, 7) and j.slice_from(4) == b"efg"
+    assert sum(seen) == j.size  # meter deltas reconcile with residency
+
+
+def test_resume_frame_codec_roundtrip_and_bounds():
+    rf = ResumeFrame(7, "rs-abc", 4096, epoch=2)
+    back = ResumeFrame.from_json(TunnelMessage.res_resume(rf).payload)
+    assert (back.token, back.offset, back.epoch) == ("rs-abc", 4096, 2)
+    from p2p_llm_tunnel_tpu.protocol.frames import ProtocolError
+
+    with pytest.raises(ProtocolError):
+        ResumeFrame.from_json(json.dumps(
+            {"stream_id": 1, "token": "x" * 100, "offset": 0}
+        ).encode())
+    with pytest.raises(ProtocolError):
+        ResumeFrame.from_json(json.dumps(
+            {"stream_id": 1, "token": "t", "offset": -1}
+        ).encode())
+
+
+def test_response_headers_resume_extension_is_wire_invisible_when_off():
+    """A non-resumable response's RES_HEADERS payload must be EXACTLY the
+    legacy key set (reference peers see an unchanged wire); the extension
+    keys appear only when a token was minted, and unknown-key-tolerant
+    parsing round-trips both."""
+    legacy = ResponseHeaders(3, 200, {"a": "b"})
+    assert set(json.loads(legacy.to_json())) == {
+        "stream_id", "status", "headers"
+    }
+    ext = ResponseHeaders(3, 200, {"a": "b"}, resume="rs-x", grace=5.0)
+    obj = json.loads(ext.to_json())
+    assert obj["resume"] == "rs-x" and obj["grace"] == 5.0
+    back = ResponseHeaders.from_json(ext.to_json())
+    assert (back.resume, back.grace) == ("rs-x", 5.0)
+    assert ResponseHeaders.from_json(legacy.to_json()).resume == ""
+
+
+# ---------------------------------------------------------------------------
+# harness: 1-peer fabric, serve-side seeded kill, optional re-admit
+# ---------------------------------------------------------------------------
+
+def _gauges_clean() -> dict:
+    return {
+        "detached": int(global_metrics.gauge("serve_streams_detached")),
+        "replay_bytes": int(
+            global_metrics.gauge("serve_replay_buffer_bytes")
+        ),
+        "live": global_streams.live_count(),
+    }
+
+
+async def _cancel_all(*tasks: "asyncio.Task") -> None:
+    """Teardown that survives the Python 3.10 wait_for cancellation
+    swallow: a task cancelled at the exact moment its awaited future
+    completes (run_serve's handshake recv under a racing re-admit) keeps
+    running — so re-cancel until everything is done."""
+    for _ in range(5):
+        for t in tasks:
+            t.cancel()
+        done, pending = await asyncio.wait(set(tasks), timeout=2.0)
+        if not pending:
+            return
+    raise AssertionError(f"tasks survived repeated cancellation: {pending}")
+
+
+async def _drain_settled(timeout: float = 5.0) -> None:
+    """Wait for the registry to empty (grace expiries included)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while global_streams.live_count() > 0:
+        assert asyncio.get_running_loop().time() < deadline, \
+            "detached-stream registry never drained"
+        await asyncio.sleep(0.02)
+
+
+def _kill_run(seed: int, kill: int, readmit: bool, grace_s: float = 3.0,
+              journal_bytes: int = 512 * 1024, n_events: int = 30,
+              chaos_extra: str = "", sample_journal: bool = False) -> dict:
+    """One seeded mid-stream-kill run; returns the outcome record two
+    seeded runs must agree on."""
+
+    async def main():
+        random.seed(seed)
+        state = ProxyState(fabric=True)
+        gate = asyncio.Event()
+
+        async def backend(req, body):
+            async def sse():
+                yield b"data: start\n\n"
+                await gate.wait()
+                for i in range(n_events):
+                    yield f"data: tok-{i}\n\n".encode()
+                    await asyncio.sleep(0)
+
+            return 200, {"content-type": "text/event-stream"}, sse()
+
+        def serve_once(channel):
+            return run_serve(channel, backend=backend,
+                             stream_grace_s=grace_s,
+                             stream_journal_bytes=journal_bytes)
+
+        ready = asyncio.get_running_loop().create_future()
+        listener = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+        serve_tasks = []
+        helpers = []
+        r0 = global_metrics.counter("serve_stream_resumes_total")
+        try:
+            port = await asyncio.wait_for(ready, 5)
+            serve0, proxy0 = loopback_pair()
+            ch = serve0
+            if kill:
+                spec = f"kill={kill},seed={seed}"
+                if chaos_extra:
+                    spec += "," + chaos_extra
+                ch = ChaosChannel(serve0, ChaosSpec.parse(spec))
+            serve_tasks.append(asyncio.create_task(serve_once(ch)))
+            await state.admit(proxy0, peer_id="peer0")
+
+            r = await http_request(
+                "GET", f"http://127.0.0.1:{port}/sse", timeout=20)
+            assert r.status == 200
+            it = r.iter_chunks()
+            first = await it.__anext__()
+            assert b"start" in first
+            gate.set()
+
+            async def readmitter():
+                while "peer0" in state.peers:
+                    await asyncio.sleep(0.01)
+                s2, p2 = loopback_pair()
+                serve_tasks.append(asyncio.create_task(serve_once(s2)))
+                await state.admit(p2, peer_id="peer0")
+
+            if kill and readmit:
+                helpers.append(asyncio.create_task(readmitter()))
+
+            max_journal = 0
+
+            async def journal_sampler():
+                nonlocal max_journal
+                while True:
+                    max_journal = max(max_journal, int(
+                        global_metrics.gauge("serve_replay_buffer_bytes")
+                    ))
+                    await asyncio.sleep(0.002)
+
+            if sample_journal:
+                helpers.append(asyncio.create_task(journal_sampler()))
+
+            body = first
+            async for c in it:
+                body += c
+            await _drain_settled()
+            return {
+                "body": body,
+                "resumes": int(global_metrics.counter(
+                    "serve_stream_resumes_total") - r0),
+                "resume_ms_recorded": global_metrics.percentile(
+                    "proxy_stream_resume_ms", 50) > 0.0,
+                "clean": _gauges_clean(),
+                "max_journal": max_journal,
+            }
+        finally:
+            await _cancel_all(listener, *serve_tasks, *helpers)
+
+    return asyncio.run(asyncio.wait_for(main(), 30))
+
+
+# ---------------------------------------------------------------------------
+# chaos proof: byte-identical resume, exactly once, seeded-deterministic
+# ---------------------------------------------------------------------------
+
+def test_midstream_kill_resume_byte_identical_seeded():
+    """Seeded kill= mid-stream with recovery inside the grace window →
+    the client receives a byte-identical complete stream (vs an unfaulted
+    run) with exactly ONE resume, identical across two seeded runs, and
+    the detached registry + replay buffers released afterward."""
+    baseline = _kill_run(SEED, kill=0, readmit=False)
+    one = _kill_run(SEED, kill=KILL_AFTER, readmit=True)
+    two = _kill_run(SEED, kill=KILL_AFTER, readmit=True)
+    assert one == two, f"seeded runs diverged:\n{one}\n{two}"
+    assert one["body"] == baseline["body"]
+    assert one["resumes"] == 1
+    assert one["resume_ms_recorded"]
+    assert one["clean"] == {"detached": 0, "replay_bytes": 0, "live": 0}
+    assert baseline["resumes"] == 0
+
+
+def test_midstream_kill_grace_expiry_is_typed_peer_lost():
+    """The grace-expiry twin: the peer never comes back, so after the
+    window the stream ends with EXACTLY today's typed peer_lost terminal
+    event — the failure mode is narrowed, never swapped — and the parked
+    generation is cancelled (registry drains to zero)."""
+    out = _kill_run(SEED, kill=KILL_AFTER, readmit=False, grace_s=0.4)
+    tail = out["body"].split(b"data: ")[-1]
+    event = json.loads(tail)
+    assert event["error"]["code"] == "peer_lost"
+    assert out["resumes"] == 0
+    assert out["clean"] == {"detached": 0, "replay_bytes": 0, "live": 0}
+
+
+def test_midstream_kill_resume_disabled_is_legacy_path():
+    """--stream-grace-s 0 disables resume wholesale: no token on the
+    wire, and a mid-stream kill is immediately today's typed peer_lost."""
+    out = _kill_run(SEED, kill=KILL_AFTER, readmit=True, grace_s=0.0)
+    event = json.loads(out["body"].split(b"data: ")[-1])
+    assert event["error"]["code"] == "peer_lost"
+    assert out["resumes"] == 0
+
+
+def test_journal_bound_holds_under_slow_reader_with_kill():
+    """kill= composed with the bw= slow-reader fault and a TINY journal
+    cap: the stream still resumes byte-identically, and the replay buffer
+    gauge never exceeds cap + one coalesced chunk — the journal is a hard
+    memory bound under a lagging client, not an unbounded buffer."""
+    from p2p_llm_tunnel_tpu.protocol.frames import MAX_BODY_CHUNK
+
+    cap = 4096
+    baseline = _kill_run(SEED, kill=0, readmit=False, n_events=120)
+    out = _kill_run(SEED, kill=KILL_AFTER, readmit=True, grace_s=5.0,
+                    journal_bytes=cap, n_events=120,
+                    chaos_extra="bw=2e5", sample_journal=True)
+    assert out["body"] == baseline["body"]
+    assert out["resumes"] >= 1
+    assert 0 < out["max_journal"] <= cap + MAX_BODY_CHUNK
+    assert out["clean"] == {"detached": 0, "replay_bytes": 0, "live": 0}
+
+
+# ---------------------------------------------------------------------------
+# resume refusal: unknown token answers typed, never hangs
+# ---------------------------------------------------------------------------
+
+def test_resume_unknown_token_refused_typed():
+    async def main():
+        async def backend(req, body):
+            async def chunks():
+                yield b"ok"
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        serve_ch, client_ch = loopback_pair()
+        serve_task = asyncio.create_task(
+            run_serve(serve_ch, backend=backend))
+        try:
+            await client_ch.send(TunnelMessage.hello(Hello()).encode())
+            agree = TunnelMessage.decode(await client_ch.recv())
+            assert agree.msg_type == MessageType.AGREE
+            Agree.from_json(agree.payload)
+            await client_ch.send(TunnelMessage.res_resume(
+                ResumeFrame(9, "rs-never-existed", 0, 0)
+            ).encode())
+            msg = TunnelMessage.decode(
+                await asyncio.wait_for(client_ch.recv(), 5))
+            assert msg.msg_type == MessageType.ERROR
+            assert msg.error_code() == "peer_lost"
+            assert msg.stream_id == 9
+        finally:
+            serve_task.cancel()
+            await asyncio.gather(serve_task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(main(), 15))
+
+
+# ---------------------------------------------------------------------------
+# drain interaction: flush-or-name (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+def _drain_run(grace_s: float, drain_timeout: float) -> dict:
+    """Detach a stream by killing its session, tear the proxy down (so
+    nothing can resume it), then drain a FRESH serve session while the
+    stream is still parked; returns what the drain did."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate = asyncio.Event()
+
+        async def backend(req, body):
+            async def sse():
+                yield b"data: start\n\n"
+                await gate.wait()
+                yield b"data: never\n\n"
+
+            return 200, {"content-type": "text/event-stream"}, sse()
+
+        ready = asyncio.get_running_loop().create_future()
+        listener = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+        serve1_ch, proxy1_ch = loopback_pair()
+        serve1 = asyncio.create_task(run_serve(
+            serve1_ch, backend=backend, stream_grace_s=grace_s))
+        captures0 = global_blackbox.section()["captured"]
+        try:
+            port = await asyncio.wait_for(ready, 5)
+            await state.admit(proxy1_ch, peer_id="peer0")
+            r = await http_request(
+                "GET", f"http://127.0.0.1:{port}/sse", timeout=10)
+            it = r.iter_chunks()
+            assert b"start" in await it.__anext__()
+            # Kill session 1: the stream parks in the global registry.
+            serve1_ch.close()
+            deadline = asyncio.get_running_loop().time() + 5
+            while global_streams.count_detached() == 0:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            tokens = global_streams.detached_tokens()
+            # Tear the proxy down so NOTHING can resume the parked
+            # stream — the drain under test must face it alone.
+            listener.cancel()
+            await asyncio.gather(listener, return_exceptions=True)
+            r.close()
+
+            # Session 2 (hand-shaken directly) drains with the stream
+            # still parked.
+            serve2_ch, proxy2_ch = loopback_pair()
+            drain = asyncio.Event()
+            drain.set()
+            serve2 = asyncio.ensure_future(run_serve(
+                serve2_ch, backend=backend, drain=drain,
+                drain_timeout=drain_timeout, stream_grace_s=grace_s,
+            ))
+            await proxy2_ch.send(TunnelMessage.hello(Hello()).encode())
+            agree = TunnelMessage.decode(
+                await asyncio.wait_for(proxy2_ch.recv(), 5))
+            assert agree.msg_type == MessageType.AGREE
+            await asyncio.wait_for(serve2, 10)
+            section = global_blackbox.section()
+            new_capture = section["captured"] - captures0
+            await _drain_settled(timeout=max(2.0, 2 * grace_s))
+            return {
+                "tokens": tokens,
+                "captures": new_capture,
+                "attribution": (section["postmortem"] or {}).get(
+                    "attribution", ""),
+                "clean": _gauges_clean(),
+            }
+        finally:
+            await _cancel_all(listener, serve1)
+
+    return asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_drain_timeout_names_abandoned_detached_streams():
+    """A drain that cannot outlast a parked stream's grace window must
+    NAME the abandoned stream in the postmortem attribution — today a
+    detached stream would silently extend or silently vanish."""
+    out = _drain_run(grace_s=5.0, drain_timeout=0.3)
+    assert out["captures"] == 1
+    assert "resumable stream(s) abandoned" in out["attribution"]
+    assert out["tokens"] and out["tokens"][0] in out["attribution"]
+
+
+def test_drain_flushes_detached_journals_inside_budget():
+    """When the grace window expires INSIDE the drain budget, the drain
+    completes cleanly — registry flushed, no postmortem capture."""
+    out = _drain_run(grace_s=0.3, drain_timeout=5.0)
+    assert out["captures"] == 0
+    assert out["clean"] == {"detached": 0, "replay_bytes": 0, "live": 0}
+
+
+def test_drain_ignores_other_sessions_healthy_streams():
+    """A multi-session process: session A's drain must not block on (or
+    name) a stream healthily attached to session B's channel — the drain
+    wait is scoped to THIS channel plus unowned detached streams."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate = asyncio.Event()
+
+        async def backend(req, body):
+            async def sse():
+                yield b"data: start\n\n"
+                await gate.wait()
+                yield b"data: end\n\n"
+
+            return 200, {"content-type": "text/event-stream"}, sse()
+
+        ready = asyncio.get_running_loop().create_future()
+        listener = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+        serveB_ch, proxyB_ch = loopback_pair()
+        serveB = asyncio.create_task(run_serve(
+            serveB_ch, backend=backend, stream_grace_s=5.0))
+        captures0 = global_blackbox.section()["captured"]
+        try:
+            port = await asyncio.wait_for(ready, 5)
+            await state.admit(proxyB_ch, peer_id="peerB")
+            r = await http_request(
+                "GET", f"http://127.0.0.1:{port}/sse", timeout=10)
+            it = r.iter_chunks()
+            assert b"start" in await it.__anext__()
+            assert global_streams.live_count() == 1  # B's healthy stream
+
+            # Session A drains with drain_timeout=0 (wait FOREVER): were
+            # the wait global, B's gated stream would hang it.
+            serveA_ch, proxyA_ch = loopback_pair()
+            drain = asyncio.Event()
+            drain.set()
+            serveA = asyncio.ensure_future(run_serve(
+                serveA_ch, backend=backend, drain=drain,
+                drain_timeout=0.0, stream_grace_s=5.0))
+            await proxyA_ch.send(TunnelMessage.hello(Hello()).encode())
+            agree = TunnelMessage.decode(
+                await asyncio.wait_for(proxyA_ch.recv(), 5))
+            assert agree.msg_type == MessageType.AGREE
+            await asyncio.wait_for(serveA, 5)
+            assert global_blackbox.section()["captured"] == captures0
+
+            gate.set()
+            rest = b""
+            async for c in it:
+                rest += c
+            assert b"end" in rest
+            await _drain_settled()
+        finally:
+            await _cancel_all(listener, serveB)
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_proxy_error_frame_reparks_resumed_attachment():
+    """An abandoned resume must never orphan-wedge the relay: if the
+    proxy cancels a (possibly late-accepted) resumed attachment with a
+    typed ERROR on its stream id, the serve side re-parks the stream —
+    back into the grace window — instead of pumping frames nobody
+    demuxes until flow credit wedges it forever."""
+
+    async def main():
+        state = ProxyState(fabric=True)
+        gate = asyncio.Event()
+
+        async def backend(req, body):
+            async def sse():
+                yield b"data: start\n\n"
+                await gate.wait()
+                yield b"data: never\n\n"
+
+            return 200, {"content-type": "text/event-stream"}, sse()
+
+        ready = asyncio.get_running_loop().create_future()
+        listener = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+        serve1_ch, proxy1_ch = loopback_pair()
+        serve1 = asyncio.create_task(run_serve(
+            serve1_ch, backend=backend, stream_grace_s=1.0))
+        try:
+            port = await asyncio.wait_for(ready, 5)
+            await state.admit(proxy1_ch, peer_id="peer0")
+            r = await http_request(
+                "GET", f"http://127.0.0.1:{port}/sse", timeout=10)
+            it = r.iter_chunks()
+            assert b"start" in await it.__anext__()
+            # Park the stream, then silence the proxy (no auto-resume).
+            listener.cancel()
+            await asyncio.gather(listener, return_exceptions=True)
+            serve1_ch.close()
+            deadline = asyncio.get_running_loop().time() + 5
+            while global_streams.count_detached() == 0:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            token = global_streams.detached_tokens()[0]
+
+            # Hand-rolled session 2: resume it, then cancel the resumed
+            # attachment with a typed ERROR on its stream id.
+            serve2_ch, proxy2_ch = loopback_pair()
+            serve2 = asyncio.create_task(run_serve(
+                serve2_ch, backend=backend, stream_grace_s=1.0))
+            await proxy2_ch.send(TunnelMessage.hello(Hello()).encode())
+            agree = TunnelMessage.decode(
+                await asyncio.wait_for(proxy2_ch.recv(), 5))
+            assert agree.msg_type == MessageType.AGREE
+            await proxy2_ch.send(TunnelMessage.res_resume(
+                ResumeFrame(77, token, 0, 0)).encode())
+            msg = TunnelMessage.decode(
+                await asyncio.wait_for(proxy2_ch.recv(), 5))
+            assert msg.msg_type == MessageType.RES_RESUMED
+            assert global_streams.count_detached() == 0  # attached again
+            await proxy2_ch.send(TunnelMessage.typed_error(
+                77, "peer_lost", "resume abandoned by proxy").encode())
+            deadline = asyncio.get_running_loop().time() + 5
+            while global_streams.count_detached() == 0:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            # Re-parked: the fresh grace window expires and releases it.
+            await _drain_settled(timeout=5.0)
+            r.close()
+            await _cancel_all(serve2)
+        finally:
+            await _cancel_all(listener, serve1)
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+# ---------------------------------------------------------------------------
+# healthz surfaces (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+def test_healthz_streams_section_and_proxy_resume_snapshot():
+    async def main():
+        state = ProxyState(fabric=True)
+
+        async def backend(req, body):
+            async def chunks():
+                yield b"ok"
+
+            return 200, {"content-type": "text/plain"}, chunks()
+
+        ready = asyncio.get_running_loop().create_future()
+        listener = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready))
+        serve_ch, proxy_ch = loopback_pair()
+        serve_task = asyncio.create_task(
+            run_serve(serve_ch, backend=backend))
+        try:
+            port = await asyncio.wait_for(ready, 5)
+            await state.admit(proxy_ch, peer_id="peer0")
+            r = await http_request(
+                "GET", f"http://127.0.0.1:{port}/healthz", timeout=10)
+            hz = json.loads(await r.read_all())
+            assert "streams" in hz
+            assert set(hz["streams"]) == {
+                "detached", "resumable_live", "replay_buffer_bytes",
+                "resumes_total",
+            }
+            r2 = await http_request(
+                "GET", f"http://127.0.0.1:{port}/healthz?local=1",
+                timeout=10)
+            snap = json.loads(await r2.read_all())
+            assert "stream_resume_p50_ms" in snap
+        finally:
+            listener.cancel()
+            serve_task.cancel()
+            await asyncio.gather(
+                listener, serve_task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(main(), 15))

@@ -2274,6 +2274,54 @@ def test_tc15_waiver_names_releasing_owner(tmp_path):
     assert rules_of(waived) == ["TC15"]
 
 
+def test_tc15_detached_stream_registry_journal_leak(tmp_path):
+    """ISSUE 13: the detached-stream registry is in TC15's vocabulary.
+    This fixture reconstructs the journal-leak shape — a stream
+    registered for resume whose grace-expiry/consumer-gone path never
+    releases it: the replay journal's bytes stay resident forever for a
+    stream nobody can resume (and the consumer closing the generator at
+    the yield is exactly how the path is reached)."""
+    active, _ = check(
+        tmp_path,
+        """
+        async def park_for_resume(self, relay, queue):
+            self._detached[relay.token] = relay
+            while True:
+                chunk = await queue.get()
+                if chunk is None:
+                    return
+                relay.journal.append(chunk)
+                yield chunk
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert rules_of(active) == ["TC15"]
+    assert "_detached" in active[0].message
+
+
+def test_tc15_detached_stream_registry_finally_release_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        async def park_for_resume(self, relay, queue):
+            self._detached[relay.token] = relay
+            try:
+                while True:
+                    chunk = await queue.get()
+                    if chunk is None:
+                        return
+                    relay.journal.append(chunk)
+                    yield chunk
+            finally:
+                self._detached.pop(relay.token, None)
+        """,
+        filename=ENG_FIXTURE,
+        rules=["TC15"],
+    )
+    assert active == []
+
+
 # ---------------------------------------------------------------------------
 # TC16 — flight/postmortem schema registries + ops routing via ops_route
 # ---------------------------------------------------------------------------

@@ -58,11 +58,13 @@ SCOPE_PARTS = (
 )
 
 #: Word-wise match on the registry attribute's name: ``link.pending``,
-#: ``self._requests``, ``self._inflight_prefix``.  A BARE name only counts
-#: when it is a function parameter — a passed-in shared registry; a local
-#: ``pending_lp`` accumulation buffer dies with the frame and needs no
-#: release.
-INFLIGHT_WORDS = frozenset({"pending", "inflight", "requests"})
+#: ``self._requests``, ``self._inflight_prefix``, ``self._detached`` (the
+#: ISSUE 13 detached-stream registry: a registration that never releases
+#: IS a replay-journal leak — bytes retained forever for a stream nobody
+#: can resume).  A BARE name only counts when it is a function parameter —
+#: a passed-in shared registry; a local ``pending_lp`` accumulation buffer
+#: dies with the frame and needs no release.
+INFLIGHT_WORDS = frozenset({"pending", "inflight", "requests", "detached"})
 
 #: ``X.open()``/``X.close()`` pairing applies when the receiver's name
 #: carries a resource word (the FlowControl per-stream window, channels,
