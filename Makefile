@@ -114,6 +114,14 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# checks are clean.
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_resume.py -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_resume.py -k "midstream or journal" -q
+	@# ISSUE 14 matrix rows: the block-paged pool + conversation cache —
+	@# the int4 hero composition's byte-identity vs the unpooled path,
+	@# cost-aware eviction's seeded two-run identity (asserted INSIDE the
+	@# test), and the page-reservation leak gate across deadline-evict /
+	@# client-cancel / owner-death-promotion paths.
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_paged_pool.py -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_paged_pool.py \
+		-k "two_run or leak_gate" -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

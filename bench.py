@@ -67,6 +67,7 @@ SAFE_OVERRIDES = {
     "BENCH_QUANT": "int8",
     "BENCH_PREFIX_CACHE": "0",
     "BENCH_MUX": "0",
+    "BENCH_CONV_CACHE": "0",
 }
 
 
@@ -89,6 +90,7 @@ RESULT_ROW_KEYS = (
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
+    "pages_used", "pages_free", "conversation_hit_rate",
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",
     "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
     "wall_s",
@@ -220,6 +222,12 @@ async def _run_attempt(model: str) -> dict:
     # sweep's mux-off twins isolate its effect.
     mux = os.environ.get("BENCH_MUX", "1") == "1"
     mux_budget = int(os.environ.get("BENCH_MUX_BUDGET", "0"))
+    # Cross-request conversation cache (ISSUE 14) — on by default here AND
+    # in the serve CLI (TUNNEL_CONV_CACHE); needs the prefix pool.  The
+    # row records pool occupancy + the conversation hit rate so multi-turn
+    # reuse is a trend axis.
+    conv_cache = os.environ.get("BENCH_CONV_CACHE", "1") == "1"
+    prefix_evict = os.environ.get("BENCH_PREFIX_EVICT", "cost")
     # Cold-shared-prefix herd (the ISSUE 5 TTFT workload): prepend this
     # many tokens of IDENTICAL templated text to every measured client's
     # prompt — but not the warm client's, so the herd hits the prefix
@@ -282,6 +290,8 @@ async def _run_attempt(model: str) -> dict:
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
             mux=mux, mux_budget_tokens=mux_budget,
+            conv_cache=conv_cache and prefix_cache,
+            prefix_evict=prefix_evict,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -440,6 +450,11 @@ async def _run_attempt(model: str) -> dict:
             return None
         return round(nearest_rank(xs, p) * 1000.0, 1)
     n_params, peak_flops = _model_flops_params(model)
+    admissions = global_metrics.counter("engine_admissions_total")
+    conv_hit_rate = (
+        round(global_metrics.counter("engine_conv_hits_total") / admissions, 4)
+        if admissions > 0 else None
+    )
     import jax
 
     row = {
@@ -510,6 +525,16 @@ async def _run_attempt(model: str) -> dict:
         "prefix_dedup_hits": global_metrics.counter(
             "engine_prefix_dedup_hits_total"
         ),
+        # Block-paged pool occupancy + conversation-cache reuse (ISSUE 14):
+        # pages at measurement end, and the fraction of admissions whose
+        # prefix match reached into finished-stream (conversation) pages.
+        "pages_used": int(
+            global_metrics.gauge("engine_prefix_pool_blocks_used")
+        ),
+        "pages_free": int(
+            global_metrics.gauge("engine_prefix_pool_blocks_free")
+        ),
+        "conversation_hit_rate": conv_hit_rate,
         # Cold-start breakdown (ISSUE 12): captured before the
         # post-warmup metrics reset above.
         "warmup_compile_s": warmup_compile_s,

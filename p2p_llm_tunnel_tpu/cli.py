@@ -220,8 +220,10 @@ def build_parser() -> argparse.ArgumentParser:
                        default=_env("TUNNEL_KV_QUANT", "none"),
                        help="KV-cache quantization (int8 halves, int4 "
                             "quarters the long-context KV read term; int4 "
-                            "disables prefix cache / chunked prefill / "
-                            "spec decode)")
+                            "composes with the prefix cache and chunked "
+                            "prefill via page-aligned pool pages — only "
+                            "spec decode stays disabled, see /healthz "
+                            "config.fences)")
     serve.add_argument("--prefill-act-quant",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFILL_ACT_QUANT", "") == "1",
@@ -274,6 +276,22 @@ def build_parser() -> argparse.ArgumentParser:
                        default=int(_env("TUNNEL_PREFIX_POOL_BLOCKS", "128")),
                        help="prefix-cache pool capacity in KV blocks "
                             "(block 0 is scratch)")
+    serve.add_argument("--conv-cache",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_CONV_CACHE", "1") == "1",
+                       help="cross-request conversation cache (default ON "
+                            "with --prefix-cache): finished streams' KV — "
+                            "prompt AND generated tokens — is saved into "
+                            "the prefix pool, so a returning user's next "
+                            "turn re-prefills only its new tail; disable "
+                            "with --no-conv-cache or TUNNEL_CONV_CACHE=0")
+    serve.add_argument("--prefix-evict", choices=("cost", "lru"),
+                       default=_env("TUNNEL_PREFIX_EVICT", "cost"),
+                       help="pool page eviction policy: cost (GreedyDual — "
+                            "pages weigh their recompute cost, tokens x "
+                            "live per-token prefill ms, so deep "
+                            "conversations outlive cheap one-shot prompts "
+                            "under pressure) or lru")
     serve.add_argument("--prefix-cache",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFIX_CACHE", "1") == "1",
@@ -620,6 +638,8 @@ async def _engine_backend(args):
                     prefix_cache=args.prefix_cache,
                     prefix_cache_dir=pfx_dir,
                     prefix_pool_blocks=args.prefix_pool_blocks,
+                    conv_cache=args.conv_cache and args.prefix_cache,
+                    prefix_evict=args.prefix_evict,
                     spec_ngram=args.spec_ngram,
                     spec_k=args.spec_k,
                     prefill_chunk=args.prefill_chunk,

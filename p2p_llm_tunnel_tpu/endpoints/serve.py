@@ -677,6 +677,14 @@ async def _send_healthz(
                 global_metrics.percentile("proxy_ttfb_ms", 99.9), 1
             ),
         },
+        # ISSUE 14 observability: the composition-fence registry — every
+        # knob the engine auto-disabled at startup, with its reason.  The
+        # hero configuration (int4 + kv-int4 + fused + mux + prefix)
+        # reports an EMPTY list here; operators verify it fleet-wide via
+        # the proxy's federated /healthz view.
+        "config": {
+            "fences": global_metrics.info("config_fences", []) or [],
+        },
         "prefix_pool": {
             "blocks_used": int(
                 global_metrics.gauge("engine_prefix_pool_blocks_used")
@@ -687,6 +695,27 @@ async def _send_healthz(
             "kv_bytes": int(
                 global_metrics.gauge("engine_prefix_pool_kv_bytes")
             ),
+            # ISSUE 14: admission-time page reservations (nonzero at rest
+            # is a leak), cost-aware eviction volume, and the
+            # conversation cache's reuse accounting — the multi-turn
+            # "turn-N re-prefills only its tail" story in numbers.
+            "pages_reserved": int(
+                global_metrics.gauge("engine_prefix_pool_pages_reserved")
+            ),
+            "evictions_total": int(
+                global_metrics.counter("engine_prefix_evictions_total")
+            ),
+            "conversation": {
+                "saved_pages_total": int(
+                    global_metrics.counter("engine_conv_saved_pages_total")
+                ),
+                "hits_total": int(
+                    global_metrics.counter("engine_conv_hits_total")
+                ),
+                "hit_tokens_total": int(
+                    global_metrics.counter("engine_conv_hit_tokens_total")
+                ),
+            },
         },
         # ISSUE 7 observability: per-tenant ingress accounting (in-flight,
         # token rate, sheds) and the advisory Retry-After the 429 paths

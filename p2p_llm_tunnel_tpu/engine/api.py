@@ -100,12 +100,22 @@ def render_chat_prompt(messages) -> str:
     Deliberately template-minimal: real chat templates are tokenizer-specific
     and belong to the checkpoint adapter; this keeps the byte-level path
     deterministic.
+
+    Assistant turns render as ``assistant:<content>`` — NO space after the
+    cue — because generation continues the bare ``assistant:`` cue
+    directly: a turn-N+1 request that resends the conversation then
+    re-renders to a BYTE-EXACT extension of turn-N's prompt + response
+    stream, which is what lets the conversation cache (ISSUE 14) match a
+    returning user's history page-for-page instead of re-prefilling it.
     """
     parts = []
     for m in messages:
         role = m.get("role", "user")
         content = m.get("content", "")
-        parts.append(f"{role}: {content}")
+        if role == "assistant":
+            parts.append(f"assistant:{content}")
+        else:
+            parts.append(f"{role}: {content}")
     parts.append("assistant:")
     return "\n".join(parts)
 

@@ -146,10 +146,12 @@ class EngineConfig:
     # per-token-per-head scales — quarters the KV stream).  Halves (or
     # quarters) the KV read term that dominates long-context decode HBM
     # traffic; dequant fuses into the einsum operand read or runs in VMEM
-    # inside the Pallas kernels.  int4 scope limits: the packed sequence
-    # axis cannot take byte-misaligned chunk writes, so prefix_cache,
-    # prefill_chunk, and spec_ngram are disabled under it (warned at
-    # startup).
+    # inside the Pallas kernels.  Since ISSUE 14 the prefix cache and
+    # chunked prefill COMPOSE with int4: every pool page and chunk start
+    # is forced to an even (two-tokens-per-byte) boundary, so packed
+    # writes cover whole bytes.  The one remaining int4 fence is
+    # spec_ngram (spec-verify writes at arbitrary, byte-misaligned
+    # positions) — recorded in ``config_fences`` and /healthz.
     kv_quant: str = "none"
     # Use the Pallas decode-attention kernel on TPU-tileable shapes
     # (models/config.py flash_decode).  Off by default pending on-hardware
@@ -253,6 +255,24 @@ class EngineConfig:
     # weigh 1.0): a premium tenant at weight 4 gets 4x the contended queue
     # share and 4x the admission stride of a default tenant.
     tenant_weights: str = ""
+    # Cross-request conversation cache (ISSUE 14): when a stream finishes
+    # naturally (stop/length), its full-page KV — prompt AND generated
+    # tokens — is saved back into the prefix pool keyed by the PrefixIndex
+    # chain, so a returning user's turn-N request matches through turn
+    # N-1's whole conversation and re-prefills only the new tail.  Needs
+    # prefix_cache.  Numerics note (the int8-history nuance's sibling):
+    # reused pages hold decode-computed KV, which is not bit-equal to a
+    # fresh prefill of the same tokens, so conversation reuse trades exact
+    # replay-identity for skipping the whole-history recompute — OFF here
+    # by default (programmatic identity tests keep the pre-ISSUE-14
+    # behavior); the serve CLI and bench default it ON.
+    conv_cache: bool = False
+    # Pool page eviction policy: "cost" (default) weighs pages by their
+    # recompute cost — the page's full-prefix token count times the live
+    # per-token prefill-ms estimate, GreedyDual-style, so a deep
+    # conversation's pages outlive a cheap one-shot prompt's under
+    # pressure — "lru" restores the plain least-recently-used order.
+    prefix_evict: str = "cost"
 
 
 @dataclass
@@ -431,19 +451,46 @@ class InferenceEngine:
         self._scratch_slot = b
         if self.ecfg.kv_quant not in ("none", "", "int8", "int4"):
             raise ValueError(f"unknown kv_quant mode {self.ecfg.kv_quant!r}")
+        if self.ecfg.prefix_evict not in ("cost", "lru"):
+            raise ValueError(
+                f"unknown prefix_evict mode {self.ecfg.prefix_evict!r}"
+            )
+        # Composition-fence registry (ISSUE 14): every knob the engine
+        # auto-disables at startup lands here WITH its reason, surfaced as
+        # the /healthz "config" section (and the proxy's federated view),
+        # so an operator can verify the hero configuration runs unfenced
+        # instead of grepping startup logs for warnings.
+        self.config_fences: List[Dict[str, str]] = []
+        # Conversation-cache scratch (ISSUE 14): finished slots whose KV
+        # awaits a batched pool insert this iteration (drained before the
+        # next admission can re-prefill the slot), per-rid page-reservation
+        # grants, the per-token prefill-ms EMA feeding cost-aware eviction,
+        # and last-published index counters (the delta-inc bookkeeping
+        # behind the engine_prefix_evictions_total / engine_conv_* series).
+        self._conv_pending: List[Tuple[int, List[int]]] = []
+        self._page_reserved: Dict[int, int] = {}
+        self._prefill_ms_per_token = 0.0
+        self._prefix_published: Dict[str, int] = {}
         if self.ecfg.kv_quant == "int4":
-            # The packed sequence axis cannot take byte-misaligned partial
-            # writes, and every chunk-prefill consumer writes at arbitrary
-            # starts (transformer.chunk_prefill_into_cache rejects int4
-            # caches outright).  Disable them rather than silently corrupt.
-            for knob, off in (("prefix_cache", False), ("prefill_chunk", 0),
-                              ("spec_ngram", 0)):
-                if getattr(self.ecfg, knob):
-                    log.warning(
-                        "%s disabled: not supported with kv_quant='int4'",
-                        knob,
-                    )
-                    self.ecfg = dc_replace(self.ecfg, **{knob: off})
+            # Block-paged alignment (ISSUE 14): chunk-prefill writes are
+            # legal on the packed sequence axis exactly when every write
+            # start and padded width is even (whole bytes — two tokens per
+            # byte).  Pool pages (min_prefill_bucket) and chunk segments
+            # (prefill_chunk) are forced to even sizes below, which makes
+            # every chunk start a page/segment multiple and hence even.
+            # Spec-verify remains the one fenced consumer: it writes
+            # proposal KV at arbitrary token positions.
+            if self.ecfg.spec_ngram:
+                self._fence(
+                    "spec_ngram", 0,
+                    "spec-verify writes proposal KV at arbitrary "
+                    "(byte-misaligned) positions in the packed int4 "
+                    "sequence axis",
+                )
+            # (The page-alignment pass — chunk rounding + pool-page
+            # evenness fences — runs AFTER the mux default below has
+            # picked the effective prefill_chunk, so a defaulted odd
+            # width cannot dodge it.)
         self.kv_cache = init_kv_cache(
             self.mcfg, rows, s, dtype, quant=self.ecfg.kv_quant
         )
@@ -464,17 +511,20 @@ class InferenceEngine:
             # program has no sequence-parallel attention path, and silently
             # bypassing ring/Ulysses on long prompts would defeat sp's
             # memory scaling exactly where it matters.
-            log.warning("chunked prefill disabled: not supported with sp>1")
-            self.ecfg = dc_replace(self.ecfg, prefill_chunk=0)
+            self._fence(
+                "prefill_chunk", 0,
+                "the chunk-prefill program has no sequence-parallel "
+                "attention path (sp>1)",
+            )
 
         # Multiplexing (ISSUE 5): chunked prefill is the production path,
-        # so pick a default segment width when none was configured.  Where
-        # the chunk path is illegal (packed int4 KV sequence axis, sp>1
-        # prefill — both zeroed prefill_chunk above), mux falls back to
-        # budgeted whole-prompt admission waves: interference control
-        # without the segment interleave.
+        # so pick a default segment width when none was configured.  Since
+        # ISSUE 14 the packed int4 KV cache takes page-aligned chunk
+        # writes, so the segment interleave runs under every kv_quant;
+        # only sp>1 prefill (no sequence-parallel chunk path) still falls
+        # back to budgeted whole-prompt admission waves.
         if self.ecfg.mux and self.ecfg.prefill_chunk <= 0:
-            if self.ecfg.kv_quant != "int4" and self.ecfg.sp <= 1:
+            if self.ecfg.sp <= 1:
                 # 128 measured best on the 32-client herd (PERF.md r8):
                 # wide enough that a shared-prefix owner drains in a few
                 # sub-batches, narrow enough that one segment's compute
@@ -484,6 +534,30 @@ class InferenceEngine:
                     prefill_chunk=max(self.ecfg.min_prefill_bucket,
                                       min(128, s)),
                 )
+        if self.ecfg.kv_quant == "int4":
+            # Page-alignment pass (ISSUE 14), AFTER the mux default above
+            # so the EFFECTIVE chunk width is what gets rounded: packed
+            # int4 segment writes must cover whole bytes.
+            from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+                INT4_PACK_TOKENS,
+                page_alignment_violations,
+            )
+
+            if self.ecfg.prefill_chunk % INT4_PACK_TOKENS:
+                fixed = (self.ecfg.prefill_chunk + INT4_PACK_TOKENS
+                         - self.ecfg.prefill_chunk % INT4_PACK_TOKENS)
+                log.info(
+                    "rounding prefill_chunk %d up to %d: packed int4 KV "
+                    "segments must be page-aligned",
+                    self.ecfg.prefill_chunk, fixed,
+                )
+                self.ecfg = dc_replace(self.ecfg, prefill_chunk=fixed)
+            if self.ecfg.prefix_cache:
+                for why in page_alignment_violations(
+                    "int4", self.ecfg.min_prefill_bucket,
+                    self.ecfg.prefill_chunk,
+                ):
+                    self._fence("prefix_cache", False, why)
 
         # Prefix cache: host index + device block pool + jitted copy ops.
         self._prefix = None
@@ -492,12 +566,24 @@ class InferenceEngine:
             # path; silently bypassing ring/Ulysses on cache hits would
             # defeat sp's memory scaling on exactly the long prompts it
             # exists for.
-            log.warning("prefix cache disabled: not supported with sp>1")
-        elif self.ecfg.prefix_cache:
+            self._fence(
+                "prefix_cache", False,
+                "chunk_prefill_into_cache has no sequence-parallel "
+                "attention path (sp>1)",
+            )
+        if self.ecfg.conv_cache and not self.ecfg.prefix_cache:
+            self._fence(
+                "conv_cache", False,
+                "the conversation cache stores finished streams' KV in "
+                "the prefix pool, which prefix_cache=False leaves "
+                "uninitialised",
+            )
+        if self.ecfg.prefix_cache:
             from p2p_llm_tunnel_tpu.engine.prefix_cache import (
                 PrefixIndex,
                 init_pool,
                 make_batch_copy_ops,
+                pool_packed_keys,
             )
 
             blk = self.ecfg.min_prefill_bucket
@@ -510,7 +596,10 @@ class InferenceEngine:
                 for i in range(max(1, self.ecfg.prefix_tail_buckets))
                 if blk * (2 ** i) <= s
             ]
-            self._prefix = PrefixIndex(blk, self.ecfg.prefix_pool_blocks)
+            self._prefix = PrefixIndex(
+                blk, self.ecfg.prefix_pool_blocks,
+                evict=self.ecfg.prefix_evict,
+            )
             self._pool = init_pool(
                 self.kv_cache, blk, self.ecfg.prefix_pool_blocks
             )
@@ -543,15 +632,36 @@ class InferenceEngine:
             # Row-batched (prefill_rows-wide) copy programs: one dispatch
             # per admission-wave sub-batch, not per request — per-request
             # dispatches through the device tunnel tripled prefill p50 in
-            # the r5 on-chip window (PERF.md).
+            # the r5 on-chip window (PERF.md).  Under int4 the value
+            # leaves move in page-aligned BYTE ranges (block // 2 bytes
+            # per page) — the alignment-stable page unit the ISSUE 14
+            # pool guarantees.
             self._copy_in, self._copy_out = make_batch_copy_ops(
-                blk, self._prefix_max_blocks, self.ecfg.prefill_rows
+                blk, self._prefix_max_blocks, self.ecfg.prefill_rows,
+                # Derived from the cache's ACTUAL leaf shapes — the same
+                # predicate init_pool sizes pages with, so the page unit
+                # and the copy unit cannot split.
+                packed_keys=pool_packed_keys(self.kv_cache),
             )
             if self._spmd is not None:
                 self._copy_in = self._spmd.wrap("copy_in", self._copy_in, 2)
                 self._copy_out = self._spmd.wrap(
                     "copy_out", self._copy_out, 2
                 )
+            # Page reservation (ISSUE 14): admission reserves the pool
+            # pages a request's prompt insert will want, evicting
+            # (cost-aware) under pressure AT admission time instead of
+            # mid-wave.  Grants are released when the insert lands or in
+            # generate()'s finally — which runs on EVERY death path
+            # (deadline evict, client cancel, owner-death promotion), the
+            # leak-gate contract tests/test_paged_pool.py pins.
+            self.scheduler.page_reserve = self._reserve_pages
+
+        # Publish the fence registry where /healthz can read it without
+        # holding an engine reference (latest engine wins — one serving
+        # engine per process is the deployed shape, same contract as the
+        # blackbox engine provider).
+        global_metrics.set_info("config_fences", list(self.config_fences))
 
         # Prefill may run a hotter quant mode than decode (prefill_act_quant):
         # a separate static config for the prefill program only.
@@ -636,6 +746,7 @@ class InferenceEngine:
         # an await (TC13).
         self._last_mux: Dict[str, object] = {}
         self._flight_admitted = 0
+        self._flight_conv = 0
         self._last_burst: Tuple[int, int] = (0, 0)
         # Postmortem black box: this engine contributes the config +
         # scheduler/slot/backlog snapshot to captured bundles (latest
@@ -1215,6 +1326,14 @@ class InferenceEngine:
             "pending_plain": len(self._pending_plain),
             "prefix_waiters": len(self._prefix_waiters),
             "inflight_prefix_keys": len(self._inflight_prefix),
+            "config_fences": list(self.config_fences),
+            "prefix_pool": None if self._prefix is None else {
+                "pages_used": self._prefix.used_blocks,
+                "pages_free": self._prefix.free_blocks,
+                "pages_reserved": self._prefix.reserved_pages,
+                "evictions": self._prefix.evictions,
+                "conv_pending": len(self._conv_pending),
+            },
             "degraded": self.degraded,
             "crashed": self._crashed,
             "warmup_done": self._warmup_done,
@@ -1746,6 +1865,12 @@ class InferenceEngine:
         finally:
             self._requests.pop(rid, None)
             self.scheduler.cancel(rid)
+            # Page-reservation release (ISSUE 14): runs on EVERY exit path
+            # — finish, deadline evict, client cancel, shed, crash — so an
+            # admission-time grant can never outlive its request (the
+            # leak-gate contract).  Idempotent: the insert path usually
+            # released it already.
+            self._release_pages(rid)
             global_metrics.tenant_end(tenant)
             if state.first_token_at is None and state.finish:
                 # The request ended SERVER-SIDE (timeout/shed — finish is
@@ -2521,6 +2646,19 @@ class InferenceEngine:
         evicted = self.scheduler.slots[slot] is None
         if evicted:
             self._active_mask[slot] = False
+            if self._prefix is not None and self.ecfg.conv_cache:
+                # Every record_token eviction is a NATURAL finish (stop /
+                # length / cache-full; deadline evictions and cancels
+                # never route through here).
+                # Conversation cache (ISSUE 14): the finished stream's KV
+                # covers positions [0, cache_len-1) — the final sampled
+                # token was never fed back, so its KV row was never
+                # written.  Queue the full-page prefix of that range for
+                # the end-of-iteration batched insert; a turn-N+1 prompt
+                # that resends this conversation matches through it.
+                seq = out.request.prompt_ids + out.generated[:-1]
+                if len(seq) >= self._prefix_block:
+                    self._conv_pending.append((slot, seq))
         else:
             self._last_token[slot] = tok
             # The generated token's own position: it is written to the cache
@@ -2560,6 +2698,7 @@ class InferenceEngine:
         entries = plan_inserts(
             self._prefix,
             [(run.slot, run.request.prompt_ids) for run in runs],
+            ms_per_token=self._prefill_ms_per_token or 1.0,
         )
         total = sum(len(ids) for _, ids, _ in entries)
         pr = self.ecfg.prefill_rows
@@ -2699,8 +2838,12 @@ class InferenceEngine:
             )
             # Wall time of this chunk's dispatch → result-on-host span, the
             # per-phase timing SURVEY §5 asks for (overlaps siblings').
-            global_metrics.observe(
-                "engine_prefill_ms", (time.monotonic() - t0) * 1000.0
+            wall_ms = (time.monotonic() - t0) * 1000.0
+            global_metrics.observe("engine_prefill_ms", wall_ms)
+            self._note_prefill_cost(
+                sum(len(r.request.prompt_ids) - hist_of.get(r.slot, 0)
+                    for r in runs),
+                wall_ms,
             )
             for i, (run, first) in enumerate(zip(runs, firsts[: len(runs)])):
                 if self.scheduler.slots[run.slot] is not run:
@@ -2724,6 +2867,7 @@ class InferenceEngine:
             await loop.run_in_executor(
                 self._executor, self._prefix_insert, live
             )
+            self._release_pages_for(live)
 
     # -- multiplexed admission (ISSUE 5) ----------------------------------
 
@@ -2932,8 +3076,9 @@ class InferenceEngine:
         iteration's ``max_rows`` budget under mux, whichever is smaller)
         by ONE segment each, as one chunk-prefill call (executor thread).
 
-        Returns (rows, first_dev, t_dispatch) where rows is
-        [(run, was_final)] in row order, or None when nothing is pending.  Every segment pads to the
+        Returns (rows, first_dev, t_dispatch, n_tokens) where rows is
+        [(run, was_final)] in row order and n_tokens counts REAL segment
+        tokens, or None when nothing is pending.  Every segment pads to the
         same ``prefill_chunk`` bucket — one compiled program; a final
         (short) segment's pad positions write junk KV past the prompt end,
         which decode overwrites before it ever becomes attendable (the
@@ -2958,6 +3103,7 @@ class InferenceEngine:
             return None
         chunk_rows = []
         rows: List[Tuple[RunningSlot, bool]] = []
+        n_tokens = 0
         for run, start in picked:
             ids = run.request.prompt_ids
             seg = ids[start : start + chunk]
@@ -2968,17 +3114,25 @@ class InferenceEngine:
                 self._segmented[run.slot] = (run, start + len(seg))
             chunk_rows.append((run, start, seg, final))
             rows.append((run, final))
+            n_tokens += len(seg)
         t_dispatch = time.monotonic()
         first_lp = self._dispatch_chunk_rows(chunk_rows, chunk)
         global_metrics.inc("engine_prefill_segments_total", len(rows))
-        return rows, first_lp, t_dispatch
+        return rows, first_lp, t_dispatch, n_tokens
 
     async def _finish_segments(self, loop, seg) -> None:
         """Fetch a segment dispatch's sampled block; activate final rows."""
-        rows, first_dev, t_dispatch = seg
+        rows, first_dev, t_dispatch, n_tokens = seg
         firsts, lp, _plp = await loop.run_in_executor(
             self._executor,
             lambda: jax.tree.map(np.asarray, jax.device_get(first_dev)),
+        )
+        # REAL segment tokens (pad rows and a final short segment's pad
+        # positions excluded): inflating the denominator would deflate
+        # the per-token estimate and underprice every page for the
+        # cost-aware eviction policy.
+        self._note_prefill_cost(
+            n_tokens, (time.monotonic() - t_dispatch) * 1000.0,
         )
         if global_tracer.enabled:
             # Engine-scope timeline row (no trace id): one span per
@@ -3004,6 +3158,7 @@ class InferenceEngine:
             await loop.run_in_executor(
                 self._executor, self._prefix_insert, inserts
             )
+            self._release_pages_for(inserts)
 
     def _trace_burst(self, t_dispatch: float, assign: List) -> None:
         """Engine-scope decode-burst span: dispatch -> fetched block
@@ -3018,9 +3173,108 @@ class InferenceEngine:
             attrs={"rows": sum(1 for a in assign if a is not None)},
         )
 
+    def _fence(self, knob: str, off, reason: str) -> None:
+        """Auto-disable ``knob`` and RECORD it (ISSUE 14): the fence lands
+        in ``config_fences`` — surfaced by /healthz's ``config`` section
+        and the proxy's federated view — instead of existing only as a
+        startup log line an operator has to grep for."""
+        log.warning("%s disabled: %s", knob, reason)
+        self.config_fences.append({"knob": knob, "reason": reason})
+        self.ecfg = dc_replace(self.ecfg, **{knob: off})
+
+    def _reserve_pages(self, req: GenRequest) -> None:
+        """Scheduler admission hook (ISSUE 14): reserve pool pages for the
+        request's prompt insert, evicting cost-aware under pressure NOW —
+        at admission — rather than thrashing the pool mid-wave.  Pure host
+        work (chain hashing + index bookkeeping).  The grant is advisory
+        accounting, not strict ownership; what the leak gate pins is that
+        every grant is RELEASED — after the insert lands, or in
+        generate()'s finally on any death path."""
+        if self._prefix is None:
+            return
+        need = len(self._prefix.missing(req.prompt_ids))
+        if need <= 0:
+            return
+        granted = self._prefix.reserve(need)
+        if granted:
+            self._page_reserved[req.request_id] = granted
+
+    def _release_pages(self, rid: int) -> None:
+        """EVENT-LOOP THREAD ONLY: every release site — generate()'s
+        finally and the post-insert releases after the executor calls
+        return — runs on the loop, so the reserved_pages counter's
+        read-modify-write never interleaves across threads (a concurrent
+        executor-side release could lose an update and wedge the
+        loadgen leak gate's pages_reserved==0 check)."""
+        n = self._page_reserved.pop(rid, None)
+        if n and self._prefix is not None:
+            self._prefix.release(n)
+
+    def _release_pages_for(self, runs: List[RunningSlot]) -> None:
+        """Release the admission grants of runs whose prompt insert just
+        landed (loop thread, after the executor insert call returned)."""
+        for run in runs:
+            self._release_pages(run.request.request_id)
+
+    def _note_prefill_cost(self, tokens: int, wall_ms: float) -> None:
+        """Per-token prefill-ms EMA (executor thread or loop; plain float
+        assignment, single logical writer per sample): the live estimate
+        cost-aware eviction weighs pool pages with — a page's recompute
+        cost is its full-prefix token count times this."""
+        if tokens <= 0 or wall_ms <= 0:
+            return
+        per = wall_ms / tokens
+        ema = self._prefill_ms_per_token
+        self._prefill_ms_per_token = per if ema <= 0 else (
+            0.8 * ema + 0.2 * per
+        )
+
+    def _conv_insert(self, pending: List[Tuple[int, List[int]]]) -> None:
+        """Save finished conversations' full-page KV — prompt AND generated
+        tokens — into the pool (executor thread, end of the iteration that
+        evicted them, so no new admission can have re-prefilled the slot).
+        One batched copy_out per prefill_rows sub-batch, exactly the
+        prompt-insert path's dispatch discipline (TC07)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            pad_rows,
+            plan_inserts,
+        )
+
+        entries = plan_inserts(
+            self._prefix, pending, conv=True,
+            ms_per_token=self._prefill_ms_per_token or 1.0,
+        )
+        total = sum(len(ids) for _, ids, _ in entries)
+        pr = self.ecfg.prefill_rows
+        for lo in range(0, len(entries), pr):
+            slots, pids, bnos = pad_rows(
+                entries[lo : lo + pr], pr, self._prefix_max_blocks,
+                scratch=0,
+            )
+            self._pool = self._copy_out(  # tunnelcheck: disable=TC07  ONE dispatch per prefill_rows-wide sub-batch, off the TTFT-critical path (end of iteration)
+                self._pool, self.kv_cache, slots, pids, bnos
+            )
+        if total:
+            global_metrics.inc("engine_conv_saved_pages_total", total)
+            global_metrics.inc("engine_prefix_saved_blocks_total", total)
+
+    async def _drain_conv_inserts(self, loop) -> None:
+        """End-of-iteration conversation-cache drain: batch-insert every
+        slot _account_token finished this iteration.  MUST run before the
+        next iteration's admission — a re-admitted slot's prefill would
+        overwrite the KV these pages are copied from (the copy dispatches
+        on the same executor as all writes, so device order is already
+        safe; this guards the HOST-side wrong-content hazard)."""
+        if not self._conv_pending:
+            return
+        pending, self._conv_pending = self._conv_pending, []
+        self._flight_conv = len(pending)  # tunnelcheck: disable=TC13  engine-loop task is the only writer (same single-writer contract as _flight_admitted)
+        await loop.run_in_executor(self._executor, self._conv_insert, pending)
+
     def _publish_prefix_gauges(self) -> None:
-        """Prefix-pool memory accounting (ISSUE 6): blocks used/free and
-        resident KV bytes, surfaced by /healthz and /metrics.  Host
+        """Prefix-pool memory accounting (ISSUE 6/14): pages used/free/
+        reserved, resident KV bytes, and the eviction + conversation-cache
+        counters (delta-inc from the index's internal tallies).  Host
         arithmetic over the index only — no device traffic."""
         if self._prefix is None:
             return
@@ -3032,6 +3286,19 @@ class InferenceEngine:
         global_metrics.set_gauge(
             "engine_prefix_pool_kv_bytes", used * self._prefix_block_bytes
         )
+        global_metrics.set_gauge(
+            "engine_prefix_pool_pages_reserved", self._prefix.reserved_pages
+        )
+        for metric, attr in (
+            ("engine_prefix_evictions_total", "evictions"),
+            ("engine_conv_hits_total", "conv_hits"),
+            ("engine_conv_hit_tokens_total", "conv_hit_tokens"),
+        ):
+            now = getattr(self._prefix, attr)
+            delta = now - self._prefix_published.get(attr, 0)
+            if delta > 0:
+                global_metrics.inc(metric, delta)
+                self._prefix_published[attr] = now
 
     async def _process_burst(self, outs, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.
@@ -3094,6 +3361,11 @@ class InferenceEngine:
             prefix_blocks_used=(
                 self._prefix.used_blocks if self._prefix is not None else 0
             ),
+            prefix_pages_reserved=(
+                self._prefix.reserved_pages if self._prefix is not None
+                else 0
+            ),
+            conv_inserted=self._flight_conv,
             cold_compiles=global_compile_watch.cold_total - cold0,
             # Detached-stream count (ISSUE 13): how many of this
             # iteration's generations are filling replay journals with no
@@ -3144,6 +3416,7 @@ class InferenceEngine:
                 # stalled step — the watchdog's attribution.
                 it_t0 = time.monotonic()
                 self._flight_admitted = 0  # tunnelcheck: disable=TC13  single-writer contract: only THIS loop task and the admission helpers it awaits touch the per-iteration flight scratch; the reset-here/accumulate-in-_note_admission/read-at-record sequence cannot interleave with another writer
+                self._flight_conv = 0
                 self._last_burst = (0, 0)
                 self._last_mux = {}
                 cold0 = global_compile_watch.cold_total
@@ -3235,6 +3508,7 @@ class InferenceEngine:
                     global_flight.set_phase("segments")
                     for seg in segs:
                         await self._finish_segments(loop, seg)
+                    await self._drain_conv_inserts(loop)
                     self._flight_record(
                         it_t0, t_admit, t_prefill, t_spec, t_spec,
                         plain_rows, seg_rows, cold0,
@@ -3284,6 +3558,10 @@ class InferenceEngine:
                     # sub-batch's device→host RTT rides under real compute
                     # (and under its successor sub-batches').
                     await self._finish_segments(loop, seg)
+                # Conversation-cache inserts for slots that finished this
+                # iteration — BEFORE the next admission can re-prefill
+                # them (ISSUE 14; off the TTFT-critical path by position).
+                await self._drain_conv_inserts(loop)
                 in_flight = current
                 self._flight_record(
                     it_t0, t_admit, t_prefill, t_dispatch, t_fetch,

@@ -49,8 +49,24 @@ from p2p_llm_tunnel_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+class _Entry:
+    """One pooled page's index record: pool slot, recompute-cost priority
+    (GreedyDual, cost-aware mode), and the conversation tag (ISSUE 14:
+    pages saved from a FINISHED stream's KV rather than a prompt insert)."""
+
+    __slots__ = ("idx", "cost", "conv", "prio")
+
+    def __init__(self, idx: int, cost: float = 0.0, conv: bool = False,
+                 prio: float = 0.0):
+        self.idx = idx
+        self.cost = cost
+        self.conv = conv
+        self.prio = prio
+
+
 class PrefixIndex:
-    """Host-side chain-hash index: block content -> pool slot, with LRU.
+    """Host-side chain-hash index: block content -> pool slot, with
+    LRU or cost-aware (GreedyDual) eviction.
 
     A block's key is ``blake2b(parent_digest || block_token_bytes)`` so
     equal token windows at different offsets/contexts never collide: block
@@ -62,17 +78,43 @@ class PrefixIndex:
     here silently serves one request KV computed from another request's
     content.  vLLM moved its prefix keys from builtin hash to sha256 for
     the same reason; a 16-byte blake2b costs ~1 us per block.
+
+    Eviction (ISSUE 14): ``evict="cost"`` runs GreedyDual — each page
+    carries ``prio = clock + recompute_cost_ms`` refreshed on every touch,
+    the victim is the minimum-priority page (ties broken by LRU order, so
+    the policy is deterministic for a fixed operation sequence), and the
+    clock advances to each victim's priority so long-idle pages age out
+    regardless of cost.  ``recompute_cost_ms`` is the page's full-prefix
+    token count times the engine's live per-token prefill-ms estimate —
+    losing page i of a chain orphans every page after it, so deep
+    (expensive, conversation-tail) pages outrank shallow cheap ones.
+    ``evict="lru"`` restores the pre-ISSUE-14 plain LRU.  Pure host state;
+    deterministic: same (insert, touch, cost) sequence, same evictions
+    (tests/test_paged_pool.py two-run identity).
     """
 
-    def __init__(self, block: int, capacity: int):
+    def __init__(self, block: int, capacity: int, evict: str = "lru"):
         assert capacity >= 2, "need at least scratch + one real block"
+        if evict not in ("lru", "cost"):
+            raise ValueError(f"unknown evict mode {evict!r}")
         self.block = block
         self.capacity = capacity
+        self.evict = evict
         # Pool index 0 is the scratch block (insert-padding target).
         self._free: List[int] = list(range(1, capacity))
-        self._lru: "OrderedDict[bytes, int]" = OrderedDict()  # key -> pool idx
+        self._lru: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._clock = 0.0
+        self._keys_memo: "OrderedDict[tuple, List[bytes]]" = OrderedDict()
         self.hits = 0
         self.lookups = 0
+        # ISSUE 14 accounting: evictions + conversation-cache reuse, read
+        # by the engine's delta-inc publisher, and the admission-time page
+        # reservation tally (advisory; released grants must zero it — the
+        # leak-gate invariant).
+        self.evictions = 0
+        self.conv_hits = 0
+        self.conv_hit_tokens = 0
+        self.reserved_pages = 0
 
     @property
     def used_blocks(self) -> int:
@@ -85,33 +127,50 @@ class PrefixIndex:
         return len(self._free)
 
     def export_state(self) -> List[List]:
-        """LRU-ordered [[hex key, pool idx], ...] (oldest first) for the
-        pool snapshot."""
-        return [[k.hex(), idx] for k, idx in self._lru.items()]
+        """LRU-ordered [[hex key, pool idx, cost, conv], ...] (oldest
+        first) for the pool snapshot."""
+        return [
+            [k.hex(), e.idx, round(e.cost, 3), int(e.conv)]
+            for k, e in self._lru.items()
+        ]
 
     def import_state(self, entries: List[List]) -> None:
         """Restore a snapshot's index; unreferenced pool slots become free.
         Malformed entries are skipped — a damaged manifest must degrade to
-        a (partially) cold pool, never crash engine startup."""
+        a (partially) cold pool, never crash engine startup.  Accepts both
+        the 2-field pre-ISSUE-14 shape and the 4-field (cost, conv) one."""
         self._lru.clear()
+        self._clock = 0.0
         used = set()
         for entry in entries:
             try:
-                khex, idx = entry
-                idx = int(idx)
+                khex, idx = entry[0], int(entry[1])
                 key = bytes.fromhex(khex)
-            except (TypeError, ValueError):
+                cost = float(entry[2]) if len(entry) > 2 else 0.0
+                conv = bool(entry[3]) if len(entry) > 3 else False
+            except (TypeError, ValueError, IndexError):
                 continue
             if not 1 <= idx < self.capacity or idx in used:
                 # Out-of-range (larger pool) or duplicate index (damaged
                 # manifest): admitting it would alias two prefix keys to
                 # one KV block — another prompt's cache served silently.
                 continue
-            self._lru[key] = idx
+            self._lru[key] = _Entry(idx, cost, conv, prio=cost)
             used.add(idx)
         self._free = [i for i in range(1, self.capacity) if i not in used]
 
+    #: Bounded chain-key memo: one admitted request's prompt is hashed at
+    #: up to THREE serving-path sites (reserve at admission, match at
+    #: prefill planning, missing at insert) and herd prompts repeat —
+    #: keyed by the exact token tuple so a hit can never alias.
+    KEYS_MEMO_CAP = 128
+
     def _keys_of(self, prompt_ids) -> List[bytes]:
+        memo_key = tuple(prompt_ids)
+        hit = self._keys_memo.get(memo_key)
+        if hit is not None:
+            self._keys_memo.move_to_end(memo_key)
+            return hit
         keys = []
         h = b""
         b = self.block
@@ -121,6 +180,9 @@ class PrefixIndex:
                 h + window.tobytes(), digest_size=16
             ).digest()
             keys.append(h)
+        self._keys_memo[memo_key] = keys
+        if len(self._keys_memo) > self.KEYS_MEMO_CAP:
+            self._keys_memo.popitem(last=False)
         return keys
 
     def match(self, prompt_ids) -> Tuple[int, List[int]]:
@@ -132,21 +194,31 @@ class PrefixIndex:
         self.lookups += 1
         max_blocks = (len(prompt_ids) - 1) // self.block
         ids: List[int] = []
+        conv_blocks = 0
         for key in self._keys_of(prompt_ids)[:max_blocks]:
-            idx = self._lru.get(key)
-            if idx is None:
+            entry = self._lru.get(key)
+            if entry is None:
                 break
             self._lru.move_to_end(key)  # touched = most recent
-            ids.append(idx)
+            entry.prio = self._clock + entry.cost
+            if entry.conv:
+                conv_blocks += 1
+            ids.append(entry.idx)
         if ids:
             self.hits += 1
+        if conv_blocks:
+            # Conversation reuse (ISSUE 14): this match reached INTO pages
+            # saved from a finished stream — a returning user's history.
+            self.conv_hits += 1
+            self.conv_hit_tokens += conv_blocks * self.block
         return len(ids) * self.block, ids
 
     def id_of(self, key: bytes) -> Optional[int]:
         """Current pool slot for ``key`` (no LRU touch), or None if evicted
         — the engine's batched insert uses this to drop (key, id) pairs a
         later same-wave allocation evicted."""
-        return self._lru.get(key)
+        entry = self._lru.get(key)
+        return None if entry is None else entry.idx
 
     def missing(self, prompt_ids) -> List[Tuple[int, bytes]]:
         """Fully-covered prompt blocks not yet pooled: [(block_no, key)]."""
@@ -156,9 +228,64 @@ class PrefixIndex:
             if key not in self._lru
         ]
 
-    def allocate(self, keys: List[bytes]) -> List[int]:
-        """Assign a pool slot per key (evicting LRU as needed); the caller
-        must then actually copy the block content in.
+    def _pick_victim(self, protect: set) -> Optional[bytes]:
+        """The next eviction victim, or None when every page is protected
+        (allocated in the in-progress call).  "lru": the least-recently
+        touched page.  "cost": the minimum-priority page, LRU order
+        breaking ties — deterministic by OrderedDict iteration."""
+        if self.evict == "lru":
+            for key in self._lru:
+                if key not in protect:
+                    return key
+            return None
+        best_key, best_prio = None, None
+        for key, entry in self._lru.items():
+            if key in protect:
+                continue
+            if best_prio is None or entry.prio < best_prio:
+                best_key, best_prio = key, entry.prio
+        return best_key
+
+    def _evict_one(self, protect: set) -> Optional[int]:
+        """Evict one page (policy above); returns its freed pool idx."""
+        victim = self._pick_victim(protect)
+        if victim is None:
+            return None
+        entry = self._lru.pop(victim)
+        # GreedyDual clock: the pool's value floor rises to each victim's
+        # priority, so surviving pages age relative to it (an untouched
+        # expensive page eventually loses to fresh cheap ones).
+        self._clock = max(self._clock, entry.prio)
+        self.evictions += 1
+        return entry.idx
+
+    def reserve(self, n: int) -> int:
+        """Admission-time page reservation (ISSUE 14): make room for up to
+        ``n`` pages NOW — evicting under the configured policy — and
+        record the grant.  Returns the granted count; the caller must
+        :meth:`release` exactly that many (on insert or on any death
+        path).  Advisory accounting: allocation does not hard-partition
+        the free list, it only pre-drains pressure off the serving wave.
+        """
+        grant = min(n, max(0, (self.capacity - 1) - self.reserved_pages))
+        want = self.reserved_pages + grant
+        while len(self._free) < want:
+            idx = self._evict_one(set())
+            if idx is None:
+                break
+            self._free.append(idx)
+        self.reserved_pages += grant
+        return grant
+
+    def release(self, n: int) -> None:
+        self.reserved_pages = max(0, self.reserved_pages - n)
+
+    def allocate(self, keys: List[bytes], costs: Optional[List[float]] = None,
+                 conv: bool = False) -> List[int]:
+        """Assign a pool slot per key (evicting as needed); the caller
+        must then actually copy the block content in.  ``costs`` (one per
+        key, ms) feeds cost-aware eviction; ``conv`` tags the pages as
+        conversation-cache content (finished-stream KV).
 
         May return FEWER ids than keys: allocation stops rather than evict
         a key allocated in this same call (a prompt with more blocks than
@@ -167,16 +294,17 @@ class PrefixIndex:
         the requested blocks is still a matchable chain prefix.
         """
         out: List[int] = []
-        newly = set()
-        for key in keys:
+        newly: set = set()
+        for j, key in enumerate(keys):
             if self._free:
                 idx = self._free.pop()
             else:
-                victim, idx = next(iter(self._lru.items()))
-                if victim in newly:
+                idx = self._evict_one(newly)
+                if idx is None:
                     break  # pool exhausted by this very call: stop
-                self._lru.popitem(last=False)
-            self._lru[key] = idx
+            cost = costs[j] if costs is not None else 0.0
+            self._lru[key] = _Entry(idx, cost, conv,
+                                    prio=self._clock + cost)
             newly.add(key)
             out.append(idx)
         return out
@@ -330,45 +458,74 @@ def load_pool_snapshot(
     return out
 
 
+def pool_packed_keys(kv_cache: Dict[str, jnp.ndarray]) -> frozenset:
+    """The cache leaves whose sequence axis is BYTE-packed (two tokens per
+    byte — the kv_quant="int4" value planes, recognized the same way
+    transformer.kv_cache_quant_mode does).  Pages of these leaves are
+    ``block // 2`` bytes; everything else (scales, unquantized caches) is
+    ``block`` positions."""
+    if ("k_scale" in kv_cache
+            and kv_cache["k"].shape[2] * 2 == kv_cache["k_scale"].shape[2]):
+        return frozenset({"k", "v"})
+    return frozenset()
+
+
 def init_pool(kv_cache: Dict[str, jnp.ndarray], block: int, capacity: int):
     """Pool arrays mirroring the cache dict's dtypes: cache [L, Slots, S, ...]
-    -> pool [L, capacity, block, ...]."""
+    -> pool [L, capacity, block, ...].  Packed int4 value leaves store
+    ``block // 2`` BYTES per page (``block`` must be even under int4 —
+    the ISSUE 14 page-alignment guarantee the engine enforces)."""
+    packed = pool_packed_keys(kv_cache)
+    if packed and block % 2:
+        raise ValueError(
+            f"packed int4 pool pages must be even-sized, got block={block}"
+        )
     return {
         key: jnp.zeros(
-            (arr.shape[0], capacity, block) + arr.shape[3:], arr.dtype
+            (arr.shape[0], capacity,
+             block // 2 if key in packed else block) + arr.shape[3:],
+            arr.dtype,
         )
         for key, arr in kv_cache.items()
     }
 
 
-def make_copy_ops(block: int, max_blocks: int):
+def make_copy_ops(block: int, max_blocks: int,
+                  packed_keys: frozenset = frozenset()):
     """The two jitted copy programs, closed over static (block, max_blocks).
 
     Both take ``ids``/``blk_nos`` arrays of length EXACTLY ``max_blocks``
     and ``n`` is pre-applied by the caller via clamping (see pad_ids) —
     shapes never depend on the match length, so each op compiles once.
+    ``packed_keys`` leaves move in ``block // 2``-byte page units (the
+    int4 value planes); positions stay whole-byte by the page-alignment
+    contract.
     """
+
+    def _pos(unit):
+        offs = jnp.arange(unit)[None, :]
+        return lambda blk_nos: (blk_nos[:, None] * unit + offs).reshape(-1)
 
     def blocks_to_cache(cache, pool, slot, pool_ids, blk_nos):
         """cache[slot] positions [blk_no*B, +B) <- pool[pool_ids]."""
-        offs = jnp.arange(block)[None, :]
-        pos = (blk_nos[:, None] * block + offs).reshape(-1)  # [Nmax*B]
         out = dict(cache)
         for key, arr in cache.items():
-            vals = pool[key][:, pool_ids]  # [L, Nmax, B, ...]
+            unit = block // 2 if key in packed_keys else block
+            pos = _pos(unit)(blk_nos)  # [Nmax*unit]
+            vals = pool[key][:, pool_ids]  # [L, Nmax, unit, ...]
             flat = vals.reshape((vals.shape[0], -1) + vals.shape[3:])
             out[key] = arr.at[:, slot, pos].set(flat)
         return out
 
     def cache_to_pool(pool, cache, slot, pool_ids, blk_nos):
         """pool[pool_ids] <- cache[slot] positions [blk_no*B, +B)."""
-        offs = jnp.arange(block)[None, :]
-        pos = (blk_nos[:, None] * block + offs).reshape(-1)
         out = dict(pool)
         for key, arr in pool.items():
-            vals = cache[key][:, slot, pos]  # [L, Nmax*B, ...]
+            unit = block // 2 if key in packed_keys else block
+            pos = _pos(unit)(blk_nos)
+            vals = cache[key][:, slot, pos]  # [L, Nmax*unit, ...]
             vals = vals.reshape(
-                (vals.shape[0], max_blocks, block) + vals.shape[2:]
+                (vals.shape[0], max_blocks, unit) + vals.shape[2:]
             )
             out[key] = arr.at[:, pool_ids].set(vals)
         return out
@@ -380,11 +537,18 @@ def make_copy_ops(block: int, max_blocks: int):
 
 
 def plan_inserts(
-    index: PrefixIndex, wave: List[Tuple[int, List[int]]]
+    index: PrefixIndex, wave: List[Tuple[int, List[int]]],
+    ms_per_token: float = 1.0, conv: bool = False,
 ) -> List[Tuple[int, List[int], List[int]]]:
     """Host-side planning for a batched pool insert: allocate blocks for
     every run's missing prompt blocks, then drop pairs a later same-wave
     allocation evicted.
+
+    ``ms_per_token`` prices each page for cost-aware eviction — page i's
+    recompute cost is its FULL-PREFIX token count ``(i+1) * block`` times
+    it, since losing page i orphans every deeper page of the chain.
+    ``conv`` tags the pages as conversation-cache content (ISSUE 14:
+    finished-stream KV saved by the engine's end-of-iteration drain).
 
     ``wave`` is [(slot, prompt_ids)].  All index updates happen here for
     the WHOLE wave before any device copy; with a tiny pool and a big wave
@@ -405,9 +569,10 @@ def plan_inserts(
             continue
         keys = [k for _, k in missing]
         blk_nos = [i for i, _ in missing]
+        costs = [(i + 1) * index.block * ms_per_token for i, _ in missing]
         # allocate() may return a PREFIX of the request when the pool is
         # smaller than the prompt; insert exactly what got ids.
-        ids = index.allocate(keys)
+        ids = index.allocate(keys, costs=costs, conv=conv)
         if ids:
             allocs.append((slot, keys[: len(ids)], blk_nos[: len(ids)], ids))
     entries: List[Tuple[int, List[int], List[int]]] = []
@@ -432,7 +597,8 @@ def plan_inserts(
     return entries
 
 
-def make_batch_copy_ops(block: int, max_blocks: int, rows: int):
+def make_batch_copy_ops(block: int, max_blocks: int, rows: int,
+                        packed_keys: frozenset = frozenset()):
     """Row-batched copy programs: ONE dispatch serves up to ``rows``
     requests' block copies.
 
@@ -447,7 +613,14 @@ def make_batch_copy_ops(block: int, max_blocks: int, rows: int):
     Same static-shape discipline as :func:`make_copy_ops`: ids pad
     within-row (clamped duplicate pairs / scratch block 0) AND across rows
     (row 0 repeated, or all-scratch rows), so each op compiles once ever.
+    ``packed_keys`` leaves (the int4 value planes) move in
+    ``block // 2``-byte page units — pages stay whole-byte by the ISSUE 14
+    alignment guarantee, so packed copies are plain scatters too.
     """
+
+    def _pos(unit, blk_nos):
+        offs = jnp.arange(unit)[None, None, :]
+        return (blk_nos[:, :, None] * unit + offs).reshape(rows, -1)
 
     def blocks_to_cache(cache, pool, slots, pool_ids, blk_nos):
         """cache[slots[r]] positions [blk_nos[r,i]*B, +B) <- pool[pool_ids[r,i]].
@@ -455,11 +628,11 @@ def make_batch_copy_ops(block: int, max_blocks: int, rows: int):
         slots [R]; pool_ids/blk_nos [R, Nmax].  Padding rows repeat a real
         row — duplicate scatters write identical bytes, so order cannot
         matter."""
-        offs = jnp.arange(block)[None, None, :]
-        pos = (blk_nos[:, :, None] * block + offs).reshape(rows, -1)
         out = dict(cache)
         for key, arr in cache.items():
-            vals = pool[key][:, pool_ids]  # [L, R, Nmax, B, ...]
+            unit = block // 2 if key in packed_keys else block
+            pos = _pos(unit, blk_nos)
+            vals = pool[key][:, pool_ids]  # [L, R, Nmax, unit, ...]
             flat = vals.reshape(
                 (vals.shape[0], rows, pos.shape[1]) + vals.shape[4:]
             )
@@ -472,14 +645,14 @@ def make_batch_copy_ops(block: int, max_blocks: int, rows: int):
         matched.  Real pool ids must be wave-distinct — the caller filters
         same-wave eviction casualties so the flat scatter never writes two
         different contents to one live block."""
-        offs = jnp.arange(block)[None, None, :]
-        pos = (blk_nos[:, :, None] * block + offs).reshape(rows, -1)
         flat_ids = pool_ids.reshape(-1)
         out = dict(pool)
         for key, arr in pool.items():
-            vals = cache[key][:, slots[:, None], pos]  # [L, R, Nmax*B, ...]
+            unit = block // 2 if key in packed_keys else block
+            pos = _pos(unit, blk_nos)
+            vals = cache[key][:, slots[:, None], pos]  # [L, R, Nmax*unit, ...]
             vals = vals.reshape(
-                (vals.shape[0], rows * max_blocks, block) + vals.shape[3:]
+                (vals.shape[0], rows * max_blocks, unit) + vals.shape[3:]
             )
             out[key] = arr.at[:, flat_ids].set(vals)
         return out

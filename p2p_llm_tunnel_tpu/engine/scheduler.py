@@ -284,6 +284,13 @@ class Scheduler:
         #: scheduler-driven slot mutation.
         self._running_tenants: frozenset = frozenset()
         self._slots_dirty = False
+        #: Page-reservation hook (ISSUE 14): when set (by the engine, with
+        #: a prefix pool configured), admit() calls it once per admitted
+        #: request BEFORE the engine sees the admission — the engine
+        #: reserves the pool pages the request's prompt insert will want,
+        #: evicting cost-aware under pressure AT admission time instead of
+        #: thrashing the pool mid-wave.  Pure host work; None = no pool.
+        self.page_reserve: Optional[object] = None
 
     # -- tenant bookkeeping ------------------------------------------------
 
@@ -548,6 +555,11 @@ class Scheduler:
             run = RunningSlot(req, i, cache_len=len(req.prompt_ids))
             self.slots[i] = run
             self._slots_dirty = True
+            if self.page_reserve is not None:
+                # Reserve prefix-pool pages for this admission (ISSUE 14);
+                # the engine releases the grant when the insert lands or
+                # on any death path (generate()'s finally).
+                self.page_reserve(req)
             admitted.append(run)
         return admitted
 

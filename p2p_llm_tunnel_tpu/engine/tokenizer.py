@@ -57,6 +57,32 @@ class ByteTokenizer:
         return bytes([token_id]).decode("utf-8", "replace")
 
 
+class Latin1Tokenizer(ByteTokenizer):
+    """ByteTokenizer with a BIJECTIVE byte<->text mapping (latin-1).
+
+    Random-weight models generate arbitrary bytes, which the UTF-8
+    ByteTokenizer cannot round-trip through client-visible text
+    (invalid sequences decode to replacement chars).  Latin-1 maps every
+    byte to exactly one codepoint, so a chat client that replays an
+    assistant message re-encodes to the EXACT bytes sitting in the KV
+    cache — the property the conversation-cache replay experiment
+    (ISSUE 14, loadgen --turns against testing.local_stack) needs to hit
+    finished-stream pages with random weights.  Real checkpoints emit
+    valid UTF-8 and don't need this.
+    """
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("latin-1", "replace"))
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("latin-1")
+
+    def decode_token(self, token_id: int) -> str:
+        if token_id >= 256:
+            return ""
+        return bytes([token_id]).decode("latin-1")
+
+
 class NumericTokenizer:
     """Renders EVERY id as visible text (``"<id> "``), unlike ByteTokenizer
     where ids ≥ 256 decode to "".

@@ -578,19 +578,31 @@ def chunk_prefill_into_cache(
     this path re-taxed exactly the long prompts it exists to help).
     Writes still target the full cache row.
 
+    int4 page-alignment contract (ISSUE 14): the packed int4 cache IS
+    supported, under the alignment the block-paged pool guarantees —
+    every ``starts`` value and the padded tail width ``t`` must be EVEN
+    (a multiple of the two-tokens-per-byte packing), so the packed write
+    covers whole bytes and needs no read-modify-write.  The engine
+    enforces this by construction: chunk starts are multiples of
+    ``min_prefill_bucket`` (the pool page size) or ``prefill_chunk``,
+    both forced even under ``kv_quant="int4"``.  Junk pad positions past
+    a row's real length land in high nibbles that decode's RMW append
+    overwrites before they are ever attendable (the standard prefill-pad
+    argument; see ``prefill_into_cache``).  Spec-verify is the one
+    consumer whose starts are arbitrary token positions — it stays
+    engine-fenced under int4.
+
     Returns last-real-tail-token logits [Bp, V] and the updated cache.
     """
-    if kv_cache_quant_mode(kv_cache) == "int4":
-        # Tail starts are arbitrary positions: a packed write would need
-        # byte-aligned read-modify-writes per token.  The engine disables
-        # every chunk-prefill consumer (prefix cache, segments, spec)
-        # under kv_quant="int4" — whole-prompt prefill + decode cover it.
-        raise NotImplementedError(
-            "chunk_prefill_into_cache does not support the packed int4 "
-            "KV cache; the engine gates its callers off under kv_quant='int4'"
-        )
     b, t = tokens.shape
-    s = kv_cache["k"].shape[2]
+    quant_mode = kv_cache_quant_mode(kv_cache)
+    if quant_mode == "int4" and t % 2:
+        raise ValueError(
+            f"packed int4 chunk prefill needs an even (page-aligned) tail "
+            f"width, got {t}; the engine pads tails to even buckets"
+        )
+    # Logical sequence length: the int4 cache's sequence axis is byte-packed.
+    s = kv_cache["k"].shape[2] * (2 if quant_mode == "int4" else 1)
     if kv_view is None or kv_view > s:
         kv_view = s
     x = _embed(cfg, params, tokens)
@@ -598,6 +610,14 @@ def chunk_prefill_into_cache(
     layer_idx = jnp.arange(cfg.n_layers)
     quant = kv_cache_is_quantized(kv_cache)
     rows = slots[:, None]  # [Bp,1] broadcasts against pos [Bp,T]
+    if quant_mode == "int4":
+        from p2p_llm_tunnel_tpu.models.quant import pack_int4, unpack_int4
+
+        # Byte positions of the page-aligned packed write: starts is even
+        # by the contract above, so byte i of the write holds exactly
+        # tokens (starts + 2i, starts + 2i + 1) — whole bytes, plain
+        # scatter, no nibble RMW on the chunk path.
+        bpos = starts[:, None] // 2 + jnp.arange(t // 2)[None, :]
 
     from p2p_llm_tunnel_tpu.ops.attention import history_attention
 
@@ -607,7 +627,20 @@ def chunk_prefill_into_cache(
         h = _norm(cfg, x, blk["attn_norm"])
         q, k, v = _qkv(cfg, blk, h, pos)  # rope at global positions
         cache = dict(cache)
-        if quant:
+        if quant_mode == "int4":
+            kq, k_s = _quant_kv4(k)
+            vq, v_s = _quant_kv4(v)
+            # Page-aligned whole-byte scatter (see the docstring contract):
+            # the scale planes stay per-token full width.
+            cache["k"] = cache["k"].at[idx, rows, bpos].set(
+                pack_int4(kq, axis=1)
+            )
+            cache["v"] = cache["v"].at[idx, rows, bpos].set(
+                pack_int4(vq, axis=1)
+            )
+            cache["k_scale"] = cache["k_scale"].at[idx, rows, pos].set(k_s)
+            cache["v_scale"] = cache["v_scale"].at[idx, rows, pos].set(v_s)
+        elif quant:
             kq, k_s = _quant_kv(k)
             vq, v_s = _quant_kv(v)
             cache["k"] = cache["k"].at[idx, rows, pos].set(kq)
@@ -618,13 +651,19 @@ def chunk_prefill_into_cache(
             cache["k"] = cache["k"].at[idx, rows, pos].set(k)
             cache["v"] = cache["v"].at[idx, rows, pos].set(v)
         # One fused (layer, view) slice, then row gather: [Bp, view, K, D].
+        # (int4: the packed value planes slice kv_view // 2 BYTE rows and
+        # unpack to kv_view tokens in the operand read.)
+        view_rows = kv_view // 2 if quant_mode == "int4" else kv_view
         zero = jnp.zeros((), idx.dtype)
         start5 = (idx, zero, zero, zero, zero)
         lshape = (
-            (1, cache["k"].shape[1], kv_view) + cache["k"].shape[3:]
+            (1, cache["k"].shape[1], view_rows) + cache["k"].shape[3:]
         )
         k_all = jax.lax.dynamic_slice(cache["k"], start5, lshape)[0][slots]
         v_all = jax.lax.dynamic_slice(cache["v"], start5, lshape)[0][slots]
+        if quant_mode == "int4":
+            k_all = unpack_int4(k_all, axis=1)
+            v_all = unpack_int4(v_all, axis=1)
         if quant:
             sshape = (
                 (1, cache["k_scale"].shape[1], kv_view)

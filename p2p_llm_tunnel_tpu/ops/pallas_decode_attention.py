@@ -67,6 +67,40 @@ _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 BLOCK_K = 128
 
+#: Tokens per byte along the packed int4 sequence axis — THE packing
+#: constant the page-alignment contract (ISSUE 14) is a multiple of.
+#: Every kernel here handles a mid-byte FRONTIER (the nibble RMW in the
+#: fused append; nibble unpack in the s-grid reads), but bulk writers —
+#: chunk-prefill segments, pool page copies — must land on whole bytes:
+#: the engine keeps pool pages and chunk widths multiples of this.
+INT4_PACK_TOKENS = 2
+
+
+def page_alignment_violations(kv_quant: Optional[str], page_tokens: int,
+                              chunk_tokens: int) -> list:
+    """The ONE spelling of the ISSUE 14 block-page alignment rule, kept
+    beside the kernels whose packed-byte layout it protects: under
+    ``kv_quant="int4"`` the pool page size and the chunk-prefill segment
+    width must both be multiples of :data:`INT4_PACK_TOKENS`, so every
+    chunk start (a page or segment multiple) and every page copy covers
+    whole bytes — misalignment would silently corrupt the neighbouring
+    nibble's token.  Returns human-readable violation strings (empty =
+    aligned); the engine turns them into config fences at startup."""
+    if kv_quant != "int4":
+        return []
+    out = []
+    if page_tokens % INT4_PACK_TOKENS:
+        out.append(
+            f"pool page size {page_tokens} is not a multiple of the int4 "
+            f"packing ({INT4_PACK_TOKENS} tokens/byte)"
+        )
+    if chunk_tokens > 0 and chunk_tokens % INT4_PACK_TOKENS:
+        out.append(
+            f"chunk segment width {chunk_tokens} is not a multiple of the "
+            f"int4 packing ({INT4_PACK_TOKENS} tokens/byte)"
+        )
+    return out
+
 
 def _decode_kernel(
     pos_ref,  # SMEM (1, 1) int32: this slot's query position

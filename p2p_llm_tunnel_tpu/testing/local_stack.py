@@ -79,10 +79,23 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-fair-admission", action="store_true",
                     help="disable tenant-fair admission (the A/B lever "
                          "for the aggressor experiment)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    default=os.environ.get("TUNNEL_PREFIX_CACHE") == "1",
+                    help="enable the prefix pool (+ conversation cache) — "
+                         "the loadgen --turns experiment's server side")
     return ap
 
 
 async def amain(args) -> None:
+    tokenizer = None
+    if args.prefix_cache:
+        # Conversation-replay experiments need the byte<->text mapping to
+        # be bijective: random-weight generations are arbitrary bytes,
+        # and only a lossless round-trip lets a replayed assistant
+        # message re-render to the exact cached token stream.
+        from p2p_llm_tunnel_tpu.engine.tokenizer import Latin1Tokenizer
+
+        tokenizer = Latin1Tokenizer()
     engine = InferenceEngine(engine_cfg=EngineConfig(
         model=args.model,
         num_slots=args.slots,
@@ -92,8 +105,10 @@ async def amain(args) -> None:
         fair_admission=not args.no_fair_admission,
         tenant_weights=args.tenant_weights,
         mux=True,
+        prefix_cache=args.prefix_cache,
+        conv_cache=args.prefix_cache,
         watchdog_budget_s=120.0,
-    ))
+    ), tokenizer=tokenizer)
     await engine.start()
     await engine.warmup()
 

@@ -85,6 +85,15 @@ FLIGHT_SCHEMA: Dict[str, str] = {
     "tenants": "distinct tenants holding decode slots",
     "waiters": "requests parked behind an in-flight shared-prefix owner",
     "prefix_blocks_used": "prefix-pool blocks in use (0 when the pool is off)",
+    "prefix_pages_reserved": (
+        "pool pages reserved by admissions whose prompt insert has not "
+        "landed yet (ISSUE 14; a nonzero steady state in a postmortem "
+        "tail is a reservation leak)"
+    ),
+    "conv_inserted": (
+        "finished conversations whose KV the end-of-iteration drain saved "
+        "into the pool this iteration (ISSUE 14)"
+    ),
     "cold_compiles": "mid-serve cold compiles detected during this iteration",
     "streams_detached": (
         "streams parked in the detached-stream registry's grace window "

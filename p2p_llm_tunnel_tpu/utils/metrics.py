@@ -198,6 +198,31 @@ METRICS_CATALOG: Dict[str, str] = {
         "kv_quant mode — int8/int4 pools store proportionally fewer bytes "
         "per block)"
     ),
+    # -- block-paged pool + conversation cache (ISSUE 14) -----------------
+    "engine_prefix_pool_pages_reserved": (
+        "pool pages reserved by admissions whose prompt insert has not "
+        "landed yet (gauge; nonzero after every stream finished is a "
+        "reservation leak — the test_paged_pool leak-gate invariant)"
+    ),
+    "engine_prefix_evictions_total": (
+        "pool pages evicted to make room (counter; cost-aware GreedyDual "
+        "by default — pages weigh their full-prefix recompute cost, "
+        "tokens x live per-token prefill ms)"
+    ),
+    "engine_conv_saved_pages_total": (
+        "conversation-cache pages saved from finished streams' KV — "
+        "prompt AND generated tokens (counter; also counted in "
+        "engine_prefix_saved_blocks_total)"
+    ),
+    "engine_conv_hits_total": (
+        "admissions whose prefix match reached into conversation-cache "
+        "pages — a returning user's history reused (counter)"
+    ),
+    "engine_conv_hit_tokens_total": (
+        "prompt tokens served from conversation-cache pages instead of "
+        "re-prefilling a resent history (counter; the multi-turn "
+        "re-prefill saving, turn-2+ prefills tail-only)"
+    ),
     # -- fleet observability plane (ISSUE 9) ------------------------------
     # The fleet_* names live in the PROXY process: aggregates over its
     # PeerSet, refreshed by /metrics?fleet=1 scrapes and the PeerSet's
@@ -400,6 +425,12 @@ class Metrics:
         #: {label value: (gauge value, last-set time)}), bounded at
         #: LABELED_CAP labels per family.
         self._labeled: Dict[str, Tuple[str, Dict[str, Tuple[float, float]]]] = {}
+        #: Structured CONFIGURATION facts (ISSUE 14: the composition-fence
+        #: registry) published by the engine for /healthz to read without
+        #: an engine reference.  Not measurements: reset() keeps them —
+        #: wiping the fence list on a metrics reset would report a fenced
+        #: engine as unfenced.
+        self._info: Dict[str, object] = {}
         self._t0 = time.monotonic()
 
     def inc(self, name: str, amount: float = 1.0) -> None:
@@ -453,6 +484,16 @@ class Metrics:
                 return
             for label in [l for l in fam[1] if l not in keep]:
                 del fam[1][label]
+
+    def set_info(self, name: str, value: object) -> None:
+        """Publish one structured configuration fact (JSON-able; e.g. the
+        ``config_fences`` list).  Unlike gauges these survive reset()."""
+        with self._lock:
+            self._info[name] = value
+
+    def info(self, name: str, default: object = None) -> object:
+        with self._lock:
+            return self._info.get(name, default)
 
     def counter(self, name: str) -> float:
         with self._lock:

@@ -94,7 +94,7 @@ async def _iter_body(reader, headers):
 
 class ReqResult:
     __slots__ = ("status", "ttfb_ms", "ttft_ms", "tokens", "wall_ms",
-                 "outcome", "finish", "retry_after")
+                 "outcome", "finish", "retry_after", "text")
 
     def __init__(self):
         self.status = 0
@@ -105,6 +105,9 @@ class ReqResult:
         self.outcome = "error"  # ok | shed | error | stuck
         self.finish = None  # finish_reason of the last SSE chunk, if any
         self.retry_after = None
+        # Concatenated SSE content deltas — only captured in --turns mode,
+        # where each client replays its own growing conversation history.
+        self.text = ""
 
 
 #: finish_reason values that mean the server SHED the stream after the 200
@@ -127,17 +130,25 @@ TERMINAL_ERROR_CODES = frozenset({"peer_lost", "tunnel_reset"})
 
 
 async def one_request(host: str, port: int, tenant: str, rid: str,
-                      prompt: str, max_tokens: int) -> ReqResult:
+                      prompt: str, max_tokens: int,
+                      capture_text: bool = False,
+                      messages: Optional[List[dict]] = None,
+                      logit_bias: Optional[Dict[str, float]] = None
+                      ) -> ReqResult:
     out = ReqResult()
     t0 = time.monotonic()
-    body = json.dumps({
+    payload = {
         "model": "loadgen",
-        "messages": [{"role": "user", "content": prompt}],
+        "messages": (messages if messages is not None
+                     else [{"role": "user", "content": prompt}]),
         "max_tokens": max_tokens,
         "stream": True,
         "temperature": 0.0,
         "ignore_eos": True,
-    }).encode()
+    }
+    if logit_bias:
+        payload["logit_bias"] = logit_bias
+    body = json.dumps(payload).encode()
     req = (
         f"POST /v1/chat/completions HTTP/1.1\r\n"
         f"host: {host}:{port}\r\n"
@@ -185,6 +196,8 @@ async def one_request(host: str, port: int, tenant: str, rid: str,
                     out.ttft_ms = (time.monotonic() - t0) * 1000.0
                 if delta.get("content"):
                     out.tokens += 1
+                    if capture_text:
+                        out.text += delta["content"]
         if status == 200:
             # A 200 is not automatically a success: a stream displaced
             # after admission ends with a typed shed finish_reason on an
@@ -226,6 +239,47 @@ async def one_client(host: str, port: int, tenant: str, idx: int,
         results.append(await one_request(
             host, port, tenant, f"{tenant}-{idx}-{r}", prompt, max_tokens
         ))
+
+
+#: Turns-mode logit bias banning the byte tokenizers' special ids
+#: (PAD/BOS/EOS) from being SAMPLED: they decode to "" — invisible in the
+#: replayed text while present in the server's KV chain — so one sampled
+#: special would silently break the conversation-cache byte-exactness the
+#: experiment measures.  Random weights sample them ~1% of tokens;
+#: real-checkpoint tokenizers frame specials via their chat template and
+#: don't need this (--ban-ids "" disables).
+DEFAULT_BAN_IDS = "256,257,258"
+
+
+async def one_turn(host: str, port: int, tenant: str, idx: int, turn: int,
+                   histories: Dict, prompt_pad: int, max_tokens: int,
+                   delay: float, results: List[ReqResult],
+                   logit_bias: Optional[Dict[str, float]] = None) -> int:
+    """One conversation TURN (ISSUE 14 --turns mode): the client resends
+    its ENTIRE message history — every prior user line and assistant
+    response, the way real chat clients replay conversations — plus a
+    fresh user message, then appends the response to its history.
+    Returns the rendered-prompt length sent (bytes ~ tokens under the
+    byte tokenizer), so the per-turn report can show resent-history
+    volume next to the prefill tokens the server ACTUALLY computed."""
+    if delay > 0:
+        await asyncio.sleep(delay)
+    msgs = histories[(tenant, idx)]
+    user = f"turn {turn} {tenant} {idx} ".ljust(prompt_pad, "y")
+    msgs = msgs + [{"role": "user", "content": user}]
+    r = await one_request(
+        host, port, tenant, f"{tenant}-{idx}-t{turn}", user, max_tokens,
+        capture_text=True, messages=msgs, logit_bias=logit_bias,
+    )
+    histories[(tenant, idx)] = msgs + [
+        {"role": "assistant", "content": r.text}
+    ]
+    results.append(r)
+    # The server renders "role: content\n..." + the assistant cue; this
+    # mirrors engine.api.render_chat_prompt's arithmetic closely enough
+    # for the sent-volume column (exact prefill counts come from the
+    # server's own metrics delta).
+    return sum(len(m["content"]) + len(m["role"]) + 3 for m in msgs) + 10
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +324,14 @@ POLL_KEYS = (
     "serve_stream_resumes_total",
     "serve_streams_detached",
     "serve_replay_buffer_bytes",
+    # Block-paged pool + conversation cache (ISSUE 14): pool occupancy,
+    # reservation level (the leak-gate gauge), and the per-turn prefill /
+    # conversation-reuse counters the --turns report differences.
+    "engine_prefill_tokens_total",
+    "engine_prefix_pool_blocks_used",
+    "engine_prefix_pool_pages_reserved",
+    "engine_conv_hit_tokens_total",
+    "engine_conv_hits_total",
 )
 POLL_QUANTILES = {
     "engine_ttft_ms": ("0.5", "0.99"),
@@ -411,19 +473,86 @@ async def run_load(args) -> dict:
         poller = asyncio.create_task(metrics_poller(
             args.host, args.port, args.metrics_poll, t0, timeline,
         ))
-    for name, clients, requests in args.tenants:
-        results = per_tenant.setdefault(name, [])
-        for i in range(clients):
-            # Stagger connection starts across the ramp so the connect
-            # storm itself is not the experiment.
-            delay = args.ramp * i / max(1, clients)
-            tasks.append(asyncio.create_task(one_client(
-                args.host, args.port, name, i, requests,
-                args.prompt_pad, args.max_tokens, delay, results,
-            )))
-    done, pending = await asyncio.wait(tasks, timeout=args.timeout)
-    for t in pending:
-        t.cancel()
+    pending: set = set()
+    turn_rows: List[dict] = []
+    if args.turns > 1:
+        # Multi-turn conversation mode (ISSUE 14): the herd advances in
+        # LOCKSTEP turn phases — every client completes turn T before any
+        # starts T+1 — so the /metrics deltas between phases attribute
+        # prefill tokens and conversation-cache hits to exactly one turn.
+        # With the conversation cache on, turn-2+ prefill_tokens should
+        # collapse to ~the new tail per client while prompt_tokens_sent
+        # keeps growing with the resent history.
+        histories: Dict = {
+            (name, i): []
+            for name, clients, _r in args.tenants for i in range(clients)
+        }
+        ban = {
+            tid.strip(): -100.0
+            for tid in (args.ban_ids or "").split(",") if tid.strip()
+        } or None
+        deadline = t0 + args.timeout
+        for turn in range(args.turns):
+            pre_text = await fetch_metrics(
+                args.host, args.port, "/metrics", 5.0)
+            pre_s = (parse_metrics_sample(pre_text)
+                     if pre_text is not None else {})
+            turn_tasks = []
+            for name, clients, _requests in args.tenants:
+                results = per_tenant.setdefault(name, [])
+                for i in range(clients):
+                    delay = (args.ramp * i / max(1, clients)
+                             if turn == 0 else 0.0)
+                    turn_tasks.append(asyncio.create_task(one_turn(
+                        args.host, args.port, name, i, turn, histories,
+                        args.prompt_pad, args.max_tokens, delay, results,
+                        logit_bias=ban,
+                    )))
+            done, pend = await asyncio.wait(
+                turn_tasks, timeout=max(0.1, deadline - time.monotonic())
+            )
+            for t in pend:
+                t.cancel()
+            tasks.extend(turn_tasks)
+            pending |= pend
+            post_text = await fetch_metrics(
+                args.host, args.port, "/metrics", 5.0)
+            post_s = (parse_metrics_sample(post_text)
+                      if post_text is not None else {})
+
+            def _delta(key):
+                if key in pre_s and key in post_s:
+                    return int(post_s[key] - pre_s[key])
+                return None
+
+            turn_rows.append({
+                "turn": turn,
+                "prompt_tokens_sent": sum(
+                    t.result() for t in done
+                    if not t.cancelled() and t.exception() is None
+                ),
+                "prefill_tokens": _delta("engine_prefill_tokens_total"),
+                "conv_hit_tokens": _delta("engine_conv_hit_tokens_total"),
+                "conv_hits": _delta("engine_conv_hits_total"),
+                "pool_pages_used": post_s.get(
+                    "engine_prefix_pool_blocks_used"),
+            })
+            if pend:
+                break  # stuck clients: stop advancing turns
+    else:
+        for name, clients, requests in args.tenants:
+            results = per_tenant.setdefault(name, [])
+            for i in range(clients):
+                # Stagger connection starts across the ramp so the connect
+                # storm itself is not the experiment.
+                delay = args.ramp * i / max(1, clients)
+                tasks.append(asyncio.create_task(one_client(
+                    args.host, args.port, name, i, requests,
+                    args.prompt_pad, args.max_tokens, delay, results,
+                )))
+        done, pending = await asyncio.wait(tasks, timeout=args.timeout)
+        for t in pending:
+            t.cancel()
     if poller is not None:
         poller.cancel()
         await asyncio.gather(poller, return_exceptions=True)
@@ -438,7 +567,9 @@ async def run_load(args) -> dict:
         got = len(per_tenant[name])
         # Tasks cancelled or crashed mid-flight under-report; every
         # planned request must land in some bucket — mark the gap stuck.
-        expect = clients * requests
+        # (--turns mode plans one request per client per COMPLETED-or-
+        # attempted turn phase.)
+        expect = clients * (len(turn_rows) if args.turns > 1 else requests)
         for _ in range(expect - got):
             r = ReqResult()
             r.outcome = "stuck"
@@ -456,6 +587,7 @@ async def run_load(args) -> dict:
         if resumes1 is not None:
             resumed = int(resumes1 - resumes0)
     streams_hz = (healthz or {}).get("streams") or {}
+    pool_hz = (healthz or {}).get("prefix_pool") or {}
     out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
@@ -475,10 +607,17 @@ async def run_load(args) -> dict:
             "slot_occupancy": healthz.get("slot_occupancy"),
             "streams_detached": streams_hz.get("detached"),
             "replay_buffer_bytes": streams_hz.get("replay_buffer_bytes"),
+            # ISSUE 14 leak gate: page reservations must return to zero
+            # once every stream finished — a leftover grant pins pool
+            # pressure forever (the deadline/cancel/owner-death paths the
+            # engine's generate() finally releases).
+            "pool_pages_reserved": pool_hz.get("pages_reserved"),
             "tenants": healthz.get("tenants"),
             "retry_after_s": healthz.get("retry_after_s"),
         },
     }
+    if args.turns > 1:
+        out["turns"] = turn_rows
     if args.metrics_poll > 0:
         # The in-run timeline next to the summary row (--metrics-poll):
         # sheds/TTFT/queue depth sampled every poll interval, so a PERF
@@ -500,6 +639,9 @@ def spawn_stack(args) -> Tuple[subprocess.Popen, int]:
         cmd += ["--tenant-weights", args.stack_tenant_weights]
     if args.stack_no_fair:
         cmd += ["--no-fair-admission"]
+    if args.turns > 1:
+        # The conversation-cache experiment needs the pool server-side.
+        cmd += ["--prefix-cache"]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -540,6 +682,19 @@ def main(argv=None) -> int:
                     help="name:clients[:requests] (repeatable; default "
                          "herd:500)")
     ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--ban-ids", default=DEFAULT_BAN_IDS,
+                    help="turns mode: comma-separated token ids biased out "
+                         "of sampling (-100) so invisible specials can't "
+                         "break the replayed conversation's byte chain; "
+                         "'' disables (real-checkpoint deployments)")
+    ap.add_argument("--turns", type=int, default=1,
+                    help="multi-turn conversation mode (ISSUE 14): each "
+                         "client replays its full growing history per "
+                         "turn, N lockstep turn phases; the report gains "
+                         "a per-turn 'turns' table (prompt tokens resent "
+                         "vs prefill tokens computed vs conversation-"
+                         "cache hits) — the out-of-process driver for "
+                         "the conversation cache (1 = classic mode)")
     ap.add_argument("--prompt-pad", type=int, default=24,
                     help="prompt length in bytes (byte tokenizer: ~tokens)")
     ap.add_argument("--ramp", type=float, default=2.0,
@@ -595,7 +750,8 @@ def main(argv=None) -> int:
         leaked = hz is None or any(
             hz.get(k) or 0
             for k in ("inflight_requests", "queue_depth", "slot_occupancy",
-                      "streams_detached", "replay_buffer_bytes")
+                      "streams_detached", "replay_buffer_bytes",
+                      "pool_pages_reserved")
         )
         if leaked:
             detail = ("unreachable" if hz is None
@@ -614,6 +770,14 @@ def main(argv=None) -> int:
         if out.get("resumed") is not None:
             print(f"# resumed mid-run (tunnel resets survived): "
                   f"{out['resumed']}", file=sys.stderr)
+        for tr in out.get("turns", []):
+            print(
+                f"# turn {tr['turn']}: sent {tr['prompt_tokens_sent']} "
+                f"prompt tokens, prefilled {tr['prefill_tokens']}, "
+                f"conversation hits {tr['conv_hits']} "
+                f"({tr['conv_hit_tokens']} tokens reused)",
+                file=sys.stderr,
+            )
     return 1 if (total_stuck or leaked) else 0
 
 

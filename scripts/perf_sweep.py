@@ -117,9 +117,10 @@ GRID = [
     # hero they twin: same weights/KV/kernels, only the serving rhythm
     # differs (BENCH_MUX recorded in the row), so the pair isolates what
     # iteration-level prefill/decode interleaving costs or buys in decode
-    # tok/s and TTFT at the throughput shape.  (kv4 keeps prefix grouping
-    # off — packed sequence axis — so this pair measures the interleave
-    # term alone; the mux-herd pair below measures the dedup term.)
+    # tok/s and TTFT at the throughput shape.  (Since ISSUE 14 kv-int4 no
+    # longer fences the prefix pool off, so both twins run the default
+    # pool — the row's effective prefix_cache field records it; the hero
+    # trio below isolates the pool term explicitly with a pool-off twin.)
     ("mux-kv4-fused-64x24", {"BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
                              "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
                              "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
@@ -131,6 +132,31 @@ GRID = [
                                  "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
                                  "BENCH_DECODE_STEPS": "24",
                                  "SWEEP_DEADLINE_S": "900"}),
+    # THE ISSUE 14 hero: every lever at once — int4 weights, int4 KV, the
+    # fused layer kernel, mux, AND the block-paged prefix pool with a cold
+    # shared-prefix herd (the composition the pre-paged engine fenced
+    # off: kv-int4 used to force the pool and chunk path OFF).  Its two
+    # twins isolate the new terms at the identical shape: mux-off (the
+    # interleave + grouped-admission term) and pool-off (the page-reuse
+    # term alone).
+    ("int4-kv4-fused-mux-prefix", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    ("int4-kv4-fused-muxoff-prefix", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "0",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    ("int4-kv4-fused-mux-nopool", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "0", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
     # Cold shared-prefix herd at the base shape (the ISSUE 5 TTFT bar):
     # 32 clients whose prompts share a ~256-token templated prefix the
     # warm request never touched.  The off twin quantifies what the herd

@@ -33,6 +33,10 @@ PINNED_ROW_KEYS = (
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
+    # ISSUE 14 add-only extension: block-paged pool occupancy + the
+    # conversation-cache hit rate (fraction of admissions matching
+    # finished-stream pages).
+    "pages_used", "pages_free", "conversation_hit_rate",
     # ISSUE 12 add-only extension: the cold-start compile breakdown
     # (warmup total / program count / slowest single program).
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",

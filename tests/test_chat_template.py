@@ -102,3 +102,18 @@ def test_byte_tokenizer_uses_generic_flattening():
     assert render_chat_prompt(MESSAGES) == (
         "system: be brief\nuser: hi there\nassistant:"
     )
+
+
+def test_assistant_turns_render_as_byte_exact_continuations():
+    """ISSUE 14: a resent conversation re-renders to a BYTE-EXACT
+    extension of the previous turn's prompt + response stream — the
+    assistant cue takes NO space before the content, because generation
+    continued the bare cue directly.  This is what lets the conversation
+    cache match a returning user's history page-for-page."""
+    turn1 = [{"role": "user", "content": "hi"}]
+    p1 = render_chat_prompt(turn1)
+    resp = "xyz"  # whatever the model streamed after the cue
+    turn2 = turn1 + [{"role": "assistant", "content": resp},
+                     {"role": "user", "content": "more"}]
+    p2 = render_chat_prompt(turn2)
+    assert p2.startswith(p1 + resp)

@@ -300,8 +300,10 @@ def test_engine_rejects_unknown_kv_quant_and_gates_int4():
                                     kv_quant="int2"),
             tokenizer=ByteTokenizer(),
         )
-    # int4 KV disables the chunk-prefill consumers (packed-axis scope
-    # limit) instead of corrupting byte-shared positions at serve time.
+    # ISSUE 14: the prefix cache and chunked prefill now COMPOSE with the
+    # packed int4 cache (page-aligned writes); spec decode is the one
+    # remaining fence — recorded in the config_fences registry, not just
+    # a startup log line.
     eng = InferenceEngine(
         engine_cfg=EngineConfig(
             model="tiny", num_slots=2, max_seq=64, dtype="float32",
@@ -310,9 +312,10 @@ def test_engine_rejects_unknown_kv_quant_and_gates_int4():
         ),
         tokenizer=ByteTokenizer(),
     )
-    assert eng._prefix is None
-    assert eng.ecfg.prefill_chunk == 0
+    assert eng._prefix is not None
+    assert eng.ecfg.prefill_chunk == 16
     assert eng.ecfg.spec_ngram == 0
+    assert [f["knob"] for f in eng.config_fences] == ["spec_ngram"]
 
 
 # ---------------------------------------------------------------------------

@@ -311,16 +311,22 @@ def test_mux_token_identity_int8_kv_same_chunk_config():
 
 
 @pytest.mark.slow
-def test_mux_kv_int4_falls_back_to_budgeted_plain_waves():
-    """kv_quant=int4 keeps its packed-sequence-axis scope limits (no chunk
-    path, no prefix cache) — mux degrades to budgeted whole-prompt waves
-    and stays token-identical to the non-multiplexed int4 path."""
+def test_mux_kv_int4_composes_with_chunk_and_pool():
+    """ISSUE 14: the packed int4 KV cache takes page-aligned chunk writes,
+    so mux + prefix pool + chunked prefill all run under kv_quant=int4 —
+    token-identical to the unpooled non-mux engine at the SAME segment
+    width (the int8 same-chunk-config contract above, now for int4), with
+    zero composition fences and real pool reuse."""
     prompts = [list(range(1, 60)) + [300 + i] for i in range(5)]
-    plain, _ = _herd(_cfg(kv_quant="int4", mux=False), prompts)
-    muxed, eng = _herd(_cfg(kv_quant="int4", mux=True), prompts)
+    plain, _ = _herd(_cfg(kv_quant="int4", mux=False, prefix_cache=False,
+                          prefill_chunk=32), prompts)
+    muxed, eng = _herd(_cfg(kv_quant="int4", mux=True, prefix_cache=True,
+                            prefill_chunk=32), prompts)
     assert muxed == plain
-    assert eng.ecfg.prefill_chunk == 0  # chunk path stays gated off
+    assert eng.ecfg.prefill_chunk == 32  # chunk path runs under int4
     assert eng.ecfg.mux
+    assert eng.config_fences == []
+    assert eng._prefix is not None and eng._prefix.hits > 0
 
 
 @pytest.mark.slow

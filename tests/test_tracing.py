@@ -338,8 +338,17 @@ def test_healthz_trace_export_and_pool_accounting():
                 payload = json.loads(h.text)
                 assert "ttft_p999_ms" in payload["tails"]
                 assert set(payload["prefix_pool"]) == {
-                    "blocks_used", "blocks_free", "kv_bytes"
+                    "blocks_used", "blocks_free", "kv_bytes",
+                    # ISSUE 14: reservation/eviction accounting + the
+                    # conversation cache's reuse counters.
+                    "pages_reserved", "evictions_total", "conversation",
                 }
+                assert set(payload["prefix_pool"]["conversation"]) == {
+                    "saved_pages_total", "hits_total", "hit_tokens_total",
+                }
+                # The composition-fence registry rides /healthz too: a
+                # list (empty unless an engine auto-disabled something).
+                assert isinstance(payload["config"]["fences"], list)
             finally:
                 await _teardown(serve_task, ch, client)
 
