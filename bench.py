@@ -68,6 +68,7 @@ SAFE_OVERRIDES = {
     "BENCH_PREFIX_CACHE": "0",
     "BENCH_MUX": "0",
     "BENCH_CONV_CACHE": "0",
+    "BENCH_RAGGED_PREFILL": "0",
 }
 
 
@@ -87,6 +88,7 @@ RESULT_ROW_KEYS = (
     "prefill_p50_ms", "decode_fetch_p50_ms",
     "mfu", "model", "quant", "quant_group_size", "prefill_act_quant",
     "kv_quant", "flash_decode", "flash_sgrid", "fused_decode_layer",
+    "ragged_prefill",
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
@@ -206,6 +208,11 @@ async def _run_attempt(model: str) -> dict:
     # selection when set — rope + KV quant + cache append + attention in
     # one program per layer.
     fused_decode = os.environ.get("BENCH_FUSED_DECODE", "0") == "1"
+    # Ragged grouped prefill (ISSUE 15): one flat-packed Pallas launch
+    # per admission group instead of the chunk[t, view] program family —
+    # the warmup_programs / warmup_compile_s fields in the row are the
+    # cold-start axis its sweep twins compare.
+    ragged_prefill = os.environ.get("BENCH_RAGGED_PREFILL", "0") == "1"
     # Automatic prefix caching — on by default here AND in the serve CLI
     # (TUNNEL_PREFIX_CACHE), so the benched config is the deployed default.
     # The bench prompts share a prefix the way real traffic shares system
@@ -289,6 +296,7 @@ async def _run_attempt(model: str) -> dict:
             flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
+            ragged_prefill=ragged_prefill,
             mux=mux, mux_budget_tokens=mux_budget,
             conv_cache=conv_cache and prefix_cache,
             prefix_evict=prefix_evict,
@@ -505,6 +513,10 @@ async def _run_attempt(model: str) -> dict:
         "flash_decode": flash_decode,
         "flash_sgrid": flash_sgrid,
         "fused_decode_layer": fused_decode,
+        # EFFECTIVE knob (the engine fences it off untileable shapes /
+        # sp>1 meshes): a row claiming the requested value would
+        # misattribute its warmup_* fields.
+        "ragged_prefill": engine.ecfg.ragged_prefill,
         "decode_kernels_per_step": global_metrics.gauge(
             "engine_decode_kernels_per_step"
         ),

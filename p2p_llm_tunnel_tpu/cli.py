@@ -251,6 +251,18 @@ def build_parser() -> argparse.ArgumentParser:
                             "attention in ONE program per layer (collapses "
                             "the per-step launch storm; composes with "
                             "every --quant/--kv-quant)")
+    serve.add_argument("--ragged-prefill",
+                       action=argparse.BooleanOptionalAction,
+                       default=_env("TUNNEL_RAGGED_PREFILL", "") == "1",
+                       help="ragged grouped flash-prefill kernel: every "
+                            "chunk-prefill dispatch (mux segments, "
+                            "prefix-cache tails) packs the group's "
+                            "variable-length tails into ONE Pallas "
+                            "launch — no pad buckets, no per-(tail,view) "
+                            "programs, the warmup grid collapses; token "
+                            "streams are byte-identical to the chunked "
+                            "path (off by default pending on-chip "
+                            "measurement)")
     serve.add_argument("--prefill-chunk", type=int,
                        default=int(_env("TUNNEL_PREFILL_CHUNK", "0")),
                        help="chunked prefill: prompts longer than this many "
@@ -643,6 +655,7 @@ async def _engine_backend(args):
                     spec_ngram=args.spec_ngram,
                     spec_k=args.spec_k,
                     prefill_chunk=args.prefill_chunk,
+                    ragged_prefill=args.ragged_prefill,
                     mux=args.mux,
                     mux_budget_tokens=args.mux_budget_tokens,
                     max_waiting=args.max_waiting,

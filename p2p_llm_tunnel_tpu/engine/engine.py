@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import functools
 import os
 import time
 from dataclasses import dataclass, replace as dc_replace
@@ -170,6 +171,20 @@ class EngineConfig:
     # kv_quant mode and weight quant in one program.  Off by default
     # until chip-measured; oracle-pinned in tests/test_fused_decode_layer.
     fused_decode_layer: bool = False
+    # Ragged grouped flash-prefill kernel (ISSUE 15): the prefill twin of
+    # fused_decode_layer.  Every chunk-prefill dispatch — mux segment
+    # sub-batches AND prefix-cache tails — packs the group's variable-
+    # length tail segments into ONE flat-token Pallas launch
+    # (ops/pallas_prefill_attention.py): per-block (slot, start, len)
+    # descriptors ride scalar prefetch, rope + KV quantization run in
+    # VMEM, the cache append is an aliased in-place write, and the
+    # attention reads the cache frontier-clamped — so there is no static
+    # kv_view argument and no per-(tail, view) program family.  The
+    # chunk×view×rows warmup/AOT grid collapses to ONE ragged program
+    # (see warmup_plan); token streams stay byte-identical to the chunked
+    # path at every kv_quant (tests/test_ragged_prefill.py).  Off by
+    # default until chip-measured; CPU hosts run it in interpret mode.
+    ragged_prefill: bool = False
     # With quant="int8": ALSO run activations int8 during PREFILL only.
     # Prefill is MXU-compute-bound (hundreds of tokens per row) where int8
     # doubles throughput; decode stays weight-only (it is HBM-bound, w8a8
@@ -559,6 +574,82 @@ class InferenceEngine:
                 ):
                     self._fence("prefix_cache", False, why)
 
+        # Ragged grouped prefill (ISSUE 15): geometry + kernel-legality
+        # gates, AFTER the mux default above so the effective
+        # prefill_chunk feeds the block/bucket arithmetic.  The q-block
+        # width must divide every chunk start (page multiples AND segment
+        # multiples — the ISSUE 14 alignment family), so it is the
+        # largest power-of-2 divisor of both units, capped at 128.
+        self._ragged_bq = 0
+        self._ragged_tot = 0
+        self._ragged_row_blocks = 0
+        self._ragged_interpret = False
+        if self.ecfg.ragged_prefill:
+            import math
+
+            unit = self.ecfg.prefill_chunk or self.ecfg.min_prefill_bucket
+            div = math.gcd(self.ecfg.min_prefill_bucket, unit)
+            bq = next((c for c in (128, 64, 32, 16, 8) if div % c == 0), 0)
+            self._ragged_interpret = jax.default_backend() != "tpu"
+            if self.ecfg.sp > 1:
+                self._fence(
+                    "ragged_prefill", False,
+                    "the ragged grouped prefill kernel has no "
+                    "sequence-parallel attention path (sp>1)",
+                )
+            elif self.ecfg.tp > 1:
+                self._fence(
+                    "ragged_prefill", False,
+                    "pallas_call is not GSPMD-partitioned: under a tp "
+                    "mesh XLA would all-gather the sharded cache (wrap "
+                    "in shard_map before enabling, like prefill's "
+                    "flash_tp)",
+                )
+            elif bq == 0:
+                self._fence(
+                    "ragged_prefill", False,
+                    f"no power-of-2 q-block width >= 8 divides both "
+                    f"min_prefill_bucket={self.ecfg.min_prefill_bucket} "
+                    f"and prefill_chunk={unit} — chunk starts would "
+                    f"misalign the grouped cache-append blocks",
+                )
+            elif not self._ragged_interpret and self.mcfg.head_dim % 128:
+                self._fence(
+                    "ragged_prefill", False,
+                    f"head_dim {self.mcfg.head_dim} does not tile "
+                    "(% 128) on the TPU backend",
+                )
+            elif not self._ragged_interpret and s % 128:
+                self._fence(
+                    "ragged_prefill", False,
+                    f"max_seq {s} does not tile (% 128) on the TPU "
+                    "backend",
+                )
+            else:
+                self._ragged_bq = bq
+                # One flat-token bucket per dispatch: the widest group
+                # the dispatch sites can assemble (prefill_rows rows of
+                # the widest per-row tail — a mux segment or the largest
+                # prefix tail bucket).  ONE compiled program replaces the
+                # whole chunk[t, view] grid; idle iterations pay pad
+                # FLOPs in the XLA projections only (the kernel skips
+                # pad blocks), which the mux budget keeps filled in
+                # steady state.
+                per_row = unit
+                if self.ecfg.prefix_cache:
+                    per_row = max(
+                        per_row,
+                        self.ecfg.min_prefill_bucket
+                        * 2 ** max(0, self.ecfg.prefix_tail_buckets - 1),
+                    )
+                per_row = -(-per_row // bq) * bq
+                self._ragged_tot = self.ecfg.prefill_rows * per_row
+                # The kernel's tail grid axis is row-relative: it spans
+                # the widest per-row tail, not the whole flat bucket —
+                # linear grid growth in group size (the quadratic form
+                # made CPU-interpret execution unusable).
+                self._ragged_row_blocks = per_row // bq
+
         # Prefix cache: host index + device block pool + jitted copy ops.
         self._prefix = None
         if self.ecfg.prefix_cache and self.ecfg.sp > 1:
@@ -779,6 +870,13 @@ class InferenceEngine:
             self._spec_verify_fn, donate_argnums=(1,), static_argnums=(6,)
         )
 
+        # Ragged grouped prefill (ISSUE 15): ONE program per flat-token
+        # bucket — no static view/tail args (descriptors are runtime
+        # operands; block_q/interpret ride the closure).
+        self._jit_ragged = jax.jit(
+            self._ragged_prefill_fn, donate_argnums=(1,)
+        )
+
         def _embed_pool_fn(params, tokens, valid):
             from p2p_llm_tunnel_tpu.models.transformer import encode_pooled
 
@@ -811,6 +909,7 @@ class InferenceEngine:
                 "set_bias", self._jit_set_bias, 1
             )
             self._jit_spec = self._spmd.wrap("spec", self._jit_spec, 3)
+            self._jit_ragged = self._spmd.wrap("ragged", self._jit_ragged, 3)
             self._jit_embed = self._spmd.wrap("embed", self._jit_embed, 1)
 
         # Per-slot OpenAI logit_bias plane [rows, V] (scratch row included
@@ -953,6 +1052,38 @@ class InferenceEngine:
             slots, kv_view=kv_view,
         )
         first = sampling.sample(last_logits, samp, key, pos=starts + lengths,
+                                bias=bias[slots])
+        lp = jax.lax.cond(
+            jnp.any(samp.logprobs > 0),
+            lambda: sampling.logprob_data(last_logits, first),
+            lambda: sampling.empty_logprob_data(
+                first.shape[0], last_logits.shape[-1]),
+        )
+        return first, lp, kv_cache
+
+    def _ragged_prefill_fn(
+        self, params, kv_cache, bias, tokens, slot_of, start_of, qoff_of,
+        base_of, sample_idx, samp_pos, slots, samp, key,
+    ):
+        """Ragged GROUPED tail prefill (ISSUE 15): the whole group's
+        variable-length segments in one flat-token Pallas launch — the
+        chunk program's twin with NO static (tail, view) axes, so one
+        compiled program serves every group shape (see warmup_plan).
+        ``sample_idx``/``samp_pos``/``slots`` are per-ROW (prefill_rows
+        wide): each row's last-real-token logits sample exactly like the
+        chunk path's."""
+        from p2p_llm_tunnel_tpu.models.transformer import (
+            ragged_prefill_into_cache,
+        )
+
+        last_logits, kv_cache = ragged_prefill_into_cache(
+            self._prefill_mcfg, params, tokens, slot_of, start_of,
+            qoff_of, base_of, sample_idx, kv_cache,
+            block_q=self._ragged_bq,
+            max_row_blocks=self._ragged_row_blocks,
+            interpret=self._ragged_interpret,
+        )
+        first = sampling.sample(last_logits, samp, key, pos=samp_pos,
                                 bias=bias[slots])
         lp = jax.lax.cond(
             jnp.any(samp.logprobs > 0),
@@ -1142,61 +1273,59 @@ class InferenceEngine:
         segments made idle-row junk writes unsafe — see the parking comment
         there)."""
         loop = asyncio.get_running_loop()
-        views = self._warmup_views()
-        steps = {self.ecfg.decode_steps}
-        if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
-            steps.add(self.ecfg.decode_steps_eager)
         t_warm0 = time.monotonic()
         compile_mark = global_compile_watch.mark()
-        await self._warm_aot_parallel(loop, views, sorted(steps))
+        await self._warm_aot_parallel(loop)
+        # Serial execute pass DRIVEN BY warmup_plan() — the same
+        # enumeration the AOT phase lowered and TC17 checks dispatch
+        # sites against, so a kind/shape added to the plan can never be
+        # execute-warmed by one phase and missed by the other (with
+        # TUNNEL_WARMUP_PAR unset this pass is the ONLY warmer).
+        plan = self.warmup_plan()
         t0 = time.monotonic()
         self._warming = True
         try:
-            for view in views:
-                for k in sorted(steps):
-                    t1 = time.monotonic()
-
-                    def _one(view=view, k=k):
+            for kind, shape in plan:
+                t1 = time.monotonic()
+                if kind == "decode":
+                    def _one(view=shape[0], k=shape[1]):
                         outs, _ = self._dispatch_decode(view=view, steps=k)
                         jax.block_until_ready(outs[0])
                     await loop.run_in_executor(self._executor, _one)
-                    dt = time.monotonic() - t1
-                    if dt > 1.0:
-                        log.info(
-                            "decode warmup[v%d,k%d] ready in %.1fs",
-                            view, k, dt,
-                        )
-            log.info(
-                "decode warmup: %d view×steps variants compiled in %.1fs",
-                len(views) * len(steps), time.monotonic() - t0,
-            )
-            for w in self._warm_prefill_widths():
-                t1 = time.monotonic()
-                await loop.run_in_executor(
-                    self._executor, self._warm_prefill_program, w
-                )
-                dt = time.monotonic() - t1
-                if dt > 1.0:
-                    log.info("prefill warmup[w%d] ready in %.1fs", w, dt)
-            if self.ecfg.spec_ngram > 0:
-                for view in views:
-                    def _one_spec(view=view):
-                        outs, _ = self._dispatch_spec(view=view)
+                elif kind == "spec":
+                    def _one_spec(view=shape[0]):
+                        self._dispatch_spec(view=view)
                         # nothing to process: no rows active during warmup
                     await loop.run_in_executor(self._executor, _one_spec)
+                elif kind == "prefill":
+                    await loop.run_in_executor(
+                        self._executor, self._warm_prefill_program, shape[0]
+                    )
+                elif kind == "chunk":
+                    await loop.run_in_executor(
+                        self._executor, self._warm_chunk_program, *shape
+                    )
+                elif kind == "ragged":
+                    await loop.run_in_executor(
+                        self._executor, self._warm_ragged_program, shape[0]
+                    )
+                else:  # a plan kind without a serial warmer is a bug HERE
+                    raise RuntimeError(f"unknown warmup-plan kind {kind!r}")
+                dt = time.monotonic() - t1
+                if dt > 1.0:
+                    log.info("warmup %s%s ready in %.1fs",
+                             kind, list(shape), dt)
+            log.info(
+                "warmup: %d planned programs executed in %.1fs",
+                len(plan), time.monotonic() - t0,
+            )
         finally:
             self._warming = False
         if self._prefix is not None:
+            # Copy-op programs sit outside the bucket-grid plan (no
+            # _program_key kind); warmed here so pool hits never compile
+            # on the serving path.
             await loop.run_in_executor(self._executor, self._warm_prefix)
-        if self.ecfg.prefill_chunk > 0:
-            # Chunked-prefill segments march ``starts`` toward max_seq, so
-            # every view bucket >= the chunk width is reachable.
-            for view in views:
-                if view >= self.ecfg.prefill_chunk:
-                    await loop.run_in_executor(
-                        self._executor, self._warm_chunk_program,
-                        self.ecfg.prefill_chunk, view,
-                    )
         # Observability (ISSUE 4): total warmup compile wall time — with
         # the fused path's extra variants this is the number a ~minutes
         # chip window has to fit before serving — and the launch-count
@@ -1357,7 +1486,7 @@ class InferenceEngine:
         need = cap + 2 * self.ecfg.decode_steps + 1
         if self.ecfg.spec_ngram > 0:
             need += self.ecfg.spec_k
-        if self.ecfg.prefill_chunk > 0:
+        if self.ecfg.prefill_chunk > 0 and not self.ecfg.ragged_prefill:
             # Chunk-prefill dispatches pick their view bucket from
             # starts.max() + the PADDED segment width (_dispatch_chunk_rows)
             # — a tail near the context cap reaches cap + prefill_chunk,
@@ -1366,9 +1495,56 @@ class InferenceEngine:
             # program, so missing this term means a cold compile on the
             # serving path the first time a long prompt's tail lands
             # (ISSUE 5 warmup-coverage fix; pinned by test_warmup_aot).
+            # The ragged program has no view axis (frontier clamp), so
+            # the term — and its extra decode buckets — vanishes with it.
             need = max(need, cap + self.ecfg.prefill_chunk)
         needed = next((v for v in views if v >= need), views[-1])
         return [v for v in views if v <= needed]
+
+    def warmup_plan(self) -> List[Tuple[str, Tuple[int, ...]]]:
+        """The compiled-program grid ``warmup()`` must cover, as
+        ``(kind, bucket shape)`` pairs — the ONE enumeration shared by
+        the parallel AOT phase and the serial execute pass, and the
+        static source tunnelcheck TC17 checks dispatch-site program
+        kinds against (a kind dispatched but absent here is the
+        mid-serve cold-compile class ISSUE 12 made measurable).
+
+        With ``ragged_prefill`` the whole ``chunk[t, view]`` family —
+        one program per (tail bucket × kv-view bucket) — collapses to a
+        single ``ragged[tot]`` entry: the ragged kernel's frontier clamp
+        reads the cache at full length (no view axis) and its flat
+        packing erases the tail-bucket axis (ISSUE 15)."""
+        views = self._warmup_views()
+        steps = {self.ecfg.decode_steps}
+        if 0 < self.ecfg.decode_steps_eager < self.ecfg.decode_steps:
+            steps.add(self.ecfg.decode_steps_eager)
+        plan: List[Tuple[str, Tuple[int, ...]]] = [
+            ("decode", (v, k)) for v in views for k in sorted(steps)
+        ]
+        if self.ecfg.spec_ngram > 0:
+            plan += [("spec", (v,)) for v in views]
+        plan += [("prefill", (w,)) for w in self._warm_prefill_widths()]
+        if self.ecfg.ragged_prefill:
+            plan.append(("ragged", (self._ragged_tot,)))
+            return plan
+        # Chunk-prefill programs are keyed by (tail, view) only: when
+        # ecfg.prefill_chunk matches a prefix-cache tail bucket, the
+        # prefix path and the segment path want the IDENTICAL program —
+        # dedupe, or two AOT threads compile it concurrently (the
+        # persistent cache does not dedupe in-flight compiles, ADVICE
+        # item 2).
+        chunk_pairs = set()
+        if self._prefix is not None:
+            for t in self._chunk_buckets:
+                for view in views:
+                    if view >= t:
+                        chunk_pairs.add((t, view))
+        if self.ecfg.prefill_chunk > 0:
+            for view in views:
+                if view >= self.ecfg.prefill_chunk:
+                    chunk_pairs.add((self.ecfg.prefill_chunk, view))
+        plan += [("chunk", pair) for pair in sorted(chunk_pairs)]
+        return plan
 
     def _warm_samp(self, rows: int) -> sampling.SamplingParams:
         """Zero-valued sampling plane with the exact dtypes live dispatch
@@ -1487,7 +1663,7 @@ class InferenceEngine:
             (self._pool, self.kv_cache, slots_o, pids_o, bnos_o),
         )
 
-    async def _warm_aot_parallel(self, loop, views, steps) -> None:
+    async def _warm_aot_parallel(self, loop) -> None:
         """Phase-A warmup: AOT lower+compile every warm program CONCURRENTLY
         (``TUNNEL_WARMUP_PAR`` threads), then let the serial execute pass
         load the results back from the persistent compilation cache.
@@ -1514,33 +1690,35 @@ class InferenceEngine:
             )
             return
         await loop.run_in_executor(self._executor, self._ensure_decode_carry)
-        # (label, program kind, bucket shape, lower-thunk): kind/shape feed
-        # the compile journal (ISSUE 12) — None kind for the copy ops,
-        # which sit outside the bucket-grid readiness contract.
-        jobs: List[Tuple[str, Optional[str], Tuple[int, ...], object]] = []
-        for view in views:
-            for k in steps:
-                jobs.append((
-                    f"decode[v{view},k{k}]", "decode", (view, k),
-                    lambda view=view, k=k: self._jit_decode.lower(
-                        *self._decode_warm_args(view, k)
-                    ),
-                ))
-        if self.ecfg.spec_ngram > 0:
-            for view in views:
-                jobs.append((
-                    f"spec[v{view}]", "spec", (view,),
-                    lambda view=view: self._jit_spec.lower(
-                        *self._spec_warm_args(view)
-                    ),
-                ))
-        for w in self._warm_prefill_widths():
-            jobs.append((
-                f"prefill[w{w}]", "prefill", (w,),
-                lambda w=w: self._jit_prefill.lower(
-                    *self._prefill_warm_args(w)
-                ),
-            ))
+        # (label, program kind, bucket shape, lower-thunk): the grid comes
+        # from warmup_plan() — the ONE enumeration the serial pass and the
+        # TC17 static check share — so the AOT phase can never drift from
+        # what dispatch reaches.  None kind for the copy ops, which sit
+        # outside the bucket-grid readiness contract.
+        lowerers = {
+            "decode": lambda shape: self._jit_decode.lower(
+                *self._decode_warm_args(*shape)
+            ),
+            "spec": lambda shape: self._jit_spec.lower(
+                *self._spec_warm_args(*shape)
+            ),
+            "prefill": lambda shape: self._jit_prefill.lower(
+                *self._prefill_warm_args(*shape)
+            ),
+            "chunk": lambda shape: self._jit_chunk_prefill.lower(
+                *self._chunk_warm_args(*shape)
+            ),
+            "ragged": lambda shape: self._jit_ragged.lower(
+                *self._ragged_warm_args(*shape)
+            ),
+        }
+        jobs: List[Tuple[str, Optional[str], Tuple[int, ...], object]] = [
+            (
+                f"{kind}{list(shape)}", kind, shape,
+                functools.partial(lowerers[kind], shape),
+            )
+            for kind, shape in self.warmup_plan()
+        ]
         if self._prefix is not None:
             in_args, out_args = self._copy_warm_args()
             jobs.append(
@@ -1550,30 +1728,6 @@ class InferenceEngine:
                 ("copy_out", None, (),
                  lambda: self._copy_out.lower(*out_args))
             )
-        # Chunk-prefill programs are keyed by (tail, view) only: when
-        # ecfg.prefill_chunk matches a prefix-cache tail bucket, the
-        # prefix path and the segment path want the IDENTICAL program —
-        # dedupe before submitting, or two threads compile it concurrently
-        # (the persistent cache does not dedupe in-flight compiles,
-        # ADVICE item 2).
-        chunk_pairs = set()
-        if self._prefix is not None:
-            for t in self._chunk_buckets:
-                for view in views:
-                    if view >= t:
-                        chunk_pairs.add((t, view))
-        if self.ecfg.prefill_chunk > 0:
-            for view in views:
-                if view >= self.ecfg.prefill_chunk:
-                    chunk_pairs.add((self.ecfg.prefill_chunk, view))
-        for t, view in sorted(chunk_pairs):
-            jobs.append((
-                f"chunk[t{t},v{view}]", "chunk", (t, view),
-                lambda t=t, view=view:
-                    self._jit_chunk_prefill.lower(
-                        *self._chunk_warm_args(t, view)
-                    ),
-            ))
 
         def _one(label, kind, shape, thunk):
             t1 = time.monotonic()
@@ -1613,6 +1767,41 @@ class InferenceEngine:
 
         await loop.run_in_executor(self._executor, _all)
 
+    def _ragged_warm_args(self, tot: int):
+        """Positional args for the ragged grouped-prefill program at flat
+        bucket ``tot``: an all-pad plan whose every block appends junk
+        into the scratch slot — aval-identical to _dispatch_ragged_rows'
+        live call."""
+        from p2p_llm_tunnel_tpu.ops.pallas_prefill_attention import (
+            plan_ragged_group,
+        )
+
+        slot_of, start_of, qoff_of, _qlen, base_of, _ = plan_ragged_group(
+            [], self._ragged_bq, tot, self._scratch_slot
+        )
+        nb = self.ecfg.prefill_rows
+        return (
+            self.params, self.kv_cache, self._bias,
+            jnp.zeros((tot,), jnp.int32),
+            jnp.asarray(slot_of), jnp.asarray(start_of),
+            jnp.asarray(qoff_of),
+            jnp.asarray(base_of),
+            jnp.zeros((nb,), jnp.int32),  # sample_idx
+            jnp.zeros((nb,), jnp.int32),  # samp_pos
+            jnp.full((nb,), self._scratch_slot, jnp.int32),
+            self._warm_samp(nb), self._key,
+        )
+
+    def _warm_ragged_program(self, tot: int) -> None:
+        """Execute-warm the ragged grouped-prefill program at flat bucket
+        ``tot`` against the scratch slot (executor thread)."""
+        t0 = time.monotonic()
+        first, _lp, self.kv_cache = self._jit_ragged(
+            *self._ragged_warm_args(tot)
+        )
+        jax.block_until_ready(first)
+        self._note_program("ragged", (tot,), time.monotonic() - t0)
+
     def _warm_chunk_program(self, t: int, view: int) -> None:
         """Compile the chunk-prefill program at tail width ``t`` and kv-view
         ``view`` against scratch rows (executor thread)."""
@@ -1633,23 +1822,19 @@ class InferenceEngine:
         return self.ecfg.max_seq
 
     def _warm_prefix(self) -> None:
-        """Compile the prefix-cache programs (both copy ops + every
-        tail-bucket chunk prefill) against scratch rows so none of them
-        cold-compiles on the serving path (executor thread)."""
+        """Compile the prefix-cache COPY programs against the scratch slot
+        so pool hits never compile on the serving path (executor thread).
+        The tail-bucket chunk programs the pool path dispatches are part
+        of warmup_plan() — the serial pass warms them with the rest of
+        the grid (or skips them wholesale under ``ragged_prefill``)."""
         t0 = time.monotonic()
         in_args, _ = self._copy_warm_args()
         self.kv_cache = self._copy_in(*in_args)
         _, out_args = self._copy_warm_args()
         self._pool = self._copy_out(*out_args)
-        views = self._warmup_views()
-        for t in self._chunk_buckets:
-            for view in views:
-                if view >= t:
-                    self._warm_chunk_program(t, view)
         log.info(
-            "prefix-cache warmup: copy ops + chunk-prefill tails %s x "
-            "views %s compiled in %.1fs",
-            self._chunk_buckets, views, time.monotonic() - t0,
+            "prefix-cache warmup: copy ops compiled in %.1fs",
+            time.monotonic() - t0,
         )
 
     # -- public API -------------------------------------------------------
@@ -2083,7 +2268,7 @@ class InferenceEngine:
                 samp,
                 self._next_key(),
             )
-        self._note_program("prefill_echo" if echo else "prefill", (t,),
+        self._note_program("prefill_echo" if echo else "prefill", (t,),  # tunnelcheck: disable=TC17  echo/scoring prefill is an explicitly-requested eval feature compiled on FIRST USE by design (_prefill_fn docstring) — never on the default serving path, so warming its [t] grid would bill every cold start for a feature most deploys never invoke
                            time.monotonic() - t_jit0)
         global_metrics.inc("engine_prefill_tokens_total", total)
         out = first, (lp if lps.any() else None), plp
@@ -2116,7 +2301,16 @@ class InferenceEngine:
 
         Non-sampled rows (mid-prompt segments) get zeroed sampling params;
         the caller discards their returned token.
+
+        With ``ragged_prefill`` the SAME rows route to the ragged grouped
+        launch instead (ISSUE 15): one flat-packed program, no ``t``
+        bucket and no view specialization — this interception point is
+        what lets every chunk consumer (mux segments, prefix tails, the
+        non-mux cached wave) share the collapsed program set without
+        changing its own routing.
         """
+        if self.ecfg.ragged_prefill:
+            return self._dispatch_ragged_rows(rows)
         nb = max(self.ecfg.prefill_rows, len(rows))
         tokens = np.zeros((nb, t), np.int32)
         lengths = np.ones((nb,), np.int32)
@@ -2173,6 +2367,98 @@ class InferenceEngine:
             view,
         )
         self._note_program("chunk", (t, view), time.monotonic() - t_jit0)
+        global_metrics.inc("engine_prefill_tokens_total", total)
+        out = first, (lp if lps.any() else None), None
+        self._start_host_copy(out)
+        return out
+
+    def _dispatch_ragged_rows(self, rows):
+        """Ragged grouped launch (ISSUE 15, executor thread): pack rows of
+        ``(run, start, segment_ids, sample?)`` into the flat-token bucket
+        and dispatch ONE Pallas-grouped program — the ragged twin of
+        :meth:`_dispatch_chunk_rows` with identical row-order outputs, so
+        every consumer (_finish_segments, _dispatch_plain_waves) is
+        oblivious to which path ran.  Pad waste is bounded by
+        ``_ragged_bq - 1`` tokens per row instead of a power-of-2 tail
+        bucket, and the single ``(tot,)`` program key replaces the whole
+        ``chunk[t, view]`` family."""
+        from p2p_llm_tunnel_tpu.ops.pallas_prefill_attention import (
+            plan_ragged_group,
+        )
+
+        bq = self._ragged_bq
+        entries = [
+            (run.slot, start, len(seg)) for run, start, seg, _s in rows
+        ]
+        tot = self._ragged_tot
+        need = sum(-(-ln // bq) * bq for _sl, _st, ln in entries)
+        if need > tot:
+            # Defensive only: every dispatch site caps rows at
+            # prefill_rows and per-row tails at the bucket arithmetic
+            # _ragged_tot was sized from; a fresh program here would be
+            # counted as a mid-serve cold compile (ISSUE 12).
+            tot = -(-need // bq) * bq
+        slot_of, start_of, qoff_of, _qlen_of, base_of, offs = (
+            plan_ragged_group(entries, bq, tot, self._scratch_slot,
+                              max_row_blocks=self._ragged_row_blocks)
+        )
+        tokens = np.zeros((tot,), np.int32)
+        nb = max(self.ecfg.prefill_rows, len(rows))
+        sample_idx = np.zeros((nb,), np.int32)
+        samp_pos = np.zeros((nb,), np.int32)
+        slots = np.full((nb,), self._scratch_slot, np.int32)
+        temp = np.zeros((nb,), np.float32)
+        top_k = np.zeros((nb,), np.int32)
+        top_p = np.ones((nb,), np.float32)
+        lps = np.zeros((nb,), np.int32)
+        seeds = np.zeros((nb,), np.uint32)
+        bias_on = np.zeros((nb,), bool)
+        total = 0
+        for i, ((run, start, seg, sample), off) in enumerate(
+            zip(rows, offs)
+        ):
+            tokens[off : off + len(seg)] = seg
+            sample_idx[i] = off + len(seg) - 1
+            samp_pos[i] = start + len(seg)
+            slots[i] = run.slot
+            if sample:
+                temp[i] = run.request.temperature
+                top_k[i] = run.request.top_k
+                top_p[i] = run.request.top_p
+                lps[i] = run.request.logprobs
+                seeds[i] = run.request.seed
+                bias_on[i] = bool(run.request.logit_bias)
+            total += len(seg)
+        self._apply_logit_bias(
+            [run for (run, _s, _g, sample) in rows if sample]
+        )
+        samp = sampling.SamplingParams(
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            freq_pen=jnp.zeros((nb,), jnp.float32),
+            pres_pen=jnp.zeros((nb,), jnp.float32),
+            logprobs=jnp.asarray(lps),
+            seed=jnp.asarray(seeds),
+            bias_on=jnp.asarray(bias_on),
+        )
+        t_jit0 = time.monotonic()
+        first, lp, self.kv_cache = self._jit_ragged(
+            self.params,
+            self.kv_cache,
+            self._bias,
+            jnp.asarray(tokens),
+            jnp.asarray(slot_of),
+            jnp.asarray(start_of),
+            jnp.asarray(qoff_of),
+            jnp.asarray(base_of),
+            jnp.asarray(sample_idx),
+            jnp.asarray(samp_pos),
+            jnp.asarray(slots),
+            samp,
+            self._next_key(),
+        )
+        self._note_program("ragged", (tot,), time.monotonic() - t_jit0)
         global_metrics.inc("engine_prefill_tokens_total", total)
         out = first, (lp if lps.any() else None), None
         self._start_host_copy(out)
@@ -2408,6 +2694,11 @@ class InferenceEngine:
             self.kv_cache = out[-1]
         elif op == "chunk":
             out = self._jit_chunk_prefill(
+                self.params, self.kv_cache, self._bias, *args
+            )
+            self.kv_cache = out[-1]
+        elif op == "ragged":
+            out = self._jit_ragged(
                 self.params, self.kv_cache, self._bias, *args
             )
             self.kv_cache = out[-1]

@@ -707,6 +707,108 @@ def chunk_prefill_into_cache(
     return last, new_cache
 
 
+def ragged_prefill_into_cache(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [TOT] flat-packed tail tokens (pads = 0)
+    slot_of: jnp.ndarray,   # [NQB] per-q-block descriptors
+    start_of: jnp.ndarray,  # (ops/pallas_prefill_attention.plan_ragged_group;
+    qoff_of: jnp.ndarray,   # its qlen_of output is caller bookkeeping only)
+    base_of: jnp.ndarray,
+    sample_idx: jnp.ndarray,  # [R] flat index of each row's last real token
+    kv_cache: KVCache,
+    block_q: int,  # static: the planner's q-block width
+    max_row_blocks: int = 0,  # static: widest per-row tail in blocks
+    return_all_logits: bool = False,  # static: [TOT,V] instead of rows
+    interpret: Optional[bool] = None,  # static: None = cfg.flash_interpret
+):
+    """Ragged GROUPED prefill (ISSUE 15): one launch per admission group.
+
+    The ragged twin of :func:`chunk_prefill_into_cache` — the group's
+    variable-length tail segments ride ONE flat token axis (no per-row
+    pad bucket), and per layer a single Pallas program
+    (``ops/pallas_prefill_attention.ragged_prefill_attention``) performs
+    rope, KV quantization into the cache precision, the cache append as
+    an aliased in-place block write (no XLA scatter), and causal flash
+    attention over each row's cache prefix + its own tail — the cache
+    read is frontier-clamped per row, so there is NO static ``kv_view``
+    argument and no per-(tail, view) program family: one compiled
+    program per flat-bucket length serves every group shape
+    (engine.warmup_plan's collapse).
+
+    Alignment contract (the planner enforces it): every row's ``start``
+    is a ``block_q`` multiple — chunk starts are page or segment
+    multiples — which under ``kv_quant="int4"`` makes every packed write
+    whole-byte (ISSUE 14).  Numerics: the kernel quantize→dequantize
+    ROUNDTRIPS each tail block before attending, exactly as this module's
+    chunk path attends through the cache it just wrote, so the two paths
+    stay token-identical (pinned in tests/test_ragged_prefill.py).
+
+    Returns ``(logits [R, V], cache')`` — logits of each row's last real
+    tail token (junk for pad rows whose ``sample_idx`` is 0), or
+    ``[TOT, V]`` with ``return_all_logits`` (the golden-anchor and
+    scoring harness path).
+    """
+    from p2p_llm_tunnel_tpu.ops.pallas_prefill_attention import (
+        ragged_prefill_attention,
+    )
+
+    tot = tokens.shape[0]
+    quant_mode = kv_cache_quant_mode(kv_cache)
+    quant = quant_mode is not None
+    s = kv_cache["k"].shape[2] * (2 if quant_mode == "int4" else 1)
+    if interpret is None:
+        interpret = cfg.flash_interpret
+    x = _embed(cfg, params, tokens[None])  # [1, TOT, Dm]
+    layer_idx = jnp.arange(cfg.n_layers)
+
+    def step(carry, xs):
+        x, cache = carry
+        blk, idx = xs
+        h = _norm(cfg, x, blk["attn_norm"])
+        q, k, v = _qkv_proj(cfg, blk, h)  # PRE-rope: the kernel ropes
+        attn, ck, cv, k_s, v_s = ragged_prefill_attention(
+            q[0], k[0], v[0],
+            cache["k"], cache["v"],
+            cache.get("k_scale"), cache.get("v_scale"),
+            slot_of, start_of, qoff_of, base_of, idx,
+            block_q=block_q,
+            max_row_blocks=max_row_blocks,
+            rope_theta=cfg.rope_theta,
+            kv_quant=quant_mode,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            window=_layer_window(cfg, idx, s),
+            interpret=interpret,
+        )
+        cache = dict(cache)
+        cache["k"], cache["v"] = ck, cv
+        if quant:
+            cache["k_scale"], cache["v_scale"] = k_s, v_s
+        attn = mm(attn.reshape(1, tot, -1), blk["wo"], cfg.act_quant)
+        if cfg.post_norms:
+            attn = _norm(cfg, attn, blk["post_attn_norm"])
+        x = x + attn
+        h = _norm(cfg, x, blk["mlp_norm"])
+        mlp = _mlp(cfg, blk, h)
+        if cfg.post_norms:
+            mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+        x = x + mlp
+        return (x, cache), None
+
+    (x, new_cache), _ = jax.lax.scan(
+        step, (x, dict(kv_cache)), (params["blocks"], layer_idx)
+    )
+    x = _norm(cfg, x, params["final_norm"])
+    if return_all_logits:
+        return _logits(cfg, params, x)[0], new_cache  # [TOT, V]
+    # Only the sampled rows' logits: the lm_head matmul is the widest in
+    # the model, and computing it over every flat token would tax exactly
+    # the pad-free win the ragged layout buys.
+    rows = x[0][sample_idx][None]  # [1, R, Dm]
+    return _logits(cfg, params, rows)[0], new_cache
+
+
 # ---------------------------------------------------------------------------
 # decode
 # ---------------------------------------------------------------------------

@@ -336,6 +336,10 @@ POLL_KEYS = (
 POLL_QUANTILES = {
     "engine_ttft_ms": ("0.5", "0.99"),
     "proxy_ttfb_ms": ("0.5", "0.99"),
+    # The prefill-EXECUTION half of the TTFT split (ISSUE 15): per-turn
+    # rows sample it from the poll timeline so conversation-cache
+    # re-prefill cost and ragged-prefill gains read from one run.
+    "engine_prefill_exec_ms": ("0.5",),
 }
 
 
@@ -493,6 +497,7 @@ async def run_load(args) -> dict:
         } or None
         deadline = t0 + args.timeout
         for turn in range(args.turns):
+            t_turn0 = time.monotonic() - t0
             pre_text = await fetch_metrics(
                 args.host, args.port, "/metrics", 5.0)
             pre_s = (parse_metrics_sample(pre_text)
@@ -527,6 +532,11 @@ async def run_load(args) -> dict:
 
             turn_rows.append({
                 "turn": turn,
+                # Window bounds (run-relative seconds): the post-run pass
+                # below resolves each turn's prefill-exec split from the
+                # --metrics-poll timeline samples inside this window.
+                "t0_s": round(t_turn0, 1),
+                "t1_s": round(time.monotonic() - t0, 1),
                 "prompt_tokens_sent": sum(
                     t.result() for t in done
                     if not t.cancelled() and t.exception() is None
@@ -536,6 +546,11 @@ async def run_load(args) -> dict:
                 "conv_hits": _delta("engine_conv_hits_total"),
                 "pool_pages_used": post_s.get(
                     "engine_prefix_pool_blocks_used"),
+                # Inline fallback when no poller runs: the live quantile
+                # at turn end (sliding reservoir, so dominated by this
+                # turn's own prefills in lockstep mode).
+                "prefill_exec_p50_ms": post_s.get(
+                    "engine_prefill_exec_ms_q0.5"),
             })
             if pend:
                 break  # stuck clients: stop advancing turns
@@ -556,6 +571,18 @@ async def run_load(args) -> dict:
     if poller is not None:
         poller.cancel()
         await asyncio.gather(poller, return_exceptions=True)
+        # Per-turn prefill-exec split from the poll timeline (ISSUE 15):
+        # the LAST in-window sample wins — by lockstep construction it
+        # reflects the turn's own prefills; the inline end-of-turn scrape
+        # above stays as the no-poller fallback.
+        for tr in turn_rows:
+            samples = [
+                row["engine_prefill_exec_ms_q0.5"] for row in timeline
+                if "engine_prefill_exec_ms_q0.5" in row
+                and tr["t0_s"] <= row["t"] <= tr["t1_s"]
+            ]
+            if samples:
+                tr["prefill_exec_p50_ms"] = samples[-1]
     # Retrieve every task's outcome: cancelled stragglers AND tasks that
     # died with an uncaught exception (whose remaining requests would
     # otherwise vanish from the report with the exit code still 0).
@@ -771,11 +798,14 @@ def main(argv=None) -> int:
             print(f"# resumed mid-run (tunnel resets survived): "
                   f"{out['resumed']}", file=sys.stderr)
         for tr in out.get("turns", []):
+            pf = tr.get("prefill_exec_p50_ms")
             print(
                 f"# turn {tr['turn']}: sent {tr['prompt_tokens_sent']} "
                 f"prompt tokens, prefilled {tr['prefill_tokens']}, "
                 f"conversation hits {tr['conv_hits']} "
-                f"({tr['conv_hit_tokens']} tokens reused)",
+                f"({tr['conv_hit_tokens']} tokens reused), "
+                f"prefill-exec p50 "
+                f"{'-' if pf is None else f'{pf:.1f}'} ms",
                 file=sys.stderr,
             )
     return 1 if (total_stuck or leaked) else 0

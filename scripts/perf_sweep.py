@@ -157,6 +157,30 @@ GRID = [
         "BENCH_PREFIX_CACHE": "0", "BENCH_SHARED_PREFIX_TOKENS": "256",
         "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
         "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    # ISSUE 15 ragged-prefill twins at the hero shape, right after the
+    # trio they extend: identical weights/KV/kernels/herd, only the
+    # prefill program family differs (BENCH_RAGGED_PREFILL recorded in
+    # the row) — the pair isolates BOTH the cold-start collapse
+    # (warmup_programs / warmup_compile_s: the chunk[t,view] grid
+    # vs one ragged program) and the grouped-launch prefill-exec term
+    # (prefill_exec_p50_ms / ttft_p50_ms) at the throughput shape.  The
+    # ragged row runs FIRST: its program set is the small one, so a
+    # short chip window banks the collapse datapoint before the wide
+    # off-twin grid gambles on fresh compiles.
+    ("int4-kv4-fused-mux-prefix-ragged", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_RAGGED_PREFILL": "1",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    ("int4-kv4-fused-mux-prefix-raggedoff", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_RAGGED_PREFILL": "0",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
     # Cold shared-prefix herd at the base shape (the ISSUE 5 TTFT bar):
     # 32 clients whose prompts share a ~256-token templated prefix the
     # warm request never touched.  The off twin quantifies what the herd

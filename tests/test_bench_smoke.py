@@ -30,6 +30,10 @@ PINNED_ROW_KEYS = (
     "prefill_p50_ms", "decode_fetch_p50_ms",
     "mfu", "model", "quant", "quant_group_size", "prefill_act_quant",
     "kv_quant", "flash_decode", "flash_sgrid", "fused_decode_layer",
+    # ISSUE 15 add-only extension: the ragged grouped-prefill knob
+    # (effective, engine-read) — its on/off sweep twins compare the
+    # warmup_* cold-start fields and prefill_exec_p50_ms.
+    "ragged_prefill",
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",

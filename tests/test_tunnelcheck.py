@@ -2477,6 +2477,154 @@ def test_tc16_runtime_registry_agrees_with_static_rule():
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# TC17 — dispatch-site program kinds must be warmup-plan-reachable
+# ---------------------------------------------------------------------------
+
+
+def test_tc17_flags_unwarmed_dispatch_kind(tmp_path):
+    """The width-hint hole class one layer earlier: a program kind that
+    exists only at a dispatch site cannot even be enumerated by the
+    warmup plan — the first request reaching it cold-compiles mid-serve."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Eng:
+            def warmup_plan(self):
+                return [("decode", (128, 8))]
+
+            def _dispatch_chunk_rows(self, rows, t):
+                self._note_program("chunk", (t, 128), 0.1)
+        """,
+        rules=["TC17"],
+    )
+    assert rules_of(active) == ["TC17"]
+    assert "'chunk'" in active[0].message
+
+
+def test_tc17_plan_tuple_and_warm_helper_kinds_are_reachable(tmp_path):
+    """Both warm spellings count: a ("kind", shape) tuple in the plan
+    enumeration AND a _warm_* helper's own _note_program call."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Eng:
+            def warmup_plan(self):
+                return [("decode", (128, 8)), ("chunk", (16, 128))]
+
+            def _warm_ragged_program(self, tot):
+                self._note_program("ragged", (tot,), 0.0)
+
+            def _dispatch_decode(self):
+                self._note_program("decode", (128, 8), 0.1)
+
+            def _dispatch_chunk_rows(self, rows, t):
+                self._note_program("chunk", (t, 128), 0.1)
+
+            def _dispatch_ragged_rows(self, rows):
+                self._note_program("ragged", (64,), 0.1)
+        """,
+        rules=["TC17"],
+    )
+    assert active == []
+
+
+def test_tc17_program_key_spelling_is_a_dispatch_site_too(tmp_path):
+    """Minting a key via _program_key directly (ad-hoc accounting without
+    _note_program) is the same reachability hole — both spellings count."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Eng:
+            def warmup_plan(self):
+                return [("decode", (128, 8))]
+
+            def _dispatch_embed(self, rows):
+                key = _program_key("embed", (len(rows),))
+                self._ready.add(key)
+        """,
+        rules=["TC17"],
+    )
+    assert rules_of(active) == ["TC17"]
+    assert "'embed'" in active[0].message
+
+
+def test_tc17_ifexp_branches_checked_individually(tmp_path):
+    """The `"prefill_echo" if echo else "prefill"` dispatch shape: the
+    warmed branch must not launder the unwarmed one."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Eng:
+            def _warm_prefill_program(self, w):
+                self._note_program("prefill", (w,), 0.0)
+
+            def _dispatch_prefill_batch(self, runs, t, echo):
+                self._note_program(
+                    "prefill_echo" if echo else "prefill", (t,), 0.1
+                )
+        """,
+        rules=["TC17"],
+    )
+    assert rules_of(active) == ["TC17"]
+    assert "'prefill_echo'" in active[0].message
+
+
+def test_tc17_waiver_and_out_of_scope_files(tmp_path):
+    """A waiver naming the first-use contract suppresses; files that never
+    call _note_program are out of scope entirely."""
+    active, waived = check(
+        tmp_path,
+        """
+        class Eng:
+            def _dispatch_prefill_batch(self, runs, t, echo):
+                self._note_program("prefill_echo", (t,), 0.1)  # tunnelcheck: disable=TC17  eval-only feature, first-use compile by contract
+        """,
+        rules=["TC17"],
+    )
+    assert active == [] and rules_of(waived) == ["TC17"]
+    active, _ = check(
+        tmp_path,
+        """
+        def unrelated():
+            plan = [("decode", (128, 8))]
+            return plan
+        """,
+        filename="clean.py",
+        rules=["TC17"],
+    )
+    assert active == []
+
+
+def test_tc17_warm_closure_inside_dispatcher_does_not_launder(tmp_path):
+    """A warm-NAMED closure nested inside a dispatch function is not a
+    plan generator — its literals must not mark the kind reachable (and
+    its own _note_program call is a second unwarmed dispatch site)."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Eng:
+            def _dispatch_spec(self):
+                def _warm_fake():
+                    self._note_program("spec", (128,), 0.0)
+                self._note_program("spec", (128,), 0.1)
+        """,
+        rules=["TC17"],
+    )
+    assert rules_of(active) == ["TC17", "TC17"]
+
+
+def test_tc17_engine_self_run_has_only_the_echo_waiver():
+    """The real engine is TC17-clean modulo the documented prefill_echo
+    first-use contract — the ragged/chunk/decode/spec/prefill kinds are
+    all reachable from warmup_plan()."""
+    eng = REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "engine.py"
+    active, waived = run_paths([eng], rules=["TC17"])
+    assert active == []
+    assert rules_of(waived) == ["TC17"]
+    assert any("prefill_echo" in v.message for v in waived)
+
+
 def test_sarif_2_1_0_shape(tmp_path):
     """Pins the SARIF 2.1.0 shape downstream consumers ingest: version,
     $schema, the rules table (ruleIndex points into it), physical
@@ -2536,14 +2684,14 @@ def test_sarif_includes_tc00(tmp_path):
 
 def test_list_rules_pinned_against_code_and_readme(capsys):
     """Rule-id drift (docs vs code) fails fast: --list-rules must show
-    exactly TC00..TC16, every runnable rule must have a summary, and the
+    exactly TC00..TC17, every runnable rule must have a summary, and the
     README rule table must carry a row for every rule."""
     from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
 
     assert tunnelcheck_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     listed = [line.split()[0] for line in out.strip().splitlines()]
-    assert listed == [f"TC{i:02d}" for i in range(17)]
+    assert listed == [f"TC{i:02d}" for i in range(18)]
     assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
 
     readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")

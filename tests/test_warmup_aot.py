@@ -181,6 +181,55 @@ def test_fused_decode_variants_covered_by_warmup(persistent_cache,
     )
 
 
+def test_ragged_mux_herd_hits_zero_cold_compiles(persistent_cache,
+                                                 monkeypatch):
+    """ISSUE 15 acceptance: under the RAGGED prefill path the warmup
+    grid is the collapsed one — decode view×steps plus ONE ragged
+    flat-bucket program (warmup_plan: the whole chunk[t, view] family
+    gone) — and it is still COMPLETE: a multiplexed shared-prefix herd
+    with short-tail, multi-segment, prefix-hit, and mid-decode
+    admissions adds ZERO fresh compiles, and the engine's cold-compile
+    counter stays at zero."""
+    monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "100")
+    monkeypatch.setenv("TUNNEL_WARMUP_PAR", "2")
+    from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+    tok = ByteTokenizer()
+
+    async def run():
+        eng = InferenceEngine(
+            engine_cfg=EngineConfig(
+                **{**ECFG, "mux": True, "ragged_prefill": True}
+            ),
+            tokenizer=tok,
+        )
+        assert eng.ecfg.ragged_prefill, eng.config_fences
+        assert [k for k, _s in eng.warmup_plan() if k == "chunk"] == []
+        await eng.start()
+        await eng.warmup()
+        warmed = _cache_files(persistent_cache)
+        cold0 = global_metrics.counter("engine_cold_compiles_total")
+        shared = list(range(1, 81))  # 5 pooled blocks of 16
+        herd = [shared + [100 + i] for i in range(3)]  # short tails
+        herd.append(list(range(1, 91)))  # multi-segment (90 > chunk 64)
+        outs = await asyncio.gather(*(_collect(eng, p) for p in herd))
+        # Mid-decode admission + a warm prefix-hit tail.
+        outs.append(await _collect(eng, shared + [200]))
+        cold = global_metrics.counter("engine_cold_compiles_total") - cold0
+        await eng.stop()
+        return outs, warmed, cold
+
+    outs, warmed, cold = asyncio.run(run())
+    assert warmed, "warmup wrote nothing to the persistent cache"
+    assert all(len(o) == 8 for o in outs)
+    assert cold == 0, f"{cold} mid-serve cold compiles under ragged mux"
+    live_new = _cache_files(persistent_cache) - warmed
+    assert not live_new, (
+        f"ragged multiplexed herd compiled {len(live_new)} programs "
+        f"warmup missed"
+    )
+
+
 def test_mux_herd_hits_zero_cold_compiles(persistent_cache, monkeypatch):
     """ISSUE 5 warmup coverage: under the MULTIPLEXED serving loop, every
     program the scheduler can reach — both burst sizes x every view

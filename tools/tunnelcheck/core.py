@@ -403,6 +403,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_retry,
         rules_taint,
         rules_tracing,
+        rules_warmup,
     )
 
     return {
@@ -422,6 +423,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC14": rules_taint.check_tc14,
         "TC15": rules_lifecycle.check_tc15,
         "TC16": rules_flight.check_tc16,
+        "TC17": rules_warmup.check_tc17,
     }
 
 
@@ -443,6 +445,7 @@ RULE_SUMMARIES = {
     "TC14": "client-controlled header/body bytes reach a trusted sink unsanitized",
     "TC15": "span/slot/in-flight registration not released on every exit path (incl. generator aclose)",
     "TC16": "flight/postmortem field not in the flight.py registries / ops path matched outside http11.ops_route",
+    "TC17": "dispatch-site program kind unreachable from the warmup/AOT plan generators (mid-serve cold-compile hole)",
 }
 
 
