@@ -122,6 +122,15 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_paged_pool.py -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_paged_pool.py \
 		-k "two_run or leak_gate" -q
+	@# ISSUE 16 matrix rows: the host-RAM spill tier under seeded
+	@# TUNNEL_SPILL_CHAOS fault schedules — spill-on/off byte identity at
+	@# every kv mode, the corrupt-page-in checksum refusal degrading to a
+	@# byte-identical re-prefill, engine-level two-run fault-schedule
+	@# identity (asserted INSIDE the tests via monkeypatched specs), and
+	@# the typed "memory" admission verdict when both tiers exhaust.
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_spill_tier.py -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_spill_tier.py \
+		-k "two_run or chaos or identity" -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

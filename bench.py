@@ -69,6 +69,7 @@ SAFE_OVERRIDES = {
     "BENCH_MUX": "0",
     "BENCH_CONV_CACHE": "0",
     "BENCH_RAGGED_PREFILL": "0",
+    "BENCH_SPILL_PAGES": "0",
 }
 
 
@@ -93,6 +94,7 @@ RESULT_ROW_KEYS = (
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
     "pages_used", "pages_free", "conversation_hit_rate",
+    "spill_pages", "spill_tier_hit_rate", "spill_pagein_p50_ms",
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",
     "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
     "wall_s",
@@ -235,6 +237,9 @@ async def _run_attempt(model: str) -> dict:
     # reuse is a trend axis.
     conv_cache = os.environ.get("BENCH_CONV_CACHE", "1") == "1"
     prefix_evict = os.environ.get("BENCH_PREFIX_EVICT", "cost")
+    # Host-RAM KV spill tier (ISSUE 16) — off by default (the default
+    # bench pool never fills); the memory-pressure sweep configs size it.
+    spill_pages = int(os.environ.get("BENCH_SPILL_PAGES", "0"))
     # Cold-shared-prefix herd (the ISSUE 5 TTFT workload): prepend this
     # many tokens of IDENTICAL templated text to every measured client's
     # prompt — but not the warm client's, so the herd hits the prefix
@@ -300,6 +305,7 @@ async def _run_attempt(model: str) -> dict:
             mux=mux, mux_budget_tokens=mux_budget,
             conv_cache=conv_cache and prefix_cache,
             prefix_evict=prefix_evict,
+            spill_pages=spill_pages,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
@@ -463,6 +469,17 @@ async def _run_attempt(model: str) -> dict:
         round(global_metrics.counter("engine_conv_hits_total") / admissions, 4)
         if admissions > 0 else None
     )
+    # Spill-tier effectiveness (ISSUE 16): of the page-in attempts the
+    # scheduler issued, the fraction that spliced cleanly (the rest fell
+    # back to tail re-prefill).  None when the tier never moved a page.
+    spill_ins = global_metrics.counter("engine_spill_pageins_total")
+    spill_in_fails = global_metrics.counter(
+        "engine_spill_pagein_failures_total"
+    )
+    spill_hit_rate = (
+        round(spill_ins / (spill_ins + spill_in_fails), 4)
+        if (spill_ins + spill_in_fails) > 0 else None
+    )
     import jax
 
     row = {
@@ -547,6 +564,13 @@ async def _run_attempt(model: str) -> dict:
             global_metrics.gauge("engine_prefix_pool_blocks_free")
         ),
         "conversation_hit_rate": conv_hit_rate,
+        # Host-RAM spill tier (ISSUE 16): shadow residency at measurement
+        # end, page-in success rate, and the splice latency median.
+        "spill_pages": int(global_metrics.gauge("engine_spill_pages")),
+        "spill_tier_hit_rate": spill_hit_rate,
+        "spill_pagein_p50_ms": round(
+            global_metrics.percentile("engine_spill_pagein_ms", 50), 1
+        ),
         # Cold-start breakdown (ISSUE 12): captured before the
         # post-warmup metrics reset above.
         "warmup_compile_s": warmup_compile_s,

@@ -288,6 +288,11 @@ def build_parser() -> argparse.ArgumentParser:
                        default=int(_env("TUNNEL_PREFIX_POOL_BLOCKS", "128")),
                        help="prefix-cache pool capacity in KV blocks "
                             "(block 0 is scratch)")
+    serve.add_argument("--spill-pages", type=int,
+                       default=int(_env("TUNNEL_SPILL_PAGES", "0")),
+                       help="pinned host-RAM spill tier capacity in KV "
+                            "pages (0 = off); cold pages migrate out of "
+                            "HBM under pressure and splice back on reuse")
     serve.add_argument("--conv-cache",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_CONV_CACHE", "1") == "1",
@@ -650,6 +655,7 @@ async def _engine_backend(args):
                     prefix_cache=args.prefix_cache,
                     prefix_cache_dir=pfx_dir,
                     prefix_pool_blocks=args.prefix_pool_blocks,
+                    spill_pages=args.spill_pages,
                     conv_cache=args.conv_cache and args.prefix_cache,
                     prefix_evict=args.prefix_evict,
                     spec_ngram=args.spec_ngram,

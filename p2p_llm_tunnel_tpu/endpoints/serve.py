@@ -716,6 +716,40 @@ async def _send_healthz(
                     global_metrics.counter("engine_conv_hit_tokens_total")
                 ),
             },
+            # ISSUE 16: the host-RAM spill tier — residency, bytes, the
+            # in-flight tier-I/O ledger (nonzero at rest is a leak), the
+            # splice/page-out volumes, the dropped-page-in count (each
+            # one fell back to tail re-prefill), and why the engine is
+            # degraded when it is ("memory" = thrash detector).  Fabric
+            # health routing reads degraded_reason to steer around a
+            # memory-pressured peer.
+            "spill": {
+                "pages": int(global_metrics.gauge("engine_spill_pages")),
+                "bytes": int(global_metrics.gauge("engine_spill_bytes")),
+                "inflight": int(
+                    global_metrics.gauge("engine_spill_inflight")
+                ),
+                "pageouts_total": int(
+                    global_metrics.counter("engine_spill_pageouts_total")
+                ),
+                "pageins_total": int(
+                    global_metrics.counter("engine_spill_pageins_total")
+                ),
+                "pagein_failures_total": int(
+                    global_metrics.counter(
+                        "engine_spill_pagein_failures_total"
+                    )
+                ),
+                "memory_sheds_total": int(
+                    global_metrics.counter("engine_memory_shed_total")
+                ),
+                "thrash_trips_total": int(
+                    global_metrics.counter("engine_thrash_trips_total")
+                ),
+            },
+            "degraded_reason": str(
+                global_metrics.info("engine_degraded_reason", "") or ""
+            ),
         },
         # ISSUE 7 observability: per-tenant ingress accounting (in-flight,
         # token rate, sheds) and the advisory Retry-After the 429 paths

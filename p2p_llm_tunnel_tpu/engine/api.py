@@ -77,6 +77,10 @@ def _overloaded(retry_after_s: float = 1.0, code: str = "busy"):
     if code == "tenant_overlimit":
         msg = ("tenant over fair-share limit: this API key is consuming "
                "more than its weighted share of a contended server")
+    elif code == "memory":
+        msg = ("server memory exhausted: KV cache pool and host spill "
+               "tier are both full; retry after the advertised backoff "
+               "or against another peer")
     else:
         msg = "server overloaded: admission queue full"
     status, headers, it = _json_response(

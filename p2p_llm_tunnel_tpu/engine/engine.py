@@ -19,7 +19,8 @@ import functools
 import os
 import time
 from dataclasses import dataclass, replace as dc_replace
-from typing import AsyncIterator, Dict, List, Optional, Tuple
+from collections import deque
+from typing import AsyncIterator, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +77,13 @@ _TIMED_OUT = object()
 #: TenantOverLimit so the response layer emits the typed
 #: ``tenant_overlimit`` error instead of a silently truncated stream.
 _SHED = object()
+
+
+#: Spill-tier I/O batch cap (ISSUE 16): page-outs per end-of-iteration
+#: drain and page-ins per pre-admission splice.  Bounds how much tier
+#: traffic one iteration can add to the executor queue — the drains run
+#: every iteration, so throughput is paced, not capped.
+_SPILL_BATCH = 8
 
 
 def _program_key(kind: str, shape: Tuple[int, ...]) -> str:
@@ -288,6 +296,19 @@ class EngineConfig:
     # conversation's pages outlive a cheap one-shot prompt's under
     # pressure — "lru" restores the plain least-recently-used order.
     prefix_evict: str = "cost"
+    # Host-RAM KV spill tier capacity in pages (ISSUE 16); 0 disables.
+    # Cold (lowest-GreedyDual-priority) pool pages are paged out
+    # asynchronously and an evicted page with a host copy MIGRATES there
+    # instead of dying; a returning conversation whose chain continues
+    # into the tier is spliced back ahead of admission.  Host cost is
+    # spill_pages x per-page KV bytes (kv_quant-scaled).  Needs
+    # prefix_cache; fenced off under SPMD like snapshots (the pool leaves
+    # are mesh-sharded and per-page host copies would gather the mesh).
+    spill_pages: int = 0
+    # Page-out trigger: the spill drain runs when the pool's free-block
+    # count sinks below this mark, keeping a reserve of pre-paid shadows
+    # so eviction under pressure migrates instead of destroys.
+    spill_low_water: int = 4  # tunnelcheck: disable=TC08  derived drain-pacing knob (a fraction of prefix_pool_blocks in spirit); one more CLI surface would just invite mis-tuning the hysteresis — programmatic only
 
 
 @dataclass
@@ -486,6 +507,17 @@ class InferenceEngine:
         self._page_reserved: Dict[int, int] = {}
         self._prefill_ms_per_token = 0.0
         self._prefix_published: Dict[str, int] = {}
+        # Memory-degradation state (ISSUE 16), initialised BEFORE the
+        # prefix block below publishes its first gauges: why
+        # engine_degraded is set ("watchdog" | "memory" | "" — the
+        # watchdog's progress-clear only touches its own reason), the
+        # thrash detector's sliding window of (evict, realloc) deltas,
+        # and the in-flight tier-I/O ledger the leak gate reads.
+        self.degraded = False
+        self.degraded_reason = ""
+        self._thrash_window: Deque[Tuple[int, int]] = deque(maxlen=64)
+        self._thrash_last: Tuple[int, int] = (0, 0)
+        self._spill_inflight = 0
         if self.ecfg.kv_quant == "int4":
             # Block-paged alignment (ISSUE 14): chunk-prefill writes are
             # legal on the packed sequence axis exactly when every write
@@ -669,11 +701,18 @@ class InferenceEngine:
                 "the prefix pool, which prefix_cache=False leaves "
                 "uninitialised",
             )
+        if self.ecfg.spill_pages > 0 and not self.ecfg.prefix_cache:
+            self._fence(
+                "spill_pages", 0,
+                "the spill tier shadows prefix-pool pages, which "
+                "prefix_cache=False leaves uninitialised",
+            )
         if self.ecfg.prefix_cache:
             from p2p_llm_tunnel_tpu.engine.prefix_cache import (
                 PrefixIndex,
                 init_pool,
                 make_batch_copy_ops,
+                make_spill_ops,
                 pool_packed_keys,
             )
 
@@ -687,9 +726,20 @@ class InferenceEngine:
                 for i in range(max(1, self.ecfg.prefix_tail_buckets))
                 if blk * (2 ** i) <= s
             ]
+            if self.ecfg.spill_pages > 0 and self.mesh is not None:
+                # Same scope limit as pool snapshots: the pool leaves are
+                # mesh-sharded and a per-page host copy would gather the
+                # mesh on the serving path.
+                self._fence(
+                    "spill_pages", 0,
+                    "pool leaves are mesh-sharded (tp/sp>1); per-page "
+                    "host copies would gather the mesh on the serving "
+                    "path — same scope limit as pool snapshots",
+                )
             self._prefix = PrefixIndex(
                 blk, self.ecfg.prefix_pool_blocks,
                 evict=self.ecfg.prefix_evict,
+                spill_pages=self.ecfg.spill_pages,
             )
             self._pool = init_pool(
                 self.kv_cache, blk, self.ecfg.prefix_pool_blocks
@@ -739,6 +789,22 @@ class InferenceEngine:
                 self._copy_out = self._spmd.wrap(
                     "copy_out", self._copy_out, 2
                 )
+            # Host-RAM spill tier (ISSUE 16): jitted single-page tier I/O
+            # (traced idx — one compile each, ever), the compatibility pin
+            # metadata every page carries across the tier boundary (TC18),
+            # the seeded fault schedule (TUNNEL_SPILL_CHAOS), and the
+            # in-flight op ledger the loadgen leak gate reads.
+            self._page_out_op = self._page_in_op = None
+            self._spill_meta: Dict = {}
+            self._spill_chaos = None
+            if self.ecfg.spill_pages > 0:
+                from p2p_llm_tunnel_tpu.transport.chaos import (
+                    maybe_spill_chaos,
+                )
+
+                self._page_out_op, self._page_in_op = make_spill_ops()
+                self._spill_meta = self._prefix_snapshot_meta()
+                self._spill_chaos = maybe_spill_chaos()
             # Page reservation (ISSUE 14): admission reserves the pool
             # pages a request's prompt insert will want, evicting
             # (cost-aware) under pressure AT admission time instead of
@@ -838,6 +904,8 @@ class InferenceEngine:
         self._last_mux: Dict[str, object] = {}
         self._flight_admitted = 0
         self._flight_conv = 0
+        self._flight_pageouts = 0
+        self._flight_pageins = 0
         self._last_burst: Tuple[int, int] = (0, 0)
         # Postmortem black box: this engine contributes the config +
         # scheduler/slot/backlog snapshot to captured bundles (latest
@@ -1192,15 +1260,26 @@ class InferenceEngine:
                     )
                     global_metrics.inc("engine_watchdog_stalls_total")
                     self.degraded = True
+                    self.degraded_reason = "watchdog"  # tunnelcheck: disable=TC13  reason ownership protocol: watchdog writes only on the not-degraded -> degraded edge it just took; "memory" trips/clears are owned by the loop's _thrash_tick hysteresis and never race this branch
+                    global_metrics.set_info(
+                        "engine_degraded_reason", "watchdog"
+                    )
                     global_metrics.set_gauge("engine_degraded", 1.0)
                     # Postmortem black box: snapshot the engine AT the
                     # trip, not minutes later — runs on this task because
                     # the loop itself is what is stuck (capture never
                     # raises past its own logging).
                     global_blackbox.capture("watchdog", attribution=phase)
-            elif self.degraded and not stalled:
+            elif (self.degraded and not stalled
+                    and self.degraded_reason == "watchdog"):
+                # Progress only clears a WATCHDOG degradation: a memory
+                # trip (ISSUE 16) is owned by the thrash detector's own
+                # hysteresis — tokens still flow while the pool thrashes,
+                # so "a token landed" proves nothing about memory health.
                 log.info("decode-stall watchdog: progress resumed")
                 self.degraded = False
+                self.degraded_reason = ""
+                global_metrics.set_info("engine_degraded_reason", "")
             global_metrics.set_gauge(
                 "engine_degraded", 1.0 if self.degraded else 0.0
             )
@@ -1462,8 +1541,12 @@ class InferenceEngine:
                 "pages_reserved": self._prefix.reserved_pages,
                 "evictions": self._prefix.evictions,
                 "conv_pending": len(self._conv_pending),
+                "spill_pages": self._prefix.spill_resident,
+                "spill_inflight": self._spill_inflight,
+                "thrash_reallocs": self._prefix.thrash_reallocs,
             },
             "degraded": self.degraded,
+            "degraded_reason": self.degraded_reason,
             "crashed": self._crashed,
             "warmup_done": self._warmup_done,
             "programs_ready": sorted(self._programs_ready),
@@ -1832,6 +1915,13 @@ class InferenceEngine:
         self.kv_cache = self._copy_in(*in_args)
         _, out_args = self._copy_warm_args()
         self._pool = self._copy_out(*out_args)
+        if self._page_out_op is not None:
+            # Spill-tier I/O programs (ISSUE 16): one round trip through
+            # the scratch page compiles both — idx is traced, so these are
+            # the only compiles the tier ever pays.
+            page = self._page_out_op(self._pool, jnp.int32(0))
+            host = {k: np.asarray(v) for k, v in page.items()}
+            self._pool = self._page_in_op(self._pool, jnp.int32(0), host)
         log.info(
             "prefix-cache warmup: copy ops compiled in %.1fs",
             time.monotonic() - t0,
@@ -1845,7 +1935,17 @@ class InferenceEngine:
         ``"tenant_overlimit"`` (the tenant is over its fair share of a
         contended queue).  The typed-error code IS the return value, so the
         API layer can shed before any streaming 200 with the same
-        vocabulary the scheduler raises mid-stream."""
+        vocabulary the scheduler raises mid-stream.
+
+        ISSUE 16 adds ``"memory"``: both KV tiers exhausted (HBM pool
+        fully reserved AND the host spill tier at capacity).  Checked
+        before the queue arithmetic — and independent of ``max_waiting``
+        — because admitting into a thrashing pool converts every queued
+        request into recompute churn, the exact failure the degradation
+        contract exists to refuse."""
+        if self._memory_exhausted():
+            global_metrics.inc("engine_memory_shed_total")
+            return "memory"
         mw = self.ecfg.max_waiting
         if mw <= 0:
             return None
@@ -3024,6 +3124,7 @@ class InferenceEngine:
         if not admitted:
             return
         self._note_admission(admitted)
+        await self._drain_page_ins(loop, admitted)
         await self._dispatch_plain_waves(loop, admitted)
 
     def _note_admission(self, admitted: List[RunningSlot]) -> None:
@@ -3182,6 +3283,7 @@ class InferenceEngine:
         if not admitted:
             return
         self._note_admission(admitted)
+        await self._drain_page_ins(loop, admitted)
         echo = [r for r in admitted if r.request.echo_logprobs]
         if echo:
             await self._dispatch_plain_waves(loop, echo)
@@ -3562,11 +3664,246 @@ class InferenceEngine:
         self._flight_conv = len(pending)  # tunnelcheck: disable=TC13  engine-loop task is the only writer (same single-writer contract as _flight_admitted)
         await loop.run_in_executor(self._executor, self._conv_insert, pending)
 
+    def _memory_exhausted(self) -> bool:
+        """The ISSUE 16 degradation verdict: BOTH KV tiers exhausted — the
+        HBM pool fully reserved by in-flight admissions AND the host spill
+        tier at capacity.  Only meaningful with the tier configured:
+        without one, HBM pressure is handled by eviction alone (the
+        pre-ISSUE-16 behavior, preserved exactly)."""
+        pi = self._prefix
+        if pi is None or pi.spill_pages <= 0:
+            return False
+        return (pi.reserved_pages >= pi.capacity - 1
+                and pi.spill_resident >= pi.spill_pages)
+
+    async def _drain_spill_outs(self, loop) -> None:
+        """End-of-iteration spill drain (ISSUE 16): when the pool's free
+        blocks sink below the low-water mark, page the coldest unshadowed
+        pages out to host RAM — a bounded batch per iteration, planned on
+        the event loop, bytes copied on the executor, committed back on
+        the loop (the _release_pages threading contract).  Shadowed pages
+        then MIGRATE on eviction instead of dying, so a capacity-cliff
+        herd degrades to host-tier hits rather than full re-prefills."""
+        pi = self._prefix
+        if pi is None or pi.spill_pages <= 0:
+            return
+        # Proactive cleaner watermark: wake at half-full, not near-empty.
+        # The tier only protects a capacity cliff if pages are shadowed
+        # BEFORE the eviction burst arrives; gating on a near-empty free
+        # list meant the first over-capacity turn evicted a pool of
+        # entirely unshadowed pages (the r16 herd's turn-2 transient:
+        # 18/80 matches while the cleaner bootstrapped).  Half-full keeps
+        # the genuinely quiet period free of tier traffic while giving
+        # the cleaner a full turn of shadowing lead time; once everything
+        # cold is shadowed, spill_plan returns empty and the drain is a
+        # cheap host-side no-op.
+        if pi.free_blocks >= max(self.ecfg.spill_low_water,
+                                 pi.capacity // 2):
+            return
+        # Batch scales with the pool so tier bandwidth tracks churn: a
+        # capacity-cliff herd evicts O(pool) pages per turn wave, and a
+        # fixed batch would shadow only a sliver of them before they die
+        # (the r16 80-client experiment measured exactly that at 8/iter).
+        batch = max(_SPILL_BATCH, (pi.capacity - 1) // 8)
+        plan = pi.spill_plan(batch)
+        if not plan:
+            return
+        self._spill_inflight += len(plan)  # tunnelcheck: disable=TC13  engine-loop task is the only writer; the executor call below only READS the plan
+        try:
+            results = await loop.run_in_executor(
+                self._executor, self._spill_copy_out, plan
+            )
+        finally:
+            self._spill_inflight -= len(plan)
+        committed = 0
+        for key, payload, checksum in results:
+            if payload is None:
+                global_metrics.inc("engine_spill_pageout_failures_total")
+                continue
+            if pi.note_spilled(key, payload, checksum,
+                               dict(self._spill_meta)):
+                committed += 1
+        if committed:
+            global_metrics.inc("engine_spill_pageouts_total", committed)
+        self._flight_pageouts = committed  # tunnelcheck: disable=TC13  single-writer: reset by the loop, written here, read at _flight_record
+
+    def _spill_copy_out(self, plan) -> List[Tuple[bytes, Optional[Dict], bytes]]:
+        """Executor thread: gather each planned page's leaves to host RAM
+        and checksum the TRUE bytes.  Chaos faults (TUNNEL_SPILL_CHAOS)
+        draw one schedule entry per page: ``fail`` drops the page-out
+        (the page simply stays HBM-only), ``stall`` sleeps this thread
+        mid-copy (the event loop keeps serving), ``corrupt`` flips one
+        stored byte AFTER checksumming so the page-in verification must
+        catch it."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import page_checksum
+
+        t0 = time.monotonic()
+        out: List[Tuple[bytes, Optional[Dict], bytes]] = []
+        for key, idx in plan:
+            fault, stall_s, pos = None, 0.0, 0
+            if self._spill_chaos is not None:
+                fault, stall_s, pos = self._spill_chaos.draw("pageout")
+            if fault == "stall":
+                time.sleep(stall_s)
+            elif fault == "fail":
+                out.append((key, None, b""))
+                continue
+            page = self._page_out_op(self._pool, jnp.int32(idx))  # tunnelcheck: disable=TC07  bounded batch (<= pool_capacity/8) at end of iteration, off the TTFT-critical path — not a per-request loop
+            payload = {k: np.asarray(v) for k, v in page.items()}
+            checksum = page_checksum(payload)
+            if fault == "corrupt":
+                leaf = sorted(payload)[0]
+                payload[leaf] = np.array(payload[leaf], copy=True)
+                flat = payload[leaf].reshape(-1).view(np.uint8)
+                flat[pos % flat.size] ^= 0xFF
+            out.append((key, payload, checksum))
+        global_metrics.observe(
+            "engine_spill_pageout_ms", (time.monotonic() - t0) * 1000.0
+        )
+        return out
+
+    async def _drain_page_ins(self, loop, admitted) -> None:
+        """Page-in splice for an ADMITTED wave (ISSUE 16): called from
+        both admission paths between ``scheduler.admit()`` and the wave's
+        pool matches, so host-tier pages continuing an admitted prompt's
+        chain land in the pool just-in-time for the match that runs a few
+        calls later.  Earlier drafts ran this once per iteration against
+        a PEEK of the waiting queue — at herd scale the peek raced the
+        arrival stream (requests admitted this iteration but submitted
+        after the peek got no splice, re-prefilled their whole history,
+        and their bulk inserts evicted the next wave's chains: the r16
+        80-client run measured hundreds of splices/turn converting to
+        single-digit matches).  Splicing for exactly the admitted set
+        closes the race by construction.  A failed/corrupt page-in aborts
+        its slot claim and the request simply re-prefills that tail:
+        correctness never depends on the tier."""
+        pi = self._prefix
+        if pi is None or pi.spill_pages <= 0 or pi.spill_resident == 0:
+            return
+        wave = [run for run in admitted
+                if not getattr(run.request, "echo_logprobs", False)]
+        if not wave:
+            return
+        wanted: List[bytes] = []
+        seen: set = set()
+        protect: set = set()
+        # Demand-limited batch: the cap is the wave's own extension
+        # demand (rows × their chain length), because every spliced page
+        # replaces a full page of tail re-prefill — strictly cheaper
+        # than the compute it displaces.  A fixed 8-page cap starved
+        # returning turns at herd scale (r16).
+        cap = len(wave) * self._prefix_max_blocks
+        for run in wave:
+            # Protect EVERY admitted prompt's full chain — resident pages
+            # past a gap included — before any claim runs: a claim that
+            # evicts a page some neighbor in the same wave will match
+            # converts that neighbor's splice into churn.
+            protect.update(pi.chain_keys(run.request.prompt_ids))
+        # Claims honor `protect`, but the wave's own reserve/insert
+        # evictions a few calls later do NOT — and a chain untouched
+        # since last turn is precisely the LRU tail they harvest.  MRU-
+        # touch the wave's residents so "matched this iteration" beats
+        # "cold" in eviction order.
+        pi.touch_resident(protect)
+        for run in wave:
+            ext = pi.spill_extension(run.request.prompt_ids)
+            if not ext:
+                continue
+            for _, key in ext:
+                if key not in seen:
+                    seen.add(key)
+                    wanted.append(key)
+            if len(wanted) >= cap:
+                break
+        if not wanted:
+            return
+        items = pi.page_in_alloc(wanted[:cap], protect=frozenset(protect))
+        if not items:
+            return
+        self._spill_inflight += len(items)  # tunnelcheck: disable=TC13  engine-loop task is the only writer; the executor call below only READS the claims
+        try:
+            results = await loop.run_in_executor(
+                self._executor, self._spill_copy_in, items
+            )
+        finally:
+            self._spill_inflight -= len(items)
+        ok_n = 0
+        for key, idx, ok in results:
+            if ok:
+                pi.commit_page_in(key, idx)
+                ok_n += 1
+            else:
+                pi.abort_page_in(key, idx)
+                global_metrics.inc("engine_spill_pagein_failures_total")
+        if ok_n:
+            global_metrics.inc("engine_spill_pageins_total", ok_n)
+        self._flight_pageins = ok_n  # tunnelcheck: disable=TC13  single-writer: reset by the loop, written here, read at _flight_record
+
+    def _spill_copy_in(self, items) -> List[Tuple[bytes, int, bool]]:
+        """Executor thread: verify + splice host-tier pages into their
+        claimed pool slots.  Every page passes the registered tier-
+        boundary pin check (:func:`verify_page_pin` — TC18) AND its
+        integrity checksum BEFORE any device write; chaos faults draw one
+        schedule entry per page (``fail`` aborts the splice outright,
+        ``corrupt`` flips a byte of a COPY so the checksum must refuse
+        it, ``stall`` sleeps this thread while the loop keeps serving)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            PagePinError,
+            page_checksum,
+            verify_page_pin,
+        )
+
+        t0 = time.monotonic()
+        out: List[Tuple[bytes, int, bool]] = []
+        for key, idx, page in items:
+            payload = page.payload
+            fault, pos = None, 0
+            if self._spill_chaos is not None:
+                fault, stall_s, pos = self._spill_chaos.draw("pagein")
+                if fault == "stall":
+                    time.sleep(stall_s)
+                elif fault == "fail":
+                    out.append((key, idx, False))
+                    continue
+                elif fault == "corrupt":
+                    leaf = sorted(page.payload)[0]
+                    payload = dict(page.payload)
+                    payload[leaf] = np.array(payload[leaf], copy=True)
+                    flat = payload[leaf].reshape(-1).view(np.uint8)
+                    flat[pos % flat.size] ^= 0xFF
+            try:
+                payload = verify_page_pin(payload, page.meta,
+                                          self._spill_meta)
+                if page_checksum(payload) != page.checksum:
+                    raise PagePinError("spill page checksum mismatch")
+            except PagePinError as e:
+                log.warning("page-in dropped (%s); falling back to tail "
+                            "re-prefill", e)
+                out.append((key, idx, False))
+                continue
+            self._pool = self._page_in_op(  # tunnelcheck: disable=TC07  bounded by the peeked admission wave's own extension demand, ahead of admission — each splice displaces a full page of tail prefill, not a per-request loop
+                self._pool, jnp.int32(idx),
+                {k: jnp.asarray(v) for k, v in payload.items()},
+            )
+            out.append((key, idx, True))
+        global_metrics.observe(
+            "engine_spill_pagein_ms", (time.monotonic() - t0) * 1000.0
+        )
+        return out
+
     def _publish_prefix_gauges(self) -> None:
         """Prefix-pool memory accounting (ISSUE 6/14): pages used/free/
         reserved, resident KV bytes, and the eviction + conversation-cache
         counters (delta-inc from the index's internal tallies).  Host
-        arithmetic over the index only — no device traffic."""
+        arithmetic over the index only — no device traffic.
+
+        ISSUE 16 adds the spill-tier gauges and the memory-thrash
+        detector: eviction-rate × reuse-distance over a sliding window of
+        these publishes (one per non-idle iteration — the flight ring's
+        cadence).  A page re-allocated while still in the recent-eviction
+        ring has reuse distance > capacity by construction, so a window
+        where most evictions are such re-allocations is the pool churning
+        without retaining — degrade loudly instead of thrashing."""
         if self._prefix is None:
             return
         used = self._prefix.used_blocks
@@ -3590,6 +3927,64 @@ class InferenceEngine:
             if delta > 0:
                 global_metrics.inc(metric, delta)
                 self._prefix_published[attr] = now
+        if self._prefix.spill_pages > 0:
+            resident = self._prefix.spill_resident
+            global_metrics.set_gauge("engine_spill_pages", resident)
+            global_metrics.set_gauge(
+                "engine_spill_bytes", resident * self._prefix_block_bytes
+            )
+            global_metrics.set_gauge(
+                "engine_spill_inflight", self._spill_inflight
+            )
+        self._thrash_tick()
+
+    def _thrash_tick(self) -> None:
+        """One thrash-detector step (loop thread, one per gauge publish):
+        window the (eviction, recent-realloc) deltas, trip degraded on a
+        churn-dominated window, clear on a quiet one."""
+        ev = self._prefix.evictions
+        re_alloc = self._prefix.thrash_reallocs
+        d_ev = ev - self._thrash_last[0]
+        d_re = re_alloc - self._thrash_last[1]
+        self._thrash_last = (ev, re_alloc)
+        if d_ev or d_re or self._thrash_window:
+            self._thrash_window.append((d_ev, d_re))
+        window_re = sum(r for _, r in self._thrash_window)
+        window_ev = sum(e for e, _ in self._thrash_window)
+        threshold = max(8, self._prefix.capacity - 1)
+        if (window_re >= threshold and window_ev >= threshold
+                and not self.degraded):
+            log.error(
+                "memory-thrash detector: %d re-allocations of recently "
+                "evicted pages across %d evictions in the detector "
+                "window; marking engine degraded (reason=memory)",
+                window_re, window_ev,
+            )
+            global_metrics.inc("engine_thrash_trips_total")
+            self.degraded = True
+            self.degraded_reason = "memory"
+            global_metrics.set_gauge("engine_degraded", 1.0)
+            global_metrics.set_info("engine_degraded_reason", "memory")
+            # Postmortem AT the trip: the flight tail shows the
+            # eviction/page-in churn that tripped it, and fabric health
+            # routing (proxy degraded-peer handling) steers around this
+            # peer while the reason stands.
+            global_blackbox.capture(
+                "memory", attribution="prefix_pool_thrash"
+            )
+            self._thrash_window.clear()
+        elif (self.degraded and self.degraded_reason == "memory"
+                and window_re == 0
+                and self._prefix.free_blocks >= self.ecfg.spill_low_water):
+            # Hysteresis: a full window with zero re-allocations AND free
+            # headroom above the low-water mark — pressure actually
+            # subsided, not just paused between admission waves.
+            log.info("memory-thrash detector: pressure subsided; "
+                     "clearing degraded")
+            self.degraded = False
+            self.degraded_reason = ""
+            global_metrics.set_gauge("engine_degraded", 0.0)
+            global_metrics.set_info("engine_degraded_reason", "")
 
     async def _process_burst(self, outs, assign: List) -> None:
         """Account one fetched token block [R, k] against current occupants.
@@ -3657,6 +4052,12 @@ class InferenceEngine:
                 else 0
             ),
             conv_inserted=self._flight_conv,
+            spill_pages=(
+                self._prefix.spill_resident if self._prefix is not None
+                else 0
+            ),
+            spill_pageouts=self._flight_pageouts,
+            spill_pageins=self._flight_pageins,
             cold_compiles=global_compile_watch.cold_total - cold0,
             # Detached-stream count (ISSUE 13): how many of this
             # iteration's generations are filling replay journals with no
@@ -3708,12 +4109,17 @@ class InferenceEngine:
                 it_t0 = time.monotonic()
                 self._flight_admitted = 0  # tunnelcheck: disable=TC13  single-writer contract: only THIS loop task and the admission helpers it awaits touch the per-iteration flight scratch; the reset-here/accumulate-in-_note_admission/read-at-record sequence cannot interleave with another writer
                 self._flight_conv = 0
+                self._flight_pageouts = 0
+                self._flight_pageins = 0
                 self._last_burst = (0, 0)
                 self._last_mux = {}
                 cold0 = global_compile_watch.cold_total
                 plain_rows = 0
                 global_flight.set_phase("admit")
                 self._expire_deadlines()
+                # The page-in splice (ISSUE 16) runs INSIDE admission —
+                # between scheduler.admit() and the wave's matches — for
+                # exactly the admitted set; see _drain_page_ins.
                 if self.ecfg.mux:
                     await self._admit_mux(loop)
                     await self._mux_wake(loop)
@@ -3800,6 +4206,7 @@ class InferenceEngine:
                     for seg in segs:
                         await self._finish_segments(loop, seg)
                     await self._drain_conv_inserts(loop)
+                    await self._drain_spill_outs(loop)
                     self._flight_record(
                         it_t0, t_admit, t_prefill, t_spec, t_spec,
                         plain_rows, seg_rows, cold0,
@@ -3853,6 +4260,11 @@ class InferenceEngine:
                 # iteration — BEFORE the next admission can re-prefill
                 # them (ISSUE 14; off the TTFT-critical path by position).
                 await self._drain_conv_inserts(loop)
+                # Spill page-outs LAST (ISSUE 16): cold pages copied to
+                # the host tier after all of this iteration's serving
+                # dispatches are queued — same off-the-critical-path
+                # position as the conversation drain.
+                await self._drain_spill_outs(loop)
                 in_flight = current
                 self._flight_record(
                     it_t0, t_admit, t_prefill, t_dispatch, t_fetch,

@@ -30,6 +30,17 @@ tables).  Consistency: the host index is only touched from the engine's
 event loop, and device copies dispatch through the engine's single XLA
 executor thread, so a match's copy-in always executes before any later
 insert that might recycle the matched block.
+
+Tiered spill (ISSUE 16): the pool grows a pinned host-RAM tier.  Cold
+pages (lowest GreedyDual priority) are paged out asynchronously as byte
+payloads + integrity checksum + compatibility pin metadata; an evicted
+page with a host shadow MIGRATES to the tier instead of dying, and a
+returning prompt whose chain continues into the tier is spliced back via
+a two-phase page-in (claim a slot on the event loop, copy + verify on
+the executor).  Correctness never depends on the tier: a failed or
+corrupt page-in drops the page and the request re-prefills its tail —
+:func:`verify_page_pin` is the registered tier-boundary check tunnelcheck
+TC18 enforces statically.
 """
 
 from __future__ import annotations
@@ -64,6 +75,61 @@ class _Entry:
         self.prio = prio
 
 
+class PagePinError(ValueError):
+    """A KV page's compatibility pins don't match the engine's (quant mode,
+    group size, kv_quant, dtype, block geometry): splicing its bytes would
+    silently serve KV computed under different numerics.  Callers treat
+    the page as lost and fall back to tail re-prefill."""
+
+
+def verify_page_pin(page, meta: Dict, want: Dict):
+    """THE registered tier-boundary check (tunnelcheck TC18): every KV page
+    crossing a tier or tunnel boundary must flow through here before its
+    bytes are spliced into a pool or cache.  Returns ``page`` only when
+    every pin in ``want`` matches the page's recorded ``meta`` — the same
+    compatibility contract as the PR 2/3 snapshot-manifest pin loop,
+    applied per page instead of per snapshot."""
+    for key, val in want.items():
+        if meta.get(key) != val:
+            raise PagePinError(
+                f"KV page pin mismatch on {key!r}: page carries "
+                f"{meta.get(key)!r}, engine wants {val!r}"
+            )
+    return page
+
+
+def page_checksum(payload: Dict[str, np.ndarray]) -> bytes:
+    """Integrity digest over a host-tier page's raw bytes, leaf-name
+    keyed so a leaf swap can't cancel out.  Verified on every page-in —
+    a corrupt page must fall back to re-prefill, never splice."""
+    h = hashlib.blake2b(digest_size=16)
+    for key in sorted(payload):
+        h.update(key.encode())
+        h.update(np.ascontiguousarray(payload[key]).tobytes())
+    return h.digest()
+
+
+class _SpillPage:
+    """One host-RAM tier page: the paged-out pool bytes (opaque to the
+    index — a dict of per-leaf numpy arrays), an integrity checksum over
+    them, the compatibility pin metadata that must ride every page across
+    a tier boundary (TC18), and the GreedyDual accounting carried over
+    from the HBM entry so tier-resident pages keep competing on recompute
+    cost after they migrate."""
+
+    __slots__ = ("payload", "checksum", "meta", "cost", "conv", "prio")
+
+    def __init__(self, payload: Dict[str, np.ndarray], checksum: bytes,
+                 meta: Dict, cost: float = 0.0, conv: bool = False,
+                 prio: float = 0.0):
+        self.payload = payload
+        self.checksum = checksum
+        self.meta = meta
+        self.cost = cost
+        self.conv = conv
+        self.prio = prio
+
+
 class PrefixIndex:
     """Host-side chain-hash index: block content -> pool slot, with
     LRU or cost-aware (GreedyDual) eviction.
@@ -93,7 +159,8 @@ class PrefixIndex:
     (tests/test_paged_pool.py two-run identity).
     """
 
-    def __init__(self, block: int, capacity: int, evict: str = "lru"):
+    def __init__(self, block: int, capacity: int, evict: str = "lru",
+                 spill_pages: int = 0):
         assert capacity >= 2, "need at least scratch + one real block"
         if evict not in ("lru", "cost"):
             raise ValueError(f"unknown evict mode {evict!r}")
@@ -115,6 +182,21 @@ class PrefixIndex:
         self.conv_hits = 0
         self.conv_hit_tokens = 0
         self.reserved_pages = 0
+        # Host-RAM spill tier (ISSUE 16).  ``_spill`` shadows pool pages by
+        # chain key: while the key is also HBM-resident the shadow is a
+        # pre-paid copy (eviction then migrates instead of destroying);
+        # once evicted the shadow is the page's only body and a page-in
+        # splices it back.  Event-loop-thread-only, like every index
+        # structure — the executor copies bytes, the loop commits them.
+        self.spill_pages = max(0, int(spill_pages))
+        self._spill: "OrderedDict[bytes, _SpillPage]" = OrderedDict()
+        self.spill_pageouts = 0
+        self.spill_pageins = 0
+        self.spill_drops = 0
+        # Thrash substrate: keys evicted recently enough that re-allocating
+        # them signals reuse-distance > capacity (the detector's input).
+        self._recent_evicted: "OrderedDict[bytes, float]" = OrderedDict()
+        self.thrash_reallocs = 0
 
     @property
     def used_blocks(self) -> int:
@@ -126,36 +208,66 @@ class PrefixIndex:
         """Pool blocks available for insertion without an eviction."""
         return len(self._free)
 
+    @property
+    def spill_resident(self) -> int:
+        """Host-tier pages currently held (shadows + host-only)."""
+        return len(self._spill)
+
     def export_state(self) -> List[List]:
-        """LRU-ordered [[hex key, pool idx, cost, conv], ...] (oldest
-        first) for the pool snapshot."""
-        return [
-            [k.hex(), e.idx, round(e.cost, 3), int(e.conv)]
+        """Snapshot rows: a leading ``["clock", value]`` row (the
+        GreedyDual value floor — without it a restore replays saved prios
+        against clock 0 and the first insert wave evicts every restored
+        page first), then LRU-ordered ``[hex key, pool idx, cost, conv,
+        prio]`` for HBM-resident pages, then ``[hex key, -1, ...]``
+        tier-residency markers for host-only spilled pages.  ``idx == -1``
+        means NOT HBM-resident: a restore must not resurrect these as pool
+        pages — their bytes live in process RAM, which the snapshot file
+        does not carry."""
+        rows: List[List] = [["clock", round(self._clock, 3)]]
+        rows += [
+            [k.hex(), e.idx, round(e.cost, 3), int(e.conv),
+             round(e.prio, 3)]
             for k, e in self._lru.items()
         ]
+        rows += [
+            [k.hex(), -1, round(p.cost, 3), int(p.conv), round(p.prio, 3)]
+            for k, p in self._spill.items()
+            if k not in self._lru
+        ]
+        return rows
 
     def import_state(self, entries: List[List]) -> None:
         """Restore a snapshot's index; unreferenced pool slots become free.
         Malformed entries are skipped — a damaged manifest must degrade to
-        a (partially) cold pool, never crash engine startup.  Accepts both
-        the 2-field pre-ISSUE-14 shape and the 4-field (cost, conv) one."""
+        a (partially) cold pool, never crash engine startup.  Accepts the
+        2-field pre-ISSUE-14 shape, the 4-field (cost, conv) ISSUE-14
+        shape, and the 5-field (+prio) ISSUE-16 shape with its optional
+        leading clock row.  Spilled-page markers (idx -1) are residency
+        records only and are SKIPPED: the host-tier bytes died with the
+        writing process, and resurrecting the key as HBM-resident would
+        alias it to a pool block holding other content."""
         self._lru.clear()
         self._clock = 0.0
         used = set()
         for entry in entries:
             try:
+                if entry[0] == "clock":
+                    self._clock = float(entry[1])
+                    continue
                 khex, idx = entry[0], int(entry[1])
                 key = bytes.fromhex(khex)
                 cost = float(entry[2]) if len(entry) > 2 else 0.0
                 conv = bool(entry[3]) if len(entry) > 3 else False
+                prio = float(entry[4]) if len(entry) > 4 else cost
             except (TypeError, ValueError, IndexError):
                 continue
             if not 1 <= idx < self.capacity or idx in used:
-                # Out-of-range (larger pool) or duplicate index (damaged
-                # manifest): admitting it would alias two prefix keys to
-                # one KV block — another prompt's cache served silently.
+                # Out-of-range (larger pool / spilled-tier marker) or
+                # duplicate index (damaged manifest): admitting it would
+                # alias two prefix keys to one KV block — another prompt's
+                # cache served silently.
                 continue
-            self._lru[key] = _Entry(idx, cost, conv, prio=cost)
+            self._lru[key] = _Entry(idx, cost, conv, prio=prio)
             used.add(idx)
         self._free = [i for i in range(1, self.capacity) if i not in used]
 
@@ -232,18 +344,37 @@ class PrefixIndex:
         """The next eviction victim, or None when every page is protected
         (allocated in the in-progress call).  "lru": the least-recently
         touched page.  "cost": the minimum-priority page, LRU order
-        breaking ties — deterministic by OrderedDict iteration."""
+        breaking ties — deterministic by OrderedDict iteration.
+
+        With the spill tier active, both policies become CLEAN-FIRST
+        (write-back cache discipline): a page with a host shadow is
+        recoverable — evicting it is a tier migration — while evicting
+        an unshadowed page destroys it and breaks its chain for every
+        later turn.  Dirty pages are only taken when no clean candidate
+        exists (the async cleaner is behind); the r16 herd measured the
+        alternative — planned page-out victims evaporating between plan
+        and commit under burst churn — as whole-chain loss that capped
+        every returning match at the first dead block."""
+        clean_tier = self.spill_pages > 0
         if self.evict == "lru":
+            dirty_fallback = None
             for key in self._lru:
-                if key not in protect:
-                    return key
-            return None
-        best_key, best_prio = None, None
-        for key, entry in self._lru.items():
+                if key in protect:
+                    continue
+                if clean_tier and key not in self._spill:
+                    if dirty_fallback is None:
+                        dirty_fallback = key
+                    continue
+                return key
+            return dirty_fallback
+        best_key, best_rank = None, None
+        for pos, (key, entry) in enumerate(self._lru.items()):
             if key in protect:
                 continue
-            if best_prio is None or entry.prio < best_prio:
-                best_key, best_prio = key, entry.prio
+            dirty = 1 if (clean_tier and key not in self._spill) else 0
+            rank = (dirty, entry.prio, pos)
+            if best_rank is None or rank < best_rank:
+                best_key, best_rank = key, rank
         return best_key
 
     def _evict_one(self, protect: set) -> Optional[int]:
@@ -257,6 +388,19 @@ class PrefixIndex:
         # expensive page eventually loses to fresh cheap ones).
         self._clock = max(self._clock, entry.prio)
         self.evictions += 1
+        page = self._spill.get(victim)
+        if page is not None:
+            # Tier migration, not loss: the host shadow (paged out earlier
+            # by the spill drain) becomes the page's only body.  The HBM
+            # entry's accounting rides along so a later page-in re-enters
+            # GreedyDual competition where the page left off.
+            page.cost, page.conv, page.prio = entry.cost, entry.conv, entry.prio
+        # Thrash substrate: remember recent victims so a re-allocation of
+        # the same chain key counts as a reuse-distance-over-capacity
+        # event (the eviction-rate × reuse-distance detector's input).
+        self._recent_evicted[victim] = self._clock
+        while len(self._recent_evicted) > 4 * self.capacity:
+            self._recent_evicted.popitem(last=False)
         return entry.idx
 
     def reserve(self, n: int) -> int:
@@ -303,11 +447,167 @@ class PrefixIndex:
                 if idx is None:
                     break  # pool exhausted by this very call: stop
             cost = costs[j] if costs is not None else 0.0
+            if key in self._recent_evicted:
+                # The key was evicted recently and is being recomputed:
+                # its reuse distance exceeds the pool — thrash, by
+                # definition.  The engine's detector windows this counter.
+                del self._recent_evicted[key]
+                self.thrash_reallocs += 1
+            if key in self._spill:
+                # Fresh insert under a spilled key: the new bytes (a
+                # re-prefill after a failed page-in, or a conversation-
+                # cache overwrite) supersede the shadow — splicing the
+                # stale shadow later would break byte identity.
+                self._spill.pop(key)
+                self.spill_drops += 1
             self._lru[key] = _Entry(idx, cost, conv,
                                     prio=self._clock + cost)
             newly.add(key)
             out.append(idx)
         return out
+
+    # ------------------------------------------------------------------
+    # Host-RAM spill tier (ISSUE 16).  All methods below are event-loop-
+    # thread bookkeeping per the _release_pages contract: the engine plans
+    # here, copies bytes on its executor, and commits back here.
+
+    def spill_plan(self, n: int,
+                   exclude: frozenset = frozenset()) -> List[Tuple[bytes, int]]:
+        """The ``n`` lowest-priority HBM-resident pages with no host
+        shadow yet: [(key, pool idx)] for the engine's async page-out
+        batch.  Deterministic: (prio, LRU position) order, so a fixed
+        operation sequence spills the same pages (two-run identity).
+        ``exclude`` protects pages about to be matched this iteration."""
+        if n <= 0 or self.spill_pages <= 0:
+            return []
+        cands = [
+            (entry.prio, pos, key, entry.idx)
+            for pos, (key, entry) in enumerate(self._lru.items())
+            if key not in self._spill and key not in exclude
+        ]
+        cands.sort()
+        return [(key, idx) for _, _, key, idx in cands[:n]]
+
+    def note_spilled(self, key: bytes, payload: Dict[str, np.ndarray],
+                     checksum: bytes, meta: Dict) -> bool:
+        """Commit one completed page-out (event loop).  Rejected when the
+        page was evicted mid-copy (its bytes may already be recycled) or
+        already shadowed; makes room by dropping the least valuable
+        host-tier page when the tier is full."""
+        entry = self._lru.get(key)
+        if entry is None or key in self._spill:
+            return False
+        self._spill_make_room()
+        self._spill[key] = _SpillPage(payload, checksum, meta,
+                                      entry.cost, entry.conv, entry.prio)
+        self.spill_pageouts += 1
+        return True
+
+    def _spill_make_room(self) -> None:
+        """Cap the host tier at ``spill_pages``: drop shadows of still-
+        HBM-resident pages first (nothing is lost — the pool copy lives
+        on), then the lowest-priority host-only page."""
+        while len(self._spill) >= max(1, self.spill_pages):
+            best_key, best_rank = None, None
+            for pos, (key, page) in enumerate(self._spill.items()):
+                rank = (0 if key in self._lru else 1, page.prio, pos)
+                if best_rank is None or rank < best_rank:
+                    best_key, best_rank = key, rank
+            self._spill.pop(best_key)
+            self.spill_drops += 1
+
+    def spill_extension(self, prompt_ids) -> List[Tuple[int, bytes]]:
+        """Host-tier pages that would EXTEND this prompt's HBM match:
+        [(block_no, key)] of spilled (host-only) chain keys past the
+        resident prefix, skipping keys already resident (match resumes
+        through those once the gap is spliced), stopping at the first key
+        in neither tier.  Capped like :meth:`match` so a tail token
+        remains for prefill."""
+        if not self._spill:
+            return []
+        max_blocks = (len(prompt_ids) - 1) // self.block
+        keys = self._keys_of(prompt_ids)[:max_blocks]
+        i = 0
+        while i < len(keys) and keys[i] in self._lru:
+            i += 1  # HBM-resident prefix: match() already serves it
+        out: List[Tuple[int, bytes]] = []
+        for j in range(i, len(keys)):
+            key = keys[j]
+            if key in self._lru:
+                continue
+            if key in self._spill:
+                out.append((j, key))
+            else:
+                break
+        return out
+
+    def chain_keys(self, prompt_ids) -> List[bytes]:
+        """ALL of the prompt's matchable chain keys (no LRU touch) — the
+        eviction-protection set a page-in slot claim must honor.  The
+        whole chain, not just the contiguous resident prefix: a chain
+        whose block 0 died still holds matchable mid-chain residents
+        that the SAME wave's splice is about to reconnect, and claiming
+        slots by evicting them converts the splice into churn (the r16
+        80-client herd measured 881 splices/turn yielding ~3 matches
+        under prefix-only protection)."""
+        max_blocks = (len(prompt_ids) - 1) // self.block
+        return list(self._keys_of(prompt_ids)[:max_blocks])
+
+    def touch_resident(self, keys) -> None:
+        """MRU-touch the resident members of a page-in wave's protection
+        set.  The wave's match runs later in the SAME iteration, but
+        admission's own reserve/insert evictions run in between — and a
+        chain untouched for a whole conversation turn sits exactly at
+        the LRU tail those evictions harvest.  Touching moves 'about to
+        be matched' ahead of genuinely cold pages in the LRU order;
+        pages the match then fails to use simply age out again."""
+        for key in keys:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+
+    def page_in_alloc(self, keys: List[bytes],
+                      protect: frozenset = frozenset(),
+                      ) -> List[Tuple[bytes, int, "_SpillPage"]]:
+        """Two-phase page-in, phase 1 (event loop): claim one free pool
+        slot per host-tier key — evicting under the policy, never a
+        ``protect`` key — WITHOUT touching the index.  The caller copies
+        bytes on the executor, then finishes every claim with
+        :meth:`commit_page_in` or :meth:`abort_page_in`; until then the
+        claimed slot is invisible to match/allocate (it is simply not in
+        ``_free``), so a racing insert can never alias it."""
+        out: List[Tuple[bytes, int, _SpillPage]] = []
+        prot = set(protect)
+        for key in keys:
+            page = self._spill.get(key)
+            if page is None or key in self._lru:
+                continue
+            if self._free:
+                idx = self._free.pop()
+            else:
+                idx = self._evict_one(prot)
+                if idx is None:
+                    break
+            out.append((key, idx, page))
+        return out
+
+    def commit_page_in(self, key: bytes, idx: int) -> None:
+        """Phase 2 success: the verified bytes are in pool slot ``idx`` —
+        insert the entry (fresh GreedyDual touch) and count the splice.
+        The shadow stays: its bytes still match the pool copy, so a later
+        eviction migrates back to the tier without another copy."""
+        page = self._spill.get(key)
+        cost = page.cost if page is not None else 0.0
+        conv = page.conv if page is not None else False
+        self._lru[key] = _Entry(idx, cost, conv, prio=self._clock + cost)
+        self.spill_pageins += 1
+
+    def abort_page_in(self, key: bytes, idx: int) -> None:
+        """Phase 2 failure (chaos fail/stall, checksum or pin mismatch):
+        return the claimed slot and DROP the host page — correctness falls
+        back to tail re-prefill, never to suspect bytes."""
+        if self._spill.pop(key, None) is not None:
+            self.spill_drops += 1
+        self._free.append(idx)
 
 
 def plan_group_admission(
@@ -661,6 +961,34 @@ def make_batch_copy_ops(block: int, max_blocks: int, rows: int,
         jax.jit(blocks_to_cache, donate_argnums=(0,)),
         jax.jit(cache_to_pool, donate_argnums=(0,)),
     )
+
+
+def make_spill_ops():
+    """The two jitted single-page tier-I/O programs (ISSUE 16).
+
+    ``page_out`` gathers one pool page's leaves (the executor then
+    ``np.asarray``s the result into pinned host RAM); ``page_in`` scatters
+    verified host bytes back into a claimed pool slot.  ``idx`` is a
+    TRACED int32 — python-int indexing would specialize the program per
+    slot and compile ``capacity`` times; ``dynamic_index_in_dim`` /
+    ``dynamic_update_index_in_dim`` keep it to one compile each, ever."""
+
+    def page_out(pool, idx):
+        return {
+            key: jax.lax.dynamic_index_in_dim(arr, idx, axis=1,
+                                              keepdims=False)
+            for key, arr in pool.items()
+        }
+
+    def page_in(pool, idx, page):
+        out = dict(pool)
+        for key, arr in pool.items():
+            out[key] = jax.lax.dynamic_update_index_in_dim(
+                arr, page[key].astype(arr.dtype), idx, axis=1
+            )
+        return out
+
+    return jax.jit(page_out), jax.jit(page_in, donate_argnums=(0,))
 
 
 def pad_rows(

@@ -77,9 +77,15 @@ CREDIT_BATCH = 64 * 1024
 #:   tunnel_reset — the proxy itself is tearing the tunnel down (shutdown
 #:     or full reconnect); unlike peer_lost there is no surviving peer to
 #:     absorb anything — retry against the listener once it returns
+#:   memory — shed by the KV memory degradation contract (ISSUE 16): both
+#:     tiers are exhausted — the HBM page pool is fully reserved AND the
+#:     host spill tier is at capacity.  Backing off (or routing to another
+#:     peer — fabric health carries engine_degraded_reason="memory")
+#:     helps; retrying instantly just thrashes the pool the code exists to
+#:     protect
 ERROR_CODES = frozenset(
     {"timeout", "busy", "draining", "upstream", "tenant_overlimit",
-     "peer_lost", "tunnel_reset"}
+     "peer_lost", "tunnel_reset", "memory"}
 )
 
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE

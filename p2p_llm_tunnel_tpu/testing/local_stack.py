@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                     default=os.environ.get("TUNNEL_PREFIX_CACHE") == "1",
                     help="enable the prefix pool (+ conversation cache) — "
                          "the loadgen --turns experiment's server side")
+    ap.add_argument("--spill-pages", type=int,
+                    default=int(os.environ.get("TUNNEL_SPILL_PAGES", "0")),
+                    help="host-RAM KV spill tier capacity in pages "
+                         "(0 = off) — the loadgen memory-pressure "
+                         "experiment's server side")
+    ap.add_argument("--prefix-pool-blocks", type=int,
+                    default=int(os.environ.get(
+                        "TUNNEL_PREFIX_POOL_BLOCKS", "128")),
+                    help="prefix pool capacity in KV blocks (shrink it to "
+                         "force spill under a herd)")
     return ap
 
 
@@ -107,6 +117,8 @@ async def amain(args) -> None:
         mux=True,
         prefix_cache=args.prefix_cache,
         conv_cache=args.prefix_cache,
+        prefix_pool_blocks=args.prefix_pool_blocks,
+        spill_pages=args.spill_pages,
         watchdog_budget_s=120.0,
     ), tokenizer=tokenizer)
     await engine.start()

@@ -259,3 +259,66 @@ def maybe_chaos(channel: Channel, spec: Optional[str] = None) -> Channel:
     parsed = ChaosSpec.parse(raw)
     log.warning("chaos injection enabled: %s", parsed)
     return ChaosChannel(channel, parsed)
+
+
+SPILL_ENV_VAR = "TUNNEL_SPILL_CHAOS"
+
+
+class SpillChaos:
+    """Seeded fault schedule for the KV spill tier's I/O path (ISSUE 16).
+
+    The message-plane determinism contract, transplanted to tier I/O: one
+    RNG draw per independent fault per I/O operation, ALWAYS consumed in
+    the same order regardless of which faults fire, so two runs that issue
+    the same page-out/page-in sequence under the same spec record the same
+    schedule.  ``faults`` is the two-run oracle, ``(op_index, op, kind)``.
+
+    Reuses the :class:`ChaosSpec` grammar with spill semantics —
+    ``drop=P`` fails the I/O outright (a failed page-out drops the page, a
+    failed page-in falls back to tail re-prefill), ``stall=P:SECS`` sleeps
+    the EXECUTOR thread mid-copy (the event loop keeps serving — exactly
+    the overlap the drain design claims), ``corrupt=P`` flips one payload
+    byte so the page-in checksum must catch it.  Message-plane-only keys
+    (dup/reorder/partition/bw/kill) are ignored here.
+    """
+
+    def __init__(self, spec: ChaosSpec):
+        self.spec = spec
+        self._rng = random.Random(spec.seed)
+        self._ops = 0
+        self.faults: List[Tuple[int, str, str]] = []
+
+    def draw(self, op: str) -> Tuple[Optional[str], float, int]:
+        """Schedule one tier I/O op: returns (fault kind or None,
+        stall seconds, corrupt byte position).  ``op`` labels the record
+        ("pageout"/"pagein"); precedence fail > corrupt > stall mirrors
+        the channel's drop > corrupt > stall."""
+        idx = self._ops
+        self._ops += 1
+        spec = self.spec
+        r_fail = self._rng.random()
+        r_corrupt = self._rng.random()
+        r_stall = self._rng.random()
+        corrupt_pos = self._rng.randrange(1 << 30)
+        if spec.drop and r_fail < spec.drop:
+            self.faults.append((idx, op, "fail"))
+            return "fail", 0.0, corrupt_pos
+        if spec.corrupt and r_corrupt < spec.corrupt:
+            self.faults.append((idx, op, "corrupt"))
+            return "corrupt", 0.0, corrupt_pos
+        if spec.stall_p and r_stall < spec.stall_p:
+            self.faults.append((idx, op, "stall"))
+            return "stall", spec.stall_s, corrupt_pos
+        return None, 0.0, corrupt_pos
+
+
+def maybe_spill_chaos(spec: Optional[str] = None) -> Optional[SpillChaos]:
+    """A :class:`SpillChaos` when ``TUNNEL_SPILL_CHAOS`` (or ``spec``) is
+    set; else None.  Malformed specs refuse loudly, like the message
+    plane's."""
+    raw = os.environ.get(SPILL_ENV_VAR, "") if spec is None else spec
+    if not raw.strip():
+        return None
+    parsed = ChaosSpec.parse(raw)
+    log.warning("spill-tier chaos injection enabled: %s", parsed)
+    return SpillChaos(parsed)

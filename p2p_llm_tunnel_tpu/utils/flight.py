@@ -94,6 +94,19 @@ FLIGHT_SCHEMA: Dict[str, str] = {
         "finished conversations whose KV the end-of-iteration drain saved "
         "into the pool this iteration (ISSUE 14)"
     ),
+    "spill_pages": (
+        "host-tier pages resident at iteration end (ISSUE 16; 0 when the "
+        "spill tier is off)"
+    ),
+    "spill_pageouts": (
+        "pool pages the spill drain committed to the host tier this "
+        "iteration (ISSUE 16)"
+    ),
+    "spill_pageins": (
+        "host-tier pages spliced back into the pool ahead of admission "
+        "this iteration (ISSUE 16; the thrash detector's context — "
+        "page-ins racing pageouts over a small window is the signature)"
+    ),
     "cold_compiles": "mid-serve cold compiles detected during this iteration",
     "streams_detached": (
         "streams parked in the detached-stream registry's grace window "
@@ -112,7 +125,9 @@ FLIGHT_SCHEMA: Dict[str, str] = {
 #: a runtime lockstep guard backs the static rule.
 POSTMORTEM_SCHEMA: Dict[str, str] = {
     "schema_version": "bundle schema version (int; bump on shape changes)",
-    "trigger": "what fired the capture: watchdog|slo|drain|crash|manual",
+    "trigger": (
+        "what fired the capture: watchdog|slo|drain|crash|manual|memory"
+    ),
     "attribution": (
         "where the engine was when the trigger fired — the flight "
         "recorder's current loop phase for watchdog/crash, the objective "
@@ -135,7 +150,8 @@ POSTMORTEM_SCHEMA: Dict[str, str] = {
 POSTMORTEM_SCHEMA_VERSION = 1
 
 #: Legal capture triggers.
-POSTMORTEM_TRIGGERS = ("watchdog", "slo", "drain", "crash", "manual")
+POSTMORTEM_TRIGGERS = ("watchdog", "slo", "drain", "crash", "manual",
+                       "memory")
 
 #: Field NAMES excluded from the bundle-determinism contract: wall-clock
 #: instants/durations and process-scoped ids.  Together with the

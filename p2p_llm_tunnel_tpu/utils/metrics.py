@@ -223,6 +223,60 @@ METRICS_CATALOG: Dict[str, str] = {
         "re-prefilling a resent history (counter; the multi-turn "
         "re-prefill saving, turn-2+ prefills tail-only)"
     ),
+    # -- KV spill tier + memory degradation contract (ISSUE 16) -----------
+    "engine_spill_pages": (
+        "KV pages resident in the host-RAM spill tier — shadows of "
+        "HBM-resident pages plus host-only migrated pages (gauge; 0 when "
+        "the tier is off)"
+    ),
+    "engine_spill_bytes": (
+        "host RAM held by spill-tier pages (gauge; pages x per-page KV "
+        "bytes, reflecting the kv_quant mode like "
+        "engine_prefix_pool_kv_bytes)"
+    ),
+    "engine_spill_inflight": (
+        "tier I/O operations planned but not yet committed — page-outs "
+        "copying on the executor plus page-in slot claims awaiting "
+        "verification (gauge; nonzero after drain is an I/O leak — the "
+        "loadgen leak-gate invariant)"
+    ),
+    "engine_spill_pageouts_total": (
+        "cold pool pages copied out to the host tier (counter; cost-ranked "
+        "by GreedyDual priority, batched off the serving path)"
+    ),
+    "engine_spill_pageins_total": (
+        "host-tier pages spliced back into the pool ahead of an admission "
+        "whose prompt chain continues into the tier (counter; the tier's "
+        "hit signal — rate against pageouts for tier efficiency)"
+    ),
+    "engine_spill_pageout_failures_total": (
+        "page-outs that failed mid-copy (counter; chaos fail/stall paths "
+        "included — the page simply stays HBM-only, nothing is lost)"
+    ),
+    "engine_spill_pagein_failures_total": (
+        "page-ins dropped by the integrity checksum, compatibility pin "
+        "check, or I/O failure (counter; each one fell back to tail "
+        "re-prefill — correctness never depends on the tier)"
+    ),
+    "engine_spill_pageout_ms": (
+        "per-batch page-out migration latency, device gather + host copy "
+        "(histogram, ms)"
+    ),
+    "engine_spill_pagein_ms": (
+        "per-batch page-in migration latency, verify + device scatter "
+        "(histogram, ms)"
+    ),
+    "engine_thrash_trips_total": (
+        "memory-thrash detector trips: eviction-and-realloc rate over the "
+        "detector window crossed the threshold, flipping engine_degraded "
+        "with engine_degraded_reason=memory and capturing a postmortem "
+        "bundle (counter)"
+    ),
+    "engine_memory_shed_total": (
+        "admissions shed with the typed `memory` verdict: HBM pool fully "
+        "reserved AND spill tier at capacity (counter; the 429 + "
+        "Retry-After degradation contract — never thrash)"
+    ),
     # -- fleet observability plane (ISSUE 9) ------------------------------
     # The fleet_* names live in the PROXY process: aggregates over its
     # PeerSet, refreshed by /metrics?fleet=1 scrapes and the PeerSet's

@@ -332,6 +332,13 @@ POLL_KEYS = (
     "engine_prefix_pool_pages_reserved",
     "engine_conv_hit_tokens_total",
     "engine_conv_hits_total",
+    # Host-RAM spill tier (ISSUE 16): residency + tier-I/O ledger over
+    # the run, so a capacity-cliff timeline shows WHEN the pool started
+    # migrating pages and whether page-ins kept up with returning turns.
+    "engine_spill_pages",
+    "engine_spill_inflight",
+    "engine_spill_pageouts_total",
+    "engine_spill_pageins_total",
 )
 POLL_QUANTILES = {
     "engine_ttft_ms": ("0.5", "0.99"),
@@ -546,6 +553,12 @@ async def run_load(args) -> dict:
                 "conv_hits": _delta("engine_conv_hits_total"),
                 "pool_pages_used": post_s.get(
                     "engine_prefix_pool_blocks_used"),
+                # Tier traffic attributed to this turn (ISSUE 16): how
+                # many pages the drain migrated out and how many a
+                # returning client's history spliced back in.
+                "spill_pageouts": _delta("engine_spill_pageouts_total"),
+                "spill_pageins": _delta("engine_spill_pageins_total"),
+                "spill_resident": post_s.get("engine_spill_pages"),
                 # Inline fallback when no poller runs: the live quantile
                 # at turn end (sliding reservoir, so dominated by this
                 # turn's own prefills in lockstep mode).
@@ -615,6 +628,7 @@ async def run_load(args) -> dict:
             resumed = int(resumes1 - resumes0)
     streams_hz = (healthz or {}).get("streams") or {}
     pool_hz = (healthz or {}).get("prefix_pool") or {}
+    spill_hz = pool_hz.get("spill") or {}
     out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
@@ -639,6 +653,14 @@ async def run_load(args) -> dict:
             # pressure forever (the deadline/cancel/owner-death paths the
             # engine's generate() finally releases).
             "pool_pages_reserved": pool_hz.get("pages_reserved"),
+            # ISSUE 16 leak gate: the spill tier's in-flight I/O ledger
+            # must drain to zero — a stuck counter is a page-out/page-in
+            # whose executor copy never committed or aborted.  Resident
+            # shadow pages/bytes are recorded for the report but NOT
+            # gated: the tier is a cache, residency persists by design.
+            "pool_spill_inflight": spill_hz.get("inflight"),
+            "pool_spill_pages": spill_hz.get("pages"),
+            "pool_spill_bytes": spill_hz.get("bytes"),
             "tenants": healthz.get("tenants"),
             "retry_after_s": healthz.get("retry_after_s"),
         },
@@ -669,6 +691,13 @@ def spawn_stack(args) -> Tuple[subprocess.Popen, int]:
     if args.turns > 1:
         # The conversation-cache experiment needs the pool server-side.
         cmd += ["--prefix-cache"]
+    if args.stack_pool_blocks:
+        cmd += ["--prefix-pool-blocks", str(args.stack_pool_blocks)]
+    if args.stack_spill_pages:
+        # Memory-pressure experiment (ISSUE 16): host-RAM spill tier on
+        # the server side, sized from the CLI so the capacity-cliff run
+        # can shrink the pool and still keep returning turns warm.
+        cmd += ["--spill-pages", str(args.stack_spill_pages)]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -747,6 +776,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stack-max-waiting", type=int, default=600)
     ap.add_argument("--stack-tenant-weights", default="")
     ap.add_argument("--stack-no-fair", action="store_true")
+    ap.add_argument("--stack-pool-blocks", type=int, default=0,
+                    help="override the spawned stack's prefix pool "
+                         "capacity in KV blocks (0 = stack default)")
+    ap.add_argument("--stack-spill-pages", type=int, default=0,
+                    help="host-RAM spill tier pages on the spawned stack "
+                         "(0 = off)")
     args = ap.parse_args(argv)
     args.tenants = [parse_tenant_spec(s) for s in (args.tenant or
                                                    ["herd:500"])]
@@ -778,7 +813,7 @@ def main(argv=None) -> int:
             hz.get(k) or 0
             for k in ("inflight_requests", "queue_depth", "slot_occupancy",
                       "streams_detached", "replay_buffer_bytes",
-                      "pool_pages_reserved")
+                      "pool_pages_reserved", "pool_spill_inflight")
         )
         if leaked:
             detail = ("unreachable" if hz is None

@@ -41,6 +41,9 @@ PINNED_ROW_KEYS = (
     # conversation-cache hit rate (fraction of admissions matching
     # finished-stream pages).
     "pages_used", "pages_free", "conversation_hit_rate",
+    # ISSUE 16 add-only extension: host-RAM spill-tier residency, page-in
+    # success rate (rest fell back to tail re-prefill), splice latency.
+    "spill_pages", "spill_tier_hit_rate", "spill_pagein_p50_ms",
     # ISSUE 12 add-only extension: the cold-start compile breakdown
     # (warmup total / program count / slowest single program).
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",

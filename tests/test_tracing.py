@@ -342,9 +342,17 @@ def test_healthz_trace_export_and_pool_accounting():
                     # ISSUE 14: reservation/eviction accounting + the
                     # conversation cache's reuse counters.
                     "pages_reserved", "evictions_total", "conversation",
+                    # ISSUE 16: host-RAM spill tier + the memory
+                    # degradation contract's live reason.
+                    "spill", "degraded_reason",
                 }
                 assert set(payload["prefix_pool"]["conversation"]) == {
                     "saved_pages_total", "hits_total", "hit_tokens_total",
+                }
+                assert set(payload["prefix_pool"]["spill"]) == {
+                    "pages", "bytes", "inflight", "pageouts_total",
+                    "pageins_total", "pagein_failures_total",
+                    "memory_sheds_total", "thrash_trips_total",
                 }
                 # The composition-fence registry rides /healthz too: a
                 # list (empty unless an engine auto-disabled something).

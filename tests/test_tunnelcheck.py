@@ -2625,6 +2625,142 @@ def test_tc17_engine_self_run_has_only_the_echo_waiver():
     assert any("prefill_echo" in v.message for v in waived)
 
 
+# ---------------------------------------------------------------------------
+# TC18 — KV page bytes must pass the tier-boundary pin check before splice
+# ---------------------------------------------------------------------------
+
+SPILL_FIXTURE = "p2p_llm_tunnel_tpu/engine/fixture_spill.py"
+
+
+def test_tc18_unchecked_page_in_splice_flags(tmp_path):
+    """The incident shape: a spill-tier page body spliced straight into
+    the pool — int4 bytes landing in an int8 pool decode garbage long
+    after the splice."""
+    active, _ = check(
+        tmp_path,
+        """
+        def splice(self, items):
+            for key, idx, page in items:
+                payload = page.payload
+                self._pool = self._page_in_op(self._pool, idx, payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert rules_of(active) == ["TC18"]
+    assert "verify_page_pin" in active[0].message
+
+
+def test_tc18_pin_check_reassign_launders(tmp_path):
+    """The sanctioned idiom: the checked value REPLACES the unchecked
+    binding, so the splice can only see the laundered name."""
+    active, _ = check(
+        tmp_path,
+        """
+        def splice(self, items):
+            for key, idx, page in items:
+                payload = page.payload
+                payload = verify_page_pin(payload, page.meta, self._meta)
+                self._pool = self._page_in_op(self._pool, idx, payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert active == []
+
+
+def test_tc18_is_flow_sensitive_not_call_anywhere(tmp_path):
+    """A bare verify_page_pin CALL whose result is discarded does not
+    launder: the unchecked binding still reaches the splice.  (TC14's
+    flow-insensitive lattice cannot make this distinction — the rule's
+    reason to exist on the CFG-ordered walk.)"""
+    active, _ = check(
+        tmp_path,
+        """
+        def splice(self, items):
+            for key, idx, page in items:
+                payload = page.payload
+                verify_page_pin(payload, page.meta, self._meta)
+                self._pool = self._page_in_op(self._pool, idx, payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert rules_of(active) == ["TC18"]
+
+
+def test_tc18_failed_check_path_excluded_from_join(tmp_path):
+    """The engine's page-in loop shape: the except handler drops the page
+    to the re-prefill fallback via ``continue``, so its tainted state
+    never merges past the try — the splice after it is clean."""
+    active, _ = check(
+        tmp_path,
+        """
+        def splice(self, items):
+            for key, idx, page in items:
+                payload = page.payload
+                if self._chaos:
+                    payload = dict(page.payload)
+                try:
+                    payload = verify_page_pin(payload, page.meta, self._m)
+                except PagePinError:
+                    log.warning("dropped %s", key)
+                    continue
+                self._pool = self._page_in_op(self._pool, idx, payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert active == []
+
+
+def test_tc18_payload_param_seeds_and_update_sink(tmp_path):
+    """A raw page body crossing a function boundary stays tainted, and
+    the jax scatter primitive + .at[].set buffer writes are sinks."""
+    active, _ = check(
+        tmp_path,
+        """
+        import jax
+
+        def splice(pool, idx, payload):
+            pool = jax.lax.dynamic_update_index_in_dim(
+                pool, payload, idx, axis=1
+            )
+            return pool.at[idx].set(payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert rules_of(active) == ["TC18", "TC18"]
+    assert any("dynamic_update_index_in_dim" in v.message for v in active)
+    assert any(".at[...].set" in v.message for v in active)
+
+
+def test_tc18_waiver(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        def warm(self):
+            page = self.frame.payload
+            self._pool = self._page_in_op(self._pool, 0, page)  # tunnelcheck: disable=TC18  loop-local round-trip, never left this process
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC18"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC18"]
+
+
+def test_tc18_engine_and_prefix_cache_self_run_clean():
+    """The real splice paths are TC18-clean WITHOUT waivers: every
+    page-in routes through verify_page_pin before touching the pool."""
+    eng = REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "engine.py"
+    pfx = REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "prefix_cache.py"
+    active, waived = run_paths([eng, pfx], rules=["TC18"])
+    assert active == []
+    assert rules_of(waived) == []
+
+
 def test_sarif_2_1_0_shape(tmp_path):
     """Pins the SARIF 2.1.0 shape downstream consumers ingest: version,
     $schema, the rules table (ruleIndex points into it), physical
@@ -2684,14 +2820,14 @@ def test_sarif_includes_tc00(tmp_path):
 
 def test_list_rules_pinned_against_code_and_readme(capsys):
     """Rule-id drift (docs vs code) fails fast: --list-rules must show
-    exactly TC00..TC17, every runnable rule must have a summary, and the
+    exactly TC00..TC18, every runnable rule must have a summary, and the
     README rule table must carry a row for every rule."""
     from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
 
     assert tunnelcheck_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     listed = [line.split()[0] for line in out.strip().splitlines()]
-    assert listed == [f"TC{i:02d}" for i in range(18)]
+    assert listed == [f"TC{i:02d}" for i in range(19)]
     assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
 
     readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")

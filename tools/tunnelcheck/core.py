@@ -402,6 +402,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_queues,
         rules_retry,
         rules_taint,
+        rules_tierpin,
         rules_tracing,
         rules_warmup,
     )
@@ -424,6 +425,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC15": rules_lifecycle.check_tc15,
         "TC16": rules_flight.check_tc16,
         "TC17": rules_warmup.check_tc17,
+        "TC18": rules_tierpin.check_tc18,
     }
 
 
@@ -446,6 +448,7 @@ RULE_SUMMARIES = {
     "TC15": "span/slot/in-flight registration not released on every exit path (incl. generator aclose)",
     "TC16": "flight/postmortem field not in the flight.py registries / ops path matched outside http11.ops_route",
     "TC17": "dispatch-site program kind unreachable from the warmup/AOT plan generators (mid-serve cold-compile hole)",
+    "TC18": "KV page bytes spliced into a device pool without the registered tier-boundary pin check (verify_page_pin)",
 }
 
 

@@ -1,0 +1,313 @@
+"""TC18: KV pages crossing the tier/tunnel boundary must pass the
+registered pin check before being spliced into a device pool.
+
+The ISSUE 16 incident class this rule makes permanent: a KV page body
+that left the device pool — into the host-RAM spill tier, a snapshot, or
+(eventually) a peer's pool over the tunnel — re-enters as *bytes*.  The
+pool's layout contract (kv quant mode, quant group size, dtype, head
+geometry) travels as metadata NEXT TO those bytes, and nothing about a
+``dynamic_update_index_in_dim`` splice checks it: int4-packed bytes write
+into an int8 pool without complaint and decode garbage three requests
+later, long after the splice that caused it.  PR 2/3 fixed the same hole
+for pool *snapshots* by pinning quant mode + group size in the snapshot
+sidecar; the spill tier re-opens the boundary on the hot path, so the
+check moves into code — :func:`p2p_llm_tunnel_tpu.engine.prefix_cache.
+verify_page_pin` — and this rule makes "every splice is pin-checked"
+statically enforceable.
+
+Unlike TC14's flow-INsensitive lattice (where a name tainted anywhere is
+tainted everywhere), this rule is **flow-sensitive** on the same
+substrate primitives (:func:`expr_tainted`, the sanitizer-call laundering
+semantics): a forward walk over each function body where
+
+- loading a ``.payload`` attribute (the spill tier's ``_SpillPage`` body,
+  a tunnel frame body) or binding a parameter named ``payload`` marks the
+  name tainted **from that point on**;
+- re-assigning the name from a registered pin check —
+  ``payload = verify_page_pin(payload, meta, want)`` — *kills* the taint
+  on the fall-through path (the sanctioned idiom: the checked value
+  REPLACES the unchecked one, so a later splice can only see the
+  laundered binding);
+- an except-handler / early-``continue`` path that skips the check never
+  merges its tainted state past a ``raise``/``return``/``continue``
+  (which is exactly how the engine's page-in loop drops a failing page
+  to the re-prefill fallback without ever reaching the splice).
+
+**Sinks** are the device-pool splice surfaces: calls named
+``page_in`` / ``_page_in_op`` (the jitted scatter op and its engine
+handle), ``jax.lax.dynamic_update_index_in_dim``, and ``.at[...].set``
+buffer writes.  Feeding any of them a tainted page body flags; route the
+body through ``verify_page_pin`` first (or register a new boundary check
+here), or waive naming why the bytes cannot have crossed a tier boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+from tools.tunnelcheck.dataflow import (
+    call_name,
+    expr_tainted,
+    iter_functions,
+    param_names,
+)
+
+SCOPE_PART = "p2p_llm_tunnel_tpu/"
+
+#: Parameter name seeded as tainted: a raw page body handed across a
+#: function boundary.  (``page`` is deliberately NOT seeded — the jitted
+#: splice primitive itself takes ``page`` and must stay definable.)
+TAINTED_PARAMS = frozenset({"payload"})
+
+#: Registered tier-boundary checks: their RESULT is a verified page body.
+SANITIZERS = frozenset({"verify_page_pin"})
+
+#: Device-pool splice entry points: a tainted argument here is unchecked
+#: bytes landing in pool memory.
+SPLICE_CALLS = frozenset({"page_in", "_page_in_op",
+                          "dynamic_update_index_in_dim"})
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    return SCOPE_PART in sf.path.as_posix()
+
+
+def _is_source(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "payload"
+        and isinstance(expr.ctx, ast.Load)
+    )
+
+
+def _walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` minus nested def/lambda bodies (they rebind params and
+    get their own :func:`iter_functions` pass)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _at_set_buffer_write(node: ast.Call) -> bool:
+    """``arr.at[...].set(x)`` / ``.add(x)`` — the functional buffer write."""
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("set", "add")
+        and isinstance(node.func.value, ast.Subscript)
+        and isinstance(node.func.value.value, ast.Attribute)
+        and node.func.value.value.attr == "at"
+    )
+
+
+class _Flow:
+    """Flow-sensitive forward taint walk over one function body.
+
+    State is the set of tainted local names at the current program point;
+    ``None`` stands for "all paths left this body" (return/raise/break/
+    continue), which is how a skip-the-check error path is excluded from
+    the join after a ``try``.  Joins are set unions; loops run to a small
+    fixpoint (the lattice is finite and monotone, 4 passes bound it far
+    past any real nesting)."""
+
+    def __init__(self, on_sink) -> None:
+        self.on_sink = on_sink
+        self._breaks: List[Set[str]] = []
+        self._continues: List[Set[str]] = []
+
+    # -- sinks ----------------------------------------------------------
+
+    def _dirty(self, expr: Optional[ast.AST], state: Set[str]) -> bool:
+        return expr is not None and expr_tainted(
+            expr, state, _is_source, SANITIZERS
+        )
+
+    def scan(self, expr: Optional[ast.AST], state: Set[str]) -> None:
+        if expr is None:
+            return
+        for sub in _walk_same_scope(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            args = list(sub.args) + [kw.value for kw in sub.keywords]
+            name = call_name(sub)
+            if name in SPLICE_CALLS and any(
+                self._dirty(a, state) for a in args
+            ):
+                self.on_sink(sub, f"`{name}`")
+            elif _at_set_buffer_write(sub) and any(
+                self._dirty(a, state) for a in args
+            ):
+                self.on_sink(sub, "an `.at[...].set` buffer write")
+
+    # -- transfer -------------------------------------------------------
+
+    def run_body(self, body, state: Optional[Set[str]]) -> Optional[Set[str]]:
+        cur = state
+        for stmt in body:
+            if cur is None:
+                break
+            cur = self.stmt(stmt, cur)
+        return cur
+
+    @staticmethod
+    def _join(a: Optional[Set[str]], b: Optional[Set[str]]) -> Optional[Set[str]]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a | b
+
+    @staticmethod
+    def _target_names(target: ast.AST) -> Iterator[str]:
+        if isinstance(target, ast.Name):
+            yield target.id
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                yield from _Flow._target_names(e)
+
+    def stmt(self, node: ast.stmt, cur: Set[str]) -> Optional[Set[str]]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return cur
+        if isinstance(node, (ast.Return, ast.Raise)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.scan(child, cur)
+            return None
+        if isinstance(node, ast.Break):
+            if self._breaks:
+                self._breaks[-1] |= cur
+            return None
+        if isinstance(node, ast.Continue):
+            if self._continues:
+                self._continues[-1] |= cur
+            return None
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(node, "value", None)
+            if value is None:
+                return cur
+            self.scan(value, cur)
+            tainted = self._dirty(value, cur)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            out = set(cur)
+            for t in targets:
+                names = set(self._target_names(t))
+                if tainted:
+                    out |= names
+                elif not isinstance(node, ast.AugAssign):
+                    # The kill: a clean (e.g. sanitizer-call) re-assign
+                    # launders the name on this path — the flow-sensitive
+                    # step TC14's everywhere-tainted lattice cannot take.
+                    out -= names
+                if (tainted and isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)):
+                    # Storing tainted bytes INTO a container taints it.
+                    out.add(t.value.id)
+            return out
+        if isinstance(node, ast.If):
+            self.scan(node.test, cur)
+            a = self.run_body(node.body, set(cur))
+            b = self.run_body(node.orelse, set(cur))
+            return self._join(a, b)
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            head = set(cur)
+            self._breaks.append(set())
+            self._continues.append(set())
+            for _ in range(4):
+                entry = set(head)
+                if isinstance(node, ast.While):
+                    self.scan(node.test, entry)
+                else:
+                    self.scan(node.iter, entry)
+                    if self._dirty(node.iter, entry):
+                        entry |= set(self._target_names(node.target))
+                out = self.run_body(node.body, entry)
+                new_head = set(head) | self._continues[-1]
+                if out is not None:
+                    new_head |= out
+                if new_head == head:
+                    break
+                head = new_head
+            self._continues.pop()
+            after = head | self._breaks.pop()
+            if node.orelse:
+                o = self.run_body(node.orelse, set(after))
+                after = o if o is not None else after
+            return after
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            st = set(cur)
+            for item in node.items:
+                self.scan(item.context_expr, st)
+                if item.optional_vars is not None and self._dirty(
+                    item.context_expr, st
+                ):
+                    st |= set(self._target_names(item.optional_vars))
+            return self.run_body(node.body, st)
+        if isinstance(node, ast.Try):
+            body_out = self.run_body(node.body, set(cur))
+            # Any statement in the body may raise: handlers see the state
+            # at entry joined with the body's fall-through state.
+            h_in = set(cur) | (body_out or set())
+            outs: List[Set[str]] = []
+            if body_out is not None:
+                else_out = (self.run_body(node.orelse, set(body_out))
+                            if node.orelse else body_out)
+                if else_out is not None:
+                    outs.append(else_out)
+            for handler in node.handlers:
+                ho = self.run_body(handler.body, set(h_in))
+                if ho is not None:
+                    outs.append(ho)
+            joined: Optional[Set[str]] = None
+            for o in outs:
+                joined = self._join(joined, o)
+            if node.finalbody:
+                fin_out = self.run_body(
+                    node.finalbody, set(h_in) | (joined or set())
+                )
+                if joined is not None and fin_out is None:
+                    joined = None
+            return joined
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.scan(child, cur)
+        return cur
+
+
+def check_tc18(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    if not _in_scope(sf):
+        return iter(())
+    out: List[Violation] = []
+    reported: Set = set()
+
+    def report(node: ast.AST, sink: str) -> None:
+        key = (node.lineno, sink)
+        if key in reported:
+            return
+        reported.add(key)
+        out.append(Violation(
+            "TC18",
+            sf.path,
+            node.lineno,
+            f"KV page bytes reach a device-pool splice ({sink}) without "
+            "passing the registered tier-boundary pin check — the "
+            "quant/group-size pinning contract (PR 2/3 snapshots, ISSUE "
+            "16 spill tier): re-assign through verify_page_pin "
+            "(`payload = verify_page_pin(payload, meta, want)`) before "
+            "the splice (or register the new boundary check in "
+            "rules_tierpin.SANITIZERS), or waive naming why these bytes "
+            "never crossed a tier boundary",
+            end_line=getattr(node, "end_lineno", None),
+        ))
+
+    for fn, _cls in iter_functions(sf.tree):
+        seed = param_names(fn) & TAINTED_PARAMS
+        _Flow(report).run_body(fn.body, set(seed))
+    return iter(out)
