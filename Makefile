@@ -65,6 +65,11 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	@# MULTIPLEXED serving loop — drain/deadline/429 semantics must not
 	@# depend on the engine's prefill/decode rhythm.
 	CHAOS_TEST_SEED=5 CHAOS_MUX=1 python -m pytest tests/test_chaos.py tests/test_deadlines.py -q
+	@# ISSUE 17 matrix row: a spec-on greedy herd (fused K-token verify
+	@# bursts) through the same seeded drop/stall schedule — decoded
+	@# streams must be byte-identical across two runs AND match the
+	@# spec-off herd; chaos may never change a decoded byte.
+	CHAOS_TEST_SEED=5 CHAOS_SPEC=1 python -m pytest tests/test_chaos.py -k spec_herd -q
 	@# ISSUE 6 matrix row: request tracing under the same seeded faults —
 	@# two runs must yield the SAME span topology per trace (tracing is
 	@# part of the determinism contract, not an exception to it).

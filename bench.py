@@ -91,6 +91,7 @@ RESULT_ROW_KEYS = (
     "kv_quant", "flash_decode", "flash_sgrid", "fused_decode_layer",
     "ragged_prefill",
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
+    "spec_k", "spec_accept_rate",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
     "pages_used", "pages_free", "conversation_hit_rate",
@@ -225,6 +226,10 @@ async def _run_attempt(model: str) -> dict:
     # long-context sweep configs turn it on.
     prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0"))
     spec_ngram = int(os.environ.get("BENCH_SPEC_NGRAM", "0"))
+    # Fused K-token verify burst width (ISSUE 17); BENCH_SPEC_K_MAX > K
+    # additionally enables the adaptive power-of-two K ladder.
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    spec_k_max = int(os.environ.get("BENCH_SPEC_K_MAX", "0"))
     # Iteration-level prefill/decode multiplexing + prefix-grouped
     # admission (ISSUE 5) — on by default here AND in the serve CLI
     # (TUNNEL_MUX), so the benched config is the deployed default; the
@@ -301,6 +306,7 @@ async def _run_attempt(model: str) -> dict:
             flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
             prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
+            spec_k=spec_k, spec_k_max=spec_k_max,
             ragged_prefill=ragged_prefill,
             mux=mux, mux_budget_tokens=mux_budget,
             conv_cache=conv_cache and prefix_cache,
@@ -542,6 +548,15 @@ async def _run_attempt(model: str) -> dict:
         # claims the requested value would misattribute the number.
         "prefix_cache": engine._prefix is not None,
         "spec_ngram": engine.ecfg.spec_ngram,
+        # ISSUE 17: the verify burst width and the measured acceptance
+        # rate (accepted/proposed over the whole measurement window) —
+        # the two numbers that make a spec-on row's tok/s interpretable.
+        "spec_k": engine.ecfg.spec_k,
+        "spec_accept_rate": round(
+            global_metrics.counter("engine_spec_accepted_tokens_total")
+            / max(1.0, global_metrics.counter(
+                "engine_spec_proposed_tokens_total")), 3
+        ),
         # EFFECTIVE mux knobs (the engine may disable/default them) plus
         # the herd-shape knob, so every mux row is self-describing.
         "mux": engine.ecfg.mux,

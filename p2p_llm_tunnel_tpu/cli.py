@@ -220,10 +220,10 @@ def build_parser() -> argparse.ArgumentParser:
                        default=_env("TUNNEL_KV_QUANT", "none"),
                        help="KV-cache quantization (int8 halves, int4 "
                             "quarters the long-context KV read term; int4 "
-                            "composes with the prefix cache and chunked "
-                            "prefill via page-aligned pool pages — only "
-                            "spec decode stays disabled, see /healthz "
-                            "config.fences)")
+                            "composes with the prefix cache, chunked "
+                            "prefill AND spec decode — byte-aligned pool "
+                            "pages + fused verify bursts leave /healthz "
+                            "config.fences empty)")
     serve.add_argument("--prefill-act-quant",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_PREFILL_ACT_QUANT", "") == "1",
@@ -326,6 +326,12 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--spec-k", type=int,
                        default=int(_env("TUNNEL_SPEC_K", "4")),
                        help="speculative proposal length per step")
+    serve.add_argument("--spec-k-max", type=int,
+                       default=int(_env("TUNNEL_SPEC_K_MAX", "0")),
+                       help="adaptive verify-burst cap: when > --spec-k, "
+                            "each dispatch picks K from a warmed "
+                            "power-of-two ladder up to this cap, steered "
+                            "by the per-slot acceptance EMA (0 = fixed K)")
     serve.add_argument("--prefix-cache-dir",
                        default=_env("TUNNEL_PREFIX_CACHE_DIR"),
                        help="persist the prefix-cache block pool here: warm "
@@ -660,6 +666,7 @@ async def _engine_backend(args):
                     prefix_evict=args.prefix_evict,
                     spec_ngram=args.spec_ngram,
                     spec_k=args.spec_k,
+                    spec_k_max=args.spec_k_max,
                     prefill_chunk=args.prefill_chunk,
                     ragged_prefill=args.ragged_prefill,
                     mux=args.mux,

@@ -659,6 +659,25 @@ async def _send_healthz(
         "prefix_dedup_hits": int(
             global_metrics.counter("engine_prefix_dedup_hits_total")
         ),
+        # ISSUE 17 observability: the fused speculative-decode ledger —
+        # lifetime proposed/accepted verify tokens, the windowed (last-64
+        # bursts) acceptance rate the adaptive-K controller steers on, and
+        # the draft-history registry size (nonzero at rest is a leak;
+        # loadgen's post-run gate asserts it).
+        "spec": {
+            "proposed_total": int(
+                global_metrics.counter("engine_spec_proposed_tokens_total")
+            ),
+            "accepted_total": int(
+                global_metrics.counter("engine_spec_accepted_tokens_total")
+            ),
+            "accept_rate": round(
+                global_metrics.gauge("engine_spec_accept_rate"), 3
+            ),
+            "hist_entries": int(
+                global_metrics.gauge("engine_spec_hist_entries")
+            ),
+        },
         # ISSUE 6 observability: tail percentiles the 1k-client ingress
         # item's SLO reporting needs (p99/p999 next to the p50 split),
         # and prefix-pool memory accounting (first slice of the

@@ -158,9 +158,10 @@ class EngineConfig:
     # inside the Pallas kernels.  Since ISSUE 14 the prefix cache and
     # chunked prefill COMPOSE with int4: every pool page and chunk start
     # is forced to an even (two-tokens-per-byte) boundary, so packed
-    # writes cover whole bytes.  The one remaining int4 fence is
-    # spec_ngram (spec-verify writes at arbitrary, byte-misaligned
-    # positions) — recorded in ``config_fences`` and /healthz.
+    # writes cover whole bytes.  Since ISSUE 17 spec_ngram composes too —
+    # verify bursts splice covering bytes (quant.splice_packed_rows /
+    # the fused spec kernel's resident-byte append), so the
+    # ``config_fences`` registry is EMPTY.
     kv_quant: str = "none"
     # Use the Pallas decode-attention kernel on TPU-tileable shapes
     # (models/config.py flash_decode).  Off by default pending on-hardware
@@ -230,6 +231,14 @@ class EngineConfig:
     # back to plain behavior automatically.  Off by default (opt-in).
     spec_ngram: int = 0
     spec_k: int = 4
+    # Adaptive verify width (ISSUE 17): when > spec_k, the per-iteration
+    # burst width K is chosen per dispatch from a windowed per-slot
+    # acceptance EMA — slots that keep accepting grow toward spec_k_max,
+    # slots that keep rejecting shrink toward 1, bounding wasted verify
+    # FLOPs.  K is bucketed to powers of two (plus spec_k_max itself) so
+    # warmup_plan() pre-compiles every reachable program.  0 disables
+    # (fixed K = spec_k, the pre-ISSUE-17 behavior).
+    spec_k_max: int = 0
     # Chunked prefill (vLLM-style prefill/decode interleaving): prompts
     # whose (post-prefix-match) tail exceeds this many tokens advance one
     # fixed-size segment per engine-loop iteration instead of prefilling in
@@ -518,26 +527,19 @@ class InferenceEngine:
         self._thrash_window: Deque[Tuple[int, int]] = deque(maxlen=64)
         self._thrash_last: Tuple[int, int] = (0, 0)
         self._spill_inflight = 0
-        if self.ecfg.kv_quant == "int4":
-            # Block-paged alignment (ISSUE 14): chunk-prefill writes are
-            # legal on the packed sequence axis exactly when every write
-            # start and padded width is even (whole bytes — two tokens per
-            # byte).  Pool pages (min_prefill_bucket) and chunk segments
-            # (prefill_chunk) are forced to even sizes below, which makes
-            # every chunk start a page/segment multiple and hence even.
-            # Spec-verify remains the one fenced consumer: it writes
-            # proposal KV at arbitrary token positions.
-            if self.ecfg.spec_ngram:
-                self._fence(
-                    "spec_ngram", 0,
-                    "spec-verify writes proposal KV at arbitrary "
-                    "(byte-misaligned) positions in the packed int4 "
-                    "sequence axis",
-                )
-            # (The page-alignment pass — chunk rounding + pool-page
-            # evenness fences — runs AFTER the mux default below has
-            # picked the effective prefill_chunk, so a defaulted odd
-            # width cannot dodge it.)
+        # Block-paged alignment (ISSUE 14): chunk-prefill writes are
+        # legal on the packed sequence axis exactly when every write
+        # start and padded width is even (whole bytes — two tokens per
+        # byte).  Pool pages (min_prefill_bucket) and chunk segments
+        # (prefill_chunk) are forced to even sizes below, which makes
+        # every chunk start a page/segment multiple and hence even.
+        # Spec-verify — the one consumer with arbitrary-parity starts —
+        # splices covering bytes instead (ISSUE 17), so the
+        # config_fences registry carries NO kv_quant entry anymore.
+        # (The page-alignment pass — chunk rounding + pool-page
+        # evenness fences — runs AFTER the mux default below has
+        # picked the effective prefill_chunk, so a defaulted odd
+        # width cannot dodge it.)
         self.kv_cache = init_kv_cache(
             self.mcfg, rows, s, dtype, quant=self.ecfg.kv_quant
         )
@@ -840,6 +842,15 @@ class InferenceEngine:
         self._sample_seed = np.zeros((rows,), np.uint32)
         self._slot_bias_on = np.zeros((rows,), bool)
         self._spec_hist: Dict[int, tuple] = {}
+        # Adaptive verify width (ISSUE 17): per-slot windowed acceptance
+        # EMA driving _spec_pick_k, the last-64-burst (proposed, accepted)
+        # window behind the engine_spec_accept_rate gauge, and the
+        # per-iteration (proposed, accepted, k) flight scratch.  Both
+        # dicts are dropped with their slot/request (_spec_drop) — the
+        # engine_spec_hist_entries gauge is the leak gate.
+        self._spec_ema: Dict[int, float] = {}
+        self._spec_window: Deque[Tuple[int, int]] = deque(maxlen=64)
+        self._flight_spec = (0, 0, 0)
 
         self._requests: Dict[int, _ActiveRequest] = {}
         # Chunked-prefill state: slot -> (run, next segment start).  FIFO;
@@ -1173,16 +1184,14 @@ class InferenceEngine:
         position pos+1 from their own (seed, pos) stream — exactly a plain
         decode step.  Returns (emitted [B, k+1], counts [B], cache)."""
         from p2p_llm_tunnel_tpu.models.transformer import (
-            chunk_prefill_into_cache,
+            spec_verify_into_cache,
         )
 
-        b, t = tokens.shape  # t = 1 + spec_k
+        b, t = tokens.shape  # t = 1 + K (the burst width this dispatch)
         k = t - 1
-        slots = jnp.arange(b)
-        lengths = jnp.full((b,), t, jnp.int32)
-        logits, kv_cache = chunk_prefill_into_cache(
-            self.mcfg, params, tokens, lengths, positions, kv_cache,
-            slots, kv_view=kv_view, return_all_logits=True,
+        logits, kv_cache = spec_verify_into_cache(
+            self.mcfg, params, tokens, positions, kv_cache,
+            kv_view=kv_view, mesh=self.mesh,
         )  # [B, t, V]
         if samp.bias_on is not None:
             logits = jax.lax.cond(
@@ -1372,8 +1381,8 @@ class InferenceEngine:
                         jax.block_until_ready(outs[0])
                     await loop.run_in_executor(self._executor, _one)
                 elif kind == "spec":
-                    def _one_spec(view=shape[0]):
-                        self._dispatch_spec(view=view)
+                    def _one_spec(view=shape[0], k=shape[1]):
+                        self._dispatch_spec(view=view, k=k)
                         # nothing to process: no rows active during warmup
                     await loop.run_in_executor(self._executor, _one_spec)
                 elif kind == "prefill":
@@ -1460,6 +1469,33 @@ class InferenceEngine:
                 *self._decode_warm_args(
                     self._warmup_views()[0] if view is None else view,
                     self.ecfg.decode_steps if steps is None else steps,
+                ),
+            )
+        finally:
+            self.mcfg = old
+
+    def spec_launch_report(self, view: Optional[int] = None,
+                           k: Optional[int] = None):
+        """Launch-proxy counts of the spec-verify program on the REAL TPU
+        lowering — :meth:`decode_launch_report`'s twin for the fused
+        K-token verify burst (ISSUE 17).  The layer-body claim the PERF.md
+        launch table and test_fused_spec_decode assert: ONE custom call
+        per layer for the whole K+1-position burst, vs K+1 separate
+        decode launches."""
+        old = self.mcfg
+        self.mcfg = dc_replace(
+            self.mcfg, flash_interpret=False, flash_force=True
+        )
+        try:
+            from p2p_llm_tunnel_tpu.utils.hlo import (
+                decode_launch_report as _report,
+            )
+
+            return _report(
+                jax.jit(self._spec_verify_fn, static_argnums=(6,)),
+                *self._spec_warm_args(
+                    self._warmup_views()[0] if view is None else view,
+                    self.ecfg.spec_k if k is None else k,
                 ),
             )
         finally:
@@ -1568,7 +1604,7 @@ class InferenceEngine:
             return views
         need = cap + 2 * self.ecfg.decode_steps + 1
         if self.ecfg.spec_ngram > 0:
-            need += self.ecfg.spec_k
+            need += self._spec_k_cap()
         if self.ecfg.prefill_chunk > 0 and not self.ecfg.ragged_prefill:
             # Chunk-prefill dispatches pick their view bucket from
             # starts.max() + the PADDED segment width (_dispatch_chunk_rows)
@@ -1605,7 +1641,13 @@ class InferenceEngine:
             ("decode", (v, k)) for v in views for k in sorted(steps)
         ]
         if self.ecfg.spec_ngram > 0:
-            plan += [("spec", (v,)) for v in views]
+            # One fused verify program per (view, burst width): adaptive K
+            # walks the power-of-two ladder (_spec_k_buckets), so every
+            # rung must be compiled up front or the first low-acceptance
+            # slot cold-compiles mid-serve (pinned by test_warmup_aot's
+            # mux+spec herd).
+            plan += [("spec", (v, k))
+                     for v in views for k in self._spec_k_buckets()]
         plan += [("prefill", (w,)) for w in self._warm_prefill_widths()]
         if self.ecfg.ragged_prefill:
             plan.append(("ragged", (self._ragged_tot,)))
@@ -1718,13 +1760,79 @@ class InferenceEngine:
             self._warm_samp(nb), self._key,
         )
 
-    def _spec_warm_args(self, view: int):
-        """Positional args for the spec-verify program, aval-identical to
-        _dispatch_spec's live call."""
+    def _spec_k_cap(self) -> int:
+        """Widest verify burst any dispatch can reach: spec_k, or
+        spec_k_max when the adaptive ladder is enabled above it."""
+        return max(self.ecfg.spec_k, self.ecfg.spec_k_max)
+
+    def _spec_adaptive(self) -> bool:
+        return self.ecfg.spec_k_max > self.ecfg.spec_k
+
+    def _spec_k_buckets(self) -> List[int]:
+        """Burst widths K a spec dispatch may select — the program ladder
+        warmup_plan() precompiles.  Fixed mode: exactly {spec_k}.
+        Adaptive mode: powers of two below spec_k_max plus the cap
+        itself, so the EMA can shrink a cold slot to K=1 and grow a
+        hot one to the cap without ever leaving the warmed grid."""
+        if not self._spec_adaptive():
+            return [self.ecfg.spec_k]
+        mx = self.ecfg.spec_k_max
+        ks = {mx}
+        k = 1
+        while k < mx:
+            ks.add(k)
+            k *= 2
+        return sorted(ks)
+
+    def _spec_pick_k(self) -> int:
+        """This dispatch's burst width: the smallest warmed bucket
+        covering every active greedy slot's DESIRED width (acceptance
+        EMA × cap, so a slot accepting ~60% of an 8-wide burst asks for
+        ~5 and rides the 8 bucket; a slot rejecting everything decays
+        toward 1).  The batch takes the max over slots — verify is one
+        program over all rows, so the narrowest slot just wastes a few
+        verify columns while the EMA converges."""
+        buckets = self._spec_k_buckets()
+        if not self._spec_adaptive():
+            return buckets[-1]
+        mx = self.ecfg.spec_k_max
+        init = self.ecfg.spec_k / mx
+        want = 1
+        n = self.ecfg.num_slots
+        for i in np.nonzero(self._active_mask[:n])[0]:
+            if self._temp[i] <= 0.0:
+                ema = self._spec_ema.get(int(i), init)
+                want = max(want, int(round(ema * mx)))
+        want = min(max(1, want), mx)
+        return next(b for b in buckets if b >= want)
+
+    def _spec_drop(self, slot: int) -> None:
+        """Release a slot's proposer history + acceptance EMA (eviction,
+        deadline, cancel) — the registry must never outlive its request
+        (the engine_spec_hist_entries leak gate; loadgen asserts it
+        returns to 0 post-run)."""
+        self._spec_hist.pop(slot, None)
+        self._spec_ema.pop(slot, None)
+        global_metrics.set_gauge(
+            "engine_spec_hist_entries", len(self._spec_hist)
+        )
+
+    def _spec_drop_rid(self, rid: int) -> None:
+        """Drop spec state for a request wherever it sits — the cancel
+        path knows the rid, not the slot."""
+        for i, entry in list(self._spec_hist.items()):
+            if entry[0] == rid:
+                self._spec_drop(i)
+
+    def _spec_warm_args(self, view: int, k: Optional[int] = None):
+        """Positional args for the spec-verify program at burst width
+        ``k``, aval-identical to _dispatch_spec's live call."""
         rows = self.ecfg.num_slots + 1
+        if k is None:
+            k = self.ecfg.spec_k
         return (
             self.params, self.kv_cache, self._bias,
-            jnp.zeros((rows, 1 + self.ecfg.spec_k), jnp.int32),
+            jnp.zeros((rows, 1 + k), jnp.int32),
             jnp.zeros((rows,), jnp.int32), self._warm_samp(rows), view,
         )
 
@@ -2150,6 +2258,10 @@ class InferenceEngine:
         finally:
             self._requests.pop(rid, None)
             self.scheduler.cancel(rid)
+            # Spec proposer history + acceptance EMA must not outlive the
+            # request (ISSUE 17 leak gate) — this finally is the one exit
+            # path that sees consumer cancels.
+            self._spec_drop_rid(rid)
             # Page-reservation release (ISSUE 14): runs on EVERY exit path
             # — finish, deadline evict, client cancel, shed, crash — so an
             # admission-time grant can never outlive its request (the
@@ -2592,10 +2704,11 @@ class InferenceEngine:
         need += 2 * self.ecfg.decode_steps + 1
         if self.ecfg.spec_ngram > 0:
             # Spec verify writes (and must be able to ATTEND) proposal KV
-            # at positions up to pos + spec_k; a view that excludes them
-            # would silently break exact-greedy equivalence at bucket
-            # boundaries.
-            need += self.ecfg.spec_k
+            # at positions up to pos + K; a view that excludes them would
+            # silently break exact-greedy equivalence at bucket
+            # boundaries.  Pad by the effective CAP, not the current
+            # adaptive K — the per-slot EMA can grow K mid-bucket.
+            need += self._spec_k_cap()
         return self._chunk_view_bucket(need)
 
     def _burst_steps(self) -> int:
@@ -2888,9 +3001,15 @@ class InferenceEngine:
         i = run.slot
         cached = self._spec_hist.get(i)
         if cached is None or cached[0] != run.request.request_id:
+            # Slot reused by a new request: its predecessor's acceptance
+            # memory must not steer the newcomer's burst width.
+            self._spec_ema.pop(i, None)
             cached = (run.request.request_id,
                       [int(t) for t in run.request.prompt_ids], 0)
             self._spec_hist[i] = cached
+            global_metrics.set_gauge(
+                "engine_spec_hist_entries", len(self._spec_hist)
+            )
         rid, hist, consumed = cached
         gen = run.generated
         if consumed < len(gen):
@@ -2924,16 +3043,22 @@ class InferenceEngine:
                  | (self._logprobs > 0))
         ))
 
-    def _dispatch_spec(self, *, view: Optional[int] = None):
+    def _dispatch_spec(self, *, view: Optional[int] = None,
+                       k: Optional[int] = None):
         """(executor thread) One speculative verify step over every row;
         returns ((emitted [R, k+1], counts [R]), request-id snapshot).
+
+        ``k`` is this dispatch's burst width — adaptive mode picks it per
+        iteration from the warmed bucket ladder (_spec_pick_k); warmup
+        pins it per plan entry.
 
         Host-carried state (no device carry, no pipelining): the host must
         read per-row counts before it can feed consumers anyway.  The
         device decode carry goes stale here, so the next plain burst gets
         a full override patch."""
         rows = self.ecfg.num_slots + 1
-        k = self.ecfg.spec_k
+        if k is None:
+            k = self._spec_pick_k()
         tokens = np.zeros((rows, 1 + k), np.int32)
         tokens[:, 0] = self._last_token
         for i in np.nonzero(self._active_mask)[0]:
@@ -2965,7 +3090,7 @@ class InferenceEngine:
             samp,
             view,
         )
-        self._note_program("spec", (view,), time.monotonic() - t_jit0)
+        self._note_program("spec", (view, k), time.monotonic() - t_jit0)
         assign = [
             run.request.request_id
             if run is not None and self._active_mask[i] else None
@@ -2977,18 +3102,40 @@ class InferenceEngine:
         self._ov_mask[:] = True
         return (emitted, counts), assign
 
+    #: Acceptance-EMA blend: new burst weighs 0.2 — ~5-burst memory,
+    #: fast enough to shrink K within a few rejected bursts.
+    SPEC_EMA_ALPHA = 0.2
+
     async def _process_spec(self, outs, assign: List) -> None:
         emitted, counts = outs
+        k = emitted.shape[1] - 1  # this burst's width
         n_emitted = 0
         n_rows = 0
+        proposed = 0
+        accepted = 0
         for i in np.nonzero(self._active_mask)[0]:
             run = self.scheduler.slots[i] if i < self.ecfg.num_slots else None
             if run is None:
                 self._active_mask[i] = False
+                self._spec_drop(int(i))
                 continue
             if run.request.request_id != assign[i]:
                 continue
             n_rows += 1
+            if self._temp[i] <= 0.0 and k > 0:
+                # Acceptance bookkeeping is GREEDY rows only: stochastic
+                # rows accept 0 by construction, and folding their zeros
+                # in would both drag the published rate and strangle the
+                # adaptive width for everyone in the batch.
+                acc = int(counts[i]) - 1
+                proposed += k
+                accepted += acc
+                prev = self._spec_ema.get(
+                    int(i), self.ecfg.spec_k / max(1, self._spec_k_cap()))
+                self._spec_ema[int(i)] = (
+                    (1.0 - self.SPEC_EMA_ALPHA) * prev
+                    + self.SPEC_EMA_ALPHA * (acc / k)
+                )
             for j in range(int(counts[i])):
                 n_emitted += 1
                 self._account_token(int(i), int(emitted[i, j]))
@@ -3000,6 +3147,15 @@ class InferenceEngine:
             global_metrics.inc(
                 "engine_spec_accepted_tokens_total", n_emitted - n_rows
             )
+            global_metrics.inc("engine_spec_proposed_tokens_total", proposed)
+            self._spec_window.append((proposed, accepted))
+            w_prop = sum(p for p, _ in self._spec_window)
+            w_acc = sum(a for _, a in self._spec_window)
+            global_metrics.set_gauge(
+                "engine_spec_accept_rate",
+                (w_acc / w_prop) if w_prop else 0.0,
+            )
+            self._flight_spec = (proposed, accepted, k)
 
     def _expire_deadlines(self) -> None:
         """Evict deadline-blown requests (queue or slot) and fail their
@@ -3010,6 +3166,7 @@ class InferenceEngine:
         for slot, req in expired:
             if slot is not None:
                 self._active_mask[slot] = False
+                self._spec_drop(slot)
             global_metrics.inc("engine_deadline_timeouts_total")
             log.warning(
                 "request %d exceeded its deadline (%s); slot reclaimed",
@@ -3037,6 +3194,7 @@ class InferenceEngine:
         evicted = self.scheduler.slots[slot] is None
         if evicted:
             self._active_mask[slot] = False
+            self._spec_drop(slot)
             if self._prefix is not None and self.ecfg.conv_cache:
                 # Every record_token eviction is a NATURAL finish (stop /
                 # length / cache-full; deadline evictions and cancels
@@ -3447,11 +3605,18 @@ class InferenceEngine:
             if run.request.deadline is not None
         ]
         min_slack = min(slacks) if slacks else None
+        # True decode token cost per row this iteration (ISSUE 17): a
+        # spec iteration moves up to K+1 tokens per slot in one pass, so
+        # the controller's decode-stall bound must charge it as such.
+        drt = 1
+        if self._spec_usable() and any(self._active_mask):
+            drt = 1 + self._spec_pick_k()
         tokens = self._mux_ctl.budget_tokens(
             queue_depth=self.scheduler.queue_depth,
             backlog_rows=backlog,
             active_rows=active,
             min_slack_s=min_slack,
+            decode_row_tokens=drt,
         )
         global_metrics.set_gauge("engine_mux_budget_tokens", tokens)
         # Flight-recorder stash (ISSUE 12): the controller's inputs and
@@ -4059,6 +4224,12 @@ class InferenceEngine:
             spill_pageouts=self._flight_pageouts,
             spill_pageins=self._flight_pageins,
             cold_compiles=global_compile_watch.cold_total - cold0,
+            # Speculation attribution (ISSUE 17): proposed/accepted verify
+            # tokens and the burst width this iteration dispatched, so a
+            # flight tail shows whether decode wall bought spec tokens.
+            spec_proposed=self._flight_spec[0],
+            spec_accepted=self._flight_spec[1],
+            spec_k=self._flight_spec[2],
             # Detached-stream count (ISSUE 13): how many of this
             # iteration's generations are filling replay journals with no
             # channel attached — a postmortem's flight tail shows whether
@@ -4113,6 +4284,7 @@ class InferenceEngine:
                 self._flight_pageins = 0
                 self._last_burst = (0, 0)
                 self._last_mux = {}
+                self._flight_spec = (0, 0, 0)
                 cold0 = global_compile_watch.cold_total
                 plain_rows = 0
                 global_flight.set_phase("admit")

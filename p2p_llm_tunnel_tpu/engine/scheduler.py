@@ -88,6 +88,7 @@ class MuxController:
         backlog_rows: int,
         active_rows: int,
         min_slack_s: Optional[float] = None,
+        decode_row_tokens: int = 1,
     ) -> int:
         """Prefill token budget for ONE loop iteration.
 
@@ -98,7 +99,15 @@ class MuxController:
         across queued + backlogged requests (None = no deadlines).  The
         returned budget may exceed one dispatch's width — the engine
         pipelines it as back-to-back ``prefill_rows``-wide sub-batches.
-        """
+
+        ``decode_row_tokens`` is the TRUE token cost one decode iteration
+        pays per active row (ISSUE 17): a speculative verify burst emits
+        up to K+1 tokens per slot in one weight-stream pass, so the engine
+        passes ``1 + K`` when spec will run.  The decode-stall bound
+        scales its prefill allowance DOWN by that factor — each iteration
+        already moves K+1× the tokens per stall-second, so holding the
+        prefill slice constant would silently grow prefill's share of
+        iteration wall from a quarter toward everything as K grows."""
         demand = queue_depth + backlog_rows
         if demand <= 0:
             return 0
@@ -125,11 +134,14 @@ class MuxController:
             return drain
         # Decode-stall bound: with a mostly-busy batch and a shallow
         # queue, live streams keep at least half (under pressure) /
-        # three quarters (normally) of each iteration's work.
+        # three quarters (normally) of each iteration's work — measured
+        # in TOKENS, so a verify burst's K+1-per-row cost shrinks the
+        # prefill slice proportionally.
         if demand >= self.max_rows:
             rows = max(1, self.max_rows // 2)
         else:
             rows = max(1, self.max_rows // 4)
+        rows = max(1, rows // max(1, decode_row_tokens))
         return min(rows * self.unit, drain)
 
 

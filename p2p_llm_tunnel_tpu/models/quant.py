@@ -133,6 +133,80 @@ def unpack_int4(packed: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     return jnp.stack([lo, hi], axis=axis + 1).reshape(out_shape)
 
 
+# ---------------------------------------------------------------------------
+# Byte-aligned packed-KV write helpers (the TC19 commit points)
+#
+# Every XLA-path write into a packed int4 KV plane goes through one of the
+# four helpers below — they are the ONLY places a pack_int4 result may meet
+# an ``.at[...].set`` (tunnelcheck TC19 enforces this statically).  The
+# contract they defend (ISSUE 14/17): HBM stores into the packed plane
+# cover WHOLE bytes; a nibble shared with a neighbouring token is merged in
+# registers from a gathered covering byte, never half-written.  Parked rows
+# ride the standard OOB semantics: gathers clamp (value unused), scatters
+# drop.
+# ---------------------------------------------------------------------------
+
+def write_packed_prefix(plane: jnp.ndarray, slots: jnp.ndarray,
+                        vals: jnp.ndarray) -> jnp.ndarray:
+    """Full-prefix packed write: ``vals [L, Bp, T(even), K, D]`` int4 values
+    land at positions ``[0, T)`` of each slot row of ``plane
+    [L, R, S//2, K, D]``.  Position 0 is byte-aligned by definition, so the
+    packed write is a plain whole-byte scatter."""
+    packed = pack_int4(vals, axis=2)
+    return plane.at[:, slots, : packed.shape[2]].set(packed)
+
+
+def write_packed_chunk(plane: jnp.ndarray, idx: jnp.ndarray,
+                       rows: jnp.ndarray, bpos: jnp.ndarray,
+                       vals: jnp.ndarray) -> jnp.ndarray:
+    """Page-aligned chunk write: ``vals [Bp, T(even), K, D]`` at EVEN token
+    starts, pre-translated by the caller to byte positions ``bpos
+    [Bp, T//2]``.  Byte i of the write holds exactly tokens
+    ``(start + 2i, start + 2i + 1)`` — whole bytes, no RMW."""
+    return plane.at[idx, rows, bpos].set(pack_int4(vals, axis=1))
+
+
+def append_packed_token(plane: jnp.ndarray, idx: jnp.ndarray,
+                        slots: jnp.ndarray, positions: jnp.ndarray,
+                        vals: jnp.ndarray) -> jnp.ndarray:
+    """Single-token decode append: ``vals [B, K, D]`` at arbitrary-parity
+    ``positions [B]``.  The new token shares a byte with its sequence
+    neighbour, whose nibble must survive (for odd positions it holds the
+    PREVIOUS token's real value) — gather the covering byte, merge the new
+    nibble, store the whole byte."""
+    bidx = positions // 2
+    even = (positions % 2 == 0)[:, None, None]
+    old = plane[idx, slots, bidx]
+    lo = jnp.where(even, vals, old) & 0x0F
+    hi = jnp.where(even, jnp.right_shift(old, 4), vals)
+    return plane.at[idx, slots, bidx].set(
+        (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
+    )
+
+
+def splice_packed_rows(plane: jnp.ndarray, idx: jnp.ndarray,
+                       slots: jnp.ndarray, starts: jnp.ndarray,
+                       vals: jnp.ndarray) -> jnp.ndarray:
+    """Arbitrary-start multi-token splice — the write shape of a
+    spec-verify burst (ISSUE 17): ``vals [B, T, K, D]`` int4 values land at
+    token positions ``[starts, starts + T)`` of each row, ``starts [B]`` of
+    ANY parity and T of any parity.  Gather the covering whole-byte range
+    (``T//2 + 1`` bytes spans every parity case), unpack, overlay the burst
+    tokens, repack, scatter the SAME whole bytes back — boundary nibbles
+    outside the burst are preserved from the gathered bytes, and positions
+    past the plane's end drop on the scatter (parked / overflow rows)."""
+    b, t, _, _ = vals.shape
+    nb = t // 2 + 1
+    bpos = starts[:, None] // 2 + jnp.arange(nb)[None, :]  # [B, nb]
+    old = plane[idx, slots[:, None], bpos]  # [B, nb, K, D]
+    old_tok = unpack_int4(old, axis=1)  # [B, 2*nb, K, D]
+    jrel = jnp.arange(2 * nb)[None, :] - (starts % 2)[:, None]  # [B, 2nb]
+    use_new = (jrel >= 0) & (jrel < t)
+    newv = vals[jnp.arange(b)[:, None], jnp.clip(jrel, 0, t - 1)]
+    merged = jnp.where(use_new[:, :, None, None], newv, old_tok)
+    return plane.at[idx, slots[:, None], bpos].set(pack_int4(merged, axis=1))
+
+
 def _quantize4(w: jnp.ndarray, axis: int, group_size: int = 128) -> QTensor4:
     """Symmetric int4 over ``axis`` with per-group scales.
 

@@ -503,7 +503,7 @@ def prefill_into_cache(
     t_w = ks.shape[2]
     out = dict(kv_cache)
     if quant_mode == "int4":
-        from p2p_llm_tunnel_tpu.models.quant import pack_int4
+        from p2p_llm_tunnel_tpu.models.quant import write_packed_prefix
 
         kq, k_s = _quant_kv4(ks)
         vq, v_s = _quant_kv4(vs)
@@ -515,12 +515,8 @@ def prefill_into_cache(
             pad = ((0, 0), (0, 0), (0, 1), (0, 0), (0, 0))
             kq = jnp.pad(kq, pad)
             vq = jnp.pad(vq, pad)
-        out["k"] = kv_cache["k"].at[:, slots, : (t_w + 1) // 2].set(
-            pack_int4(kq, axis=2)
-        )
-        out["v"] = kv_cache["v"].at[:, slots, : (t_w + 1) // 2].set(
-            pack_int4(vq, axis=2)
-        )
+        out["k"] = write_packed_prefix(kv_cache["k"], slots, kq)
+        out["v"] = write_packed_prefix(kv_cache["v"], slots, vq)
         out["k_scale"] = kv_cache["k_scale"].at[:, slots, :t_w].set(k_s)
         out["v_scale"] = kv_cache["v_scale"].at[:, slots, :t_w].set(v_s)
     elif quant_mode == "int8":
@@ -548,6 +544,7 @@ def chunk_prefill_into_cache(
     slots: jnp.ndarray,  # [Bp] cache slot per prompt
     kv_view: Optional[int] = None,  # static: attend only to cache[:kv_view]
     return_all_logits: bool = False,  # static: [Bp,T,V] instead of last
+    unaligned_int4: bool = False,  # static: arbitrary-parity int4 starts
 ) -> Tuple[jnp.ndarray, KVCache]:
     """Prefill only the TAIL of each prompt against reused history KV.
 
@@ -588,15 +585,18 @@ def chunk_prefill_into_cache(
     both forced even under ``kv_quant="int4"``.  Junk pad positions past
     a row's real length land in high nibbles that decode's RMW append
     overwrites before they are ever attendable (the standard prefill-pad
-    argument; see ``prefill_into_cache``).  Spec-verify is the one
-    consumer whose starts are arbitrary token positions — it stays
-    engine-fenced under int4.
+    argument; see ``prefill_into_cache``).  Spec-verify — the one consumer
+    whose starts are arbitrary token positions — passes
+    ``unaligned_int4=True`` to route the packed write through
+    ``quant.splice_packed_rows`` instead: boundary nibbles are merged in
+    registers from gathered covering bytes, so HBM stores stay whole-byte
+    and the last ``config_fences`` entry stays dead (ISSUE 17).
 
     Returns last-real-tail-token logits [Bp, V] and the updated cache.
     """
     b, t = tokens.shape
     quant_mode = kv_cache_quant_mode(kv_cache)
-    if quant_mode == "int4" and t % 2:
+    if quant_mode == "int4" and t % 2 and not unaligned_int4:
         raise ValueError(
             f"packed int4 chunk prefill needs an even (page-aligned) tail "
             f"width, got {t}; the engine pads tails to even buckets"
@@ -611,13 +611,20 @@ def chunk_prefill_into_cache(
     quant = kv_cache_is_quantized(kv_cache)
     rows = slots[:, None]  # [Bp,1] broadcasts against pos [Bp,T]
     if quant_mode == "int4":
-        from p2p_llm_tunnel_tpu.models.quant import pack_int4, unpack_int4
+        from p2p_llm_tunnel_tpu.models.quant import (
+            splice_packed_rows,
+            unpack_int4,
+            write_packed_chunk,
+        )
 
         # Byte positions of the page-aligned packed write: starts is even
         # by the contract above, so byte i of the write holds exactly
         # tokens (starts + 2i, starts + 2i + 1) — whole bytes, plain
-        # scatter, no nibble RMW on the chunk path.
-        bpos = starts[:, None] // 2 + jnp.arange(t // 2)[None, :]
+        # scatter, no nibble RMW on the chunk path.  (Unaligned spec-verify
+        # bursts skip this and splice covering bytes instead.)
+        bpos = None
+        if not unaligned_int4:
+            bpos = starts[:, None] // 2 + jnp.arange(t // 2)[None, :]
 
     from p2p_llm_tunnel_tpu.ops.attention import history_attention
 
@@ -630,14 +637,20 @@ def chunk_prefill_into_cache(
         if quant_mode == "int4":
             kq, k_s = _quant_kv4(k)
             vq, v_s = _quant_kv4(v)
-            # Page-aligned whole-byte scatter (see the docstring contract):
-            # the scale planes stay per-token full width.
-            cache["k"] = cache["k"].at[idx, rows, bpos].set(
-                pack_int4(kq, axis=1)
-            )
-            cache["v"] = cache["v"].at[idx, rows, bpos].set(
-                pack_int4(vq, axis=1)
-            )
+            # Whole-byte writes either way (see the docstring contract):
+            # aligned chunks scatter packed bytes directly, unaligned
+            # spec-verify bursts splice covering bytes; the scale planes
+            # stay per-token full width.
+            if unaligned_int4:
+                cache["k"] = splice_packed_rows(
+                    cache["k"], idx, slots, starts, kq)
+                cache["v"] = splice_packed_rows(
+                    cache["v"], idx, slots, starts, vq)
+            else:
+                cache["k"] = write_packed_chunk(
+                    cache["k"], idx, rows, bpos, kq)
+                cache["v"] = write_packed_chunk(
+                    cache["v"], idx, rows, bpos, vq)
             cache["k_scale"] = cache["k_scale"].at[idx, rows, pos].set(k_s)
             cache["v_scale"] = cache["v_scale"].at[idx, rows, pos].set(v_s)
         elif quant:
@@ -705,6 +718,109 @@ def chunk_prefill_into_cache(
         logits, (lengths - 1)[:, None, None], axis=1
     )[:, 0]
     return last, new_cache
+
+
+def spec_verify_into_cache(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, T] carry token + K proposals per slot
+    positions: jnp.ndarray,  # [B] global position of tokens[:, 0]
+    kv_cache: KVCache,
+    kv_view: Optional[int] = None,  # static: attend only to cache[:kv_view]
+    mesh=None,  # Mesh when params/cache are sharded (gates the fused path)
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Speculative draft-verify burst: T = 1 + K positions per slot in ONE
+    forward pass (ISSUE 17).
+
+    The fused path runs ``ops.pallas_decode_attention.fused_spec_decode_layer``
+    — ONE Pallas launch per layer covering rope + KV quant + whole-byte
+    cache append + frontier-clamped flash over the cache prefix with the
+    burst's own rows substituted causally — so a verify burst costs one
+    weight-stream pass instead of T decode launches (the PR 4/15 launch
+    arithmetic, K-fold).  Its token streams are bitwise those of T
+    sequential ``fused_decode_layer`` steps (tests/test_fused_spec_decode);
+    rejected-tail KV is junk PAST every accepted position, rewritten by the
+    row's next burst before any query can attend it (all masks are strictly
+    ``< pos``), so acceptance needs no cache rollback.
+
+    The fallback (no TPU/interpret, tp>1, or fused disabled) is the chunk
+    prefill path with ``unaligned_int4=True``: spec starts are arbitrary
+    token positions, so packed int4 writes ride ``quant.splice_packed_rows``
+    (covering-byte gather → nibble merge → whole-byte scatter) instead of
+    the page-aligned scatter — the write discipline that lets spec_ngram
+    run under ``kv-int4`` with the ``config_fences`` registry EMPTY.
+
+    Inactive slots park at ``positions >= kv_view`` and compute junk
+    (gathers clamp, scatters drop), masked by the engine.  Returns
+    (logits [B, T, V], updated cache).
+    """
+    b, t = tokens.shape
+    quant_mode = kv_cache_quant_mode(kv_cache)
+    quant = quant_mode is not None
+    s = kv_cache["k"].shape[2] * (2 if quant_mode == "int4" else 1)
+    if kv_view is None or kv_view > s:
+        kv_view = s
+    tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
+    kernel_ok = (
+        (jax.default_backend() == "tpu" or cfg.flash_interpret
+         or cfg.flash_force)
+        and tp == 1
+        and kv_view % 128 == 0
+        and (cfg.head_dim % 128 == 0 or cfg.flash_interpret)
+    )
+    if not (cfg.fused_decode_layer and kernel_ok):
+        lengths = jnp.full((b,), t, jnp.int32)
+        return chunk_prefill_into_cache(
+            cfg, params, tokens, lengths, positions, kv_cache,
+            jnp.arange(b), kv_view=kv_view, return_all_logits=True,
+            unaligned_int4=True,
+        )
+
+    from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+        fused_spec_decode_layer,
+    )
+
+    x = _embed(cfg, params, tokens)  # [B,T,Dm]
+    layer_idx = jnp.arange(cfg.n_layers)
+
+    def step(carry, xs):
+        x, cache = carry
+        blk, idx = xs
+        h = _norm(cfg, x, blk["attn_norm"])
+        q, k, v = _qkv_proj(cfg, blk, h)  # PRE-rope: kernel ropes the burst
+        attn, ck, cv, k_s, v_s = fused_spec_decode_layer(
+            q, k, v,
+            cache["k"], cache["v"],
+            cache.get("k_scale"), cache.get("v_scale"),
+            positions, idx,
+            kv_view=kv_view,
+            rope_theta=cfg.rope_theta,
+            kv_quant=quant_mode,
+            scale=cfg.query_scale,
+            softcap=cfg.attn_softcap,
+            window=_layer_window(cfg, idx, s),
+            interpret=cfg.flash_interpret,
+        )
+        cache = dict(cache)
+        cache["k"], cache["v"] = ck, cv
+        if quant:
+            cache["k_scale"], cache["v_scale"] = k_s, v_s
+        attn = mm(attn.reshape(b, t, -1), blk["wo"], cfg.act_quant)
+        if cfg.post_norms:
+            attn = _norm(cfg, attn, blk["post_attn_norm"])
+        x = x + attn
+        h = _norm(cfg, x, blk["mlp_norm"])
+        mlp = _mlp(cfg, blk, h)
+        if cfg.post_norms:
+            mlp = _norm(cfg, mlp, blk["post_mlp_norm"])
+        x = x + mlp
+        return (x, cache), None
+
+    (x, new_cache), _ = jax.lax.scan(
+        step, (x, dict(kv_cache)), (params["blocks"], layer_idx)
+    )
+    x = _norm(cfg, x, params["final_norm"])
+    return _logits(cfg, params, x), new_cache  # [B,T,V]
 
 
 def ragged_prefill_into_cache(
@@ -947,7 +1063,10 @@ def decode_step(
             )
 
     if quant_mode == "int4":
-        from p2p_llm_tunnel_tpu.models.quant import unpack_int4
+        from p2p_llm_tunnel_tpu.models.quant import (
+            append_packed_token,
+            unpack_int4,
+        )
 
     def step(carry, xs):
         x, cache = carry
@@ -958,28 +1077,17 @@ def decode_step(
         if quant_mode == "int4":
             kq, k_s = _quant_kv4(k[:, 0])
             vq, v_s = _quant_kv4(v[:, 0])
-            # Packed nibble read-modify-write: the new token shares a byte
-            # with its sequence neighbour, whose nibble must survive (for
-            # odd positions it holds the PREVIOUS token's real value).
-            # Parked rows (pos >= s) rely on the same OOB semantics as the
-            # int8 path: the gather clamps (value unused) and the scatter
-            # drops the write.
-            bidx = positions // 2
-            even = (positions % 2 == 0)[:, None, None]
-            old_k = cache["k"][idx, slot_ids, bidx]
-            old_v = cache["v"][idx, slot_ids, bidx]
-
-            def pack_row(new, old):
-                lo = jnp.where(even, new, old) & 0x0F
-                hi = jnp.where(even, jnp.right_shift(old, 4), new)
-                return (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
-
-            cache["k"] = cache["k"].at[idx, slot_ids, bidx].set(
-                pack_row(kq, old_k)
-            )
-            cache["v"] = cache["v"].at[idx, slot_ids, bidx].set(
-                pack_row(vq, old_v)
-            )
+            # Packed nibble read-modify-write via quant.append_packed_token
+            # (the TC19 commit point): the new token shares a byte with its
+            # sequence neighbour, whose nibble must survive (for odd
+            # positions it holds the PREVIOUS token's real value).  Parked
+            # rows (pos >= s) rely on the same OOB semantics as the int8
+            # path: the gather clamps (value unused) and the scatter drops
+            # the write.
+            cache["k"] = append_packed_token(
+                cache["k"], idx, slot_ids, positions, kq)
+            cache["v"] = append_packed_token(
+                cache["v"], idx, slot_ids, positions, vq)
             cache["k_scale"] = (
                 cache["k_scale"].at[idx, slot_ids, positions].set(k_s)
             )

@@ -892,3 +892,492 @@ def fused_decode_layer(
         return attn, kc, vc, ks5[..., 0], vs5[..., 0]
     attn, kc, vc = outs
     return attn, kc, vc, None, None
+
+
+# ---------------------------------------------------------------------------
+# Fused K-token speculative verify kernel (ISSUE 17 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _fused_spec_decode_layer_kernel(
+    idx_sref,  # scalar-prefetch [1] int32: layer index into the [L,...] cache
+    pos_sref,  # scalar-prefetch [B] int32: per-slot position of burst row 0
+    win_sref,  # scalar-prefetch [1] int32: sliding window (view+1 = disabled)
+    q_ref,  # [T*H, D] this slot's T query rows' heads, PRE-rope
+    kn_ref,  # [T*K, D] new key rows, PRE-rope
+    vn_ref,  # [T*K, D] new value rows
+    k_ref,  # [BS, K, D] cache block (raw/int8) | [BS/2, K, D] packed int4
+    v_ref,  # same layout as k_ref
+    *rest,  # kv_quant: ks_ref/vs_ref [BS, K, 1], then outputs+scratch
+    scale: float,
+    softcap: Optional[float],
+    block_s: int,
+    n_sblocks: int,
+    t_burst: int,
+    kh: int,
+    g: int,
+    view: int,
+    rope_theta: float,
+    out_dtype,
+    kv_quant: Optional[str],
+):
+    """The K+1-position verify-burst twin of ``_fused_decode_layer_kernel``.
+
+    One program per (slot, grid-step) where the grid's s-axis is
+    ``n_sblocks`` flash steps followed by ``t_burst`` append steps:
+
+    - sj == 0: RoPE all T query/key rows at positions ``pos + t`` and
+      quantize each new KV row to the cache precision, into scratch.
+    - sj <= fmax (flash): online softmax over the staged cache block for
+      ALL T queries.  Burst-own rows are SUBSTITUTED into the dequantized
+      block where their global position lands (their cache bytes are
+      stale until this launch's appends), so query t accumulates rows
+      ``< pos + t`` in exactly the block order a sequential
+      ``fused_decode_layer`` pass would — per-query attention is
+      bit-identical to T unfused launches, which is what keeps spec-on
+      and spec-off token streams byte-identical under greedy sampling.
+    - sj == n_sblocks - 1: fold each query's OWN row (attendable at its
+      position) and emit all T normalized outputs.
+    - sj == n_sblocks + t (append, unrolled per static t): write token
+      t's quantized row through a 1-row aliased output block.  For int4,
+      two adjacent tokens share a byte: consecutive append steps with the
+      same byte-row index keep the output block RESIDENT in VMEM (Pallas
+      flushes only on an index change), so nibbles accumulate on-chip and
+      only whole bytes ever reach HBM — the byte-alignment contract that
+      kills the spec_ngram config fence.  The boundary byte's neighbour
+      nibble is preserved from the staged input block (its pre-launch
+      value: for an odd ``pos`` that is the PREVIOUS committed token).
+      Rejected-tail rows need no rollback: every mask here is strictly
+      ``< pos``, so a stale speculative row is never attendable before a
+      later burst/decode rewrites it.
+    """
+    if kv_quant is not None:
+        (ks_ref, vs_ref,
+         o_ref, ok_ref, ov_ref, oks_ref, ovs_ref,
+         q_sc, kq_sc, vq_sc, ksc_sc, vsc_sc, m_sc, l_sc, acc_sc) = rest
+    else:
+        (o_ref, ok_ref, ov_ref,
+         q_sc, kq_sc, vq_sc, m_sc, l_sc, acc_sc) = rest
+    bi = pl.program_id(0)
+    sj = pl.program_id(1)
+    pos = pos_sref[bi]
+    window = win_sref[0]
+    d = q_ref.shape[-1]
+    h_all = g * kh
+    # Last s-block any burst query may attend: covers the substituted
+    # burst rows, not just the cache prefix.  Parked rows (pos >= view)
+    # clamp to the full range — junk output, discarded by the engine.
+    fmax = jnp.minimum((pos + t_burst - 1) // block_s, n_sblocks - 1)
+    qmax = 7.0 if kv_quant == "int4" else 127.0
+
+    @pl.when(sj == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc[:], _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc[:])
+        acc_sc[:] = jnp.zeros_like(acc_sc[:])
+        half = d // 2
+        lane = jax.lax.broadcasted_iota(jnp.float32, (1, d), 1)
+        pair = jnp.where(lane < half, lane, lane - half)
+        freqs = 1.0 / (rope_theta ** (2.0 * pair / d))
+
+        def rope(x, ang):  # x [rows, D] f32
+            sin = jnp.sin(ang)
+            cos = jnp.cos(ang)
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            return x * cos + rot * sin
+
+        for t in range(t_burst):
+            ang = (pos + t).astype(jnp.float32) * freqs
+            q_sc[t * h_all:(t + 1) * h_all] = rope(
+                q_ref[t * h_all:(t + 1) * h_all].astype(jnp.float32), ang
+            ) * scale
+            kn = rope(kn_ref[t * kh:(t + 1) * kh].astype(jnp.float32), ang)
+            vn = vn_ref[t * kh:(t + 1) * kh].astype(jnp.float32)
+            if kv_quant is not None:
+                k_s = jnp.maximum(
+                    jnp.abs(kn).max(-1, keepdims=True), 1e-8) / qmax
+                v_s = jnp.maximum(
+                    jnp.abs(vn).max(-1, keepdims=True), 1e-8) / qmax
+                kq_sc[t * kh:(t + 1) * kh] = jnp.clip(
+                    jnp.round(kn / k_s), -qmax, qmax)
+                vq_sc[t * kh:(t + 1) * kh] = jnp.clip(
+                    jnp.round(vn / v_s), -qmax, qmax)
+                ksc_sc[t * kh:(t + 1) * kh] = jnp.broadcast_to(
+                    k_s, (kh, ksc_sc.shape[-1]))
+                vsc_sc[t * kh:(t + 1) * kh] = jnp.broadcast_to(
+                    v_s, (kh, vsc_sc.shape[-1]))
+            else:
+                kq_sc[t * kh:(t + 1) * kh] = kn
+                vq_sc[t * kh:(t + 1) * kh] = vn
+
+    def _unpack_seq(p):  # [BS/2, K, D] bytes -> [BS, K, D] int8 in [-8, 7]
+        lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+        hi = jnp.right_shift(p, 4)
+        return jnp.stack([lo, hi], axis=1).reshape(
+            2 * p.shape[0], p.shape[1], p.shape[2]
+        )
+
+    def _deq_row(sc, ssc, t, h, stored: bool = False):
+        # One burst row's head-h DEQUANTIZED value [1, D] — what a later
+        # read of the appended cache row reproduces exactly.  ``stored``
+        # additionally roundtrips through the cache storage dtype: the
+        # unquantized cache is bf16, so a query attending an EARLIER
+        # burst row must see the value a sequential pass would read back,
+        # not the full-f32 scratch copy.  (Quantized rows are exact: the
+        # int values in scratch ARE the stored bytes.)
+        row = sc[t * kh + h:t * kh + h + 1, :]
+        if kv_quant is not None:
+            return row * ssc[t * kh + h:t * kh + h + 1, :1]
+        if stored:
+            return row.astype(k_ref.dtype).astype(jnp.float32)
+        return row
+
+    @pl.when(sj <= fmax)
+    def _compute():
+        if kv_quant == "int4":
+            k_blk = _unpack_seq(k_ref[:]).astype(jnp.float32)
+            v_blk = _unpack_seq(v_ref[:]).astype(jnp.float32)
+        else:
+            k_blk = k_ref[:].astype(jnp.float32)  # [BS, K, D]
+            v_blk = v_ref[:].astype(jnp.float32)
+        if kv_quant is not None:
+            k_blk = k_blk * ks_ref[:]
+            v_blk = v_blk * vs_ref[:]
+        k_pos = sj * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1
+        )
+        row_pos = sj * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, 1), 0
+        )
+        for h in range(kh):
+            k_h = k_blk[:, h, :]  # [BS, D]
+            v_h = v_blk[:, h, :]
+            # Substitute the burst's own roundtripped rows over their
+            # stale cache bytes (parked rows never match: row_pos < view).
+            for tt in range(t_burst):
+                sel = row_pos == (pos + tt)  # [BS, 1]
+                k_h = jnp.where(sel, _deq_row(kq_sc, ksc_sc if kv_quant
+                                              else None, tt, h,
+                                              stored=True), k_h)
+                v_h = jnp.where(sel, _deq_row(vq_sc, vsc_sc if kv_quant
+                                              else None, tt, h,
+                                              stored=True), v_h)
+            for t in range(t_burst):
+                lo = t * h_all + h * g
+                hi_r = lo + g
+                qh = q_sc[lo:hi_r, :]  # [G, D], pre-scaled
+                s = jax.lax.dot_general(
+                    qh, k_h, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [G, BS]
+                if softcap is not None:
+                    s = softcap * jnp.tanh(s / softcap)
+                # STRICT < pos + t: rows before query t's own position —
+                # cache prefix plus the substituted earlier burst rows.
+                mask = (k_pos < pos + t) & ((pos + t - k_pos) < window)
+                s = jnp.where(mask, s, _NEG_INF)
+                m_prev = m_sc[lo:hi_r, :1]
+                l_prev = l_sc[lo:hi_r, :1]
+                m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+                corr = jnp.where(
+                    m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+                p = jnp.exp(s - m_new)
+                p = jnp.where(s == _NEG_INF, 0.0, p)
+                acc_sc[lo:hi_r, :] = (
+                    acc_sc[lo:hi_r, :] * corr
+                    + jax.lax.dot_general(
+                        p, v_h, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+                l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+                m_sc[lo:hi_r, :] = jnp.broadcast_to(m_new, (g, m_sc.shape[-1]))
+                l_sc[lo:hi_r, :] = jnp.broadcast_to(l_new, (g, l_sc.shape[-1]))
+
+    @pl.when(sj == n_sblocks - 1)
+    def _emit():
+        for t in range(t_burst):
+            for h in range(kh):
+                lo = t * h_all + h * g
+                hi_r = lo + g
+                qh = q_sc[lo:hi_r, :]
+                kd = _deq_row(kq_sc, ksc_sc if kv_quant else None, t, h)
+                vd = _deq_row(vq_sc, vsc_sc if kv_quant else None, t, h)
+                s = jax.lax.dot_general(
+                    qh, kd, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [G, 1]
+                if softcap is not None:
+                    s = softcap * jnp.tanh(s / softcap)
+                m_prev = m_sc[lo:hi_r, :1]
+                l_prev = l_sc[lo:hi_r, :1]
+                m_new = jnp.maximum(m_prev, s)
+                corr = jnp.where(
+                    m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_new))
+                p = jnp.exp(s - m_new)
+                acc = acc_sc[lo:hi_r, :] * corr + p * vd
+                l_new = l_prev * corr + p
+                o_ref[lo:hi_r, :] = (
+                    acc / jnp.maximum(l_new, 1e-30)
+                ).astype(out_dtype)
+
+    # Append steps, unrolled over the STATIC burst offset so each token's
+    # parity/first-touch logic stays compile-time simple.
+    for t in range(t_burst):
+        @pl.when(sj == n_sblocks + t)
+        def _append_t(t=t):
+            p = pos + t
+            cp = jnp.minimum(p, view - 1)
+            tok_parked = p >= view
+            blk = cp // block_s
+            if kv_quant == "int4":
+                rb = cp // 2 - blk * (block_s // 2)
+                old_k = k_ref[pl.ds(rb, 1), :, :]  # [1, K, D] bytes
+                old_v = v_ref[pl.ds(rb, 1), :, :]
+                even = (cp % 2) == 0
+                if t == 0:
+                    # First touch: the neighbour nibble comes from HBM.
+                    base_k, base_v = old_k, old_v
+                else:
+                    # A new byte starts exactly when cp is even; odd cp
+                    # shares the byte the PREVIOUS append step wrote,
+                    # still resident in the un-flushed output block.
+                    base_k = jnp.where(even, old_k, ok_ref[:])
+                    base_v = jnp.where(even, old_v, ov_ref[:])
+                kq = jnp.round(kq_sc[t * kh:(t + 1) * kh]).astype(
+                    jnp.int8)[None]
+                vq = jnp.round(vq_sc[t * kh:(t + 1) * kh]).astype(
+                    jnp.int8)[None]
+
+                def pack_row(new, old_b):
+                    lo = jnp.where(even, new, old_b) & 0x0F
+                    hi = jnp.where(even, jnp.right_shift(old_b, 4), new)
+                    return (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
+
+                ok_ref[:] = jnp.where(
+                    tok_parked, base_k, pack_row(kq, base_k))
+                ov_ref[:] = jnp.where(
+                    tok_parked, base_v, pack_row(vq, base_v))
+            else:
+                row = cp - blk * block_s
+                old_k = k_ref[pl.ds(row, 1), :, :]
+                old_v = v_ref[pl.ds(row, 1), :, :]
+                # Parked steps all clamp to row view-1: keep the resident
+                # block (which may hold the just-written final real row)
+                # rather than re-fetching the pre-launch bytes.
+                base_k = old_k if t == 0 else ok_ref[:]
+                base_v = old_v if t == 0 else ov_ref[:]
+                if kv_quant == "int8":
+                    kq = jnp.round(kq_sc[t * kh:(t + 1) * kh]).astype(
+                        jnp.int8)[None]
+                    vq = jnp.round(vq_sc[t * kh:(t + 1) * kh]).astype(
+                        jnp.int8)[None]
+                else:
+                    kq = kq_sc[t * kh:(t + 1) * kh].astype(
+                        ok_ref.dtype)[None]
+                    vq = vq_sc[t * kh:(t + 1) * kh].astype(
+                        ov_ref.dtype)[None]
+                ok_ref[:] = jnp.where(tok_parked, base_k, kq)
+                ov_ref[:] = jnp.where(tok_parked, base_v, vq)
+            if kv_quant is not None:
+                srow = cp - blk * block_s
+                old_ks = ks_ref[pl.ds(srow, 1), :, :]  # [1, K, 1]
+                old_vs = vs_ref[pl.ds(srow, 1), :, :]
+                base_ks = old_ks if t == 0 else oks_ref[:]
+                base_vs = old_vs if t == 0 else ovs_ref[:]
+                oks_ref[:] = jnp.where(
+                    tok_parked, base_ks,
+                    ksc_sc[t * kh:(t + 1) * kh, :1][None])
+                ovs_ref[:] = jnp.where(
+                    tok_parked, base_vs,
+                    vsc_sc[t * kh:(t + 1) * kh, :1][None])
+
+
+def fused_spec_decode_layer(
+    q: jnp.ndarray,  # [B, T, H, D] post-projection, PRE-rope
+    k_new: jnp.ndarray,  # [B, T, K, D] post-projection, PRE-rope
+    v_new: jnp.ndarray,  # [B, T, K, D]
+    k_cache: jnp.ndarray,  # [L, B, S, K, D] raw/int8 | [L, B, S/2, K, D] int4
+    v_cache: jnp.ndarray,
+    k_scale: Optional[jnp.ndarray],  # [L, B, S, K] f32, or None
+    v_scale: Optional[jnp.ndarray],
+    positions: jnp.ndarray,  # [B] int32: position of burst row 0 per slot
+    layer_idx,  # int32 scalar (traced: the lax.scan layer index)
+    *,
+    kv_view: int,  # static: attention reads cache[..., :kv_view, :, :]
+    rope_theta: float,
+    kv_quant: Optional[str] = None,  # None | "int8" | "int4"
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    window=None,  # None | int | traced int scalar
+    interpret: bool = False,
+):
+    """Fused K+1-position speculative verify burst (ISSUE 17 tentpole).
+
+    ``fused_decode_layer`` extended from 1 new position to ``T = K + 1``
+    positions per slot in ONE pallas_call per layer: in-VMEM rope for all
+    T rows, causal attention among the burst's own rows folded into the
+    frontier-clamped flash read over the cache prefix, and the cache
+    append as T aliased in-place row writes (whole bytes only under the
+    packed int4 layout — the write pattern that deletes the last
+    ``config_fences`` entry).  The grid is ``(B, n_sblocks + T)``: flash
+    steps first, then one append step per burst row whose 1-row output
+    block stays VMEM-resident while consecutive tokens share an int4 byte.
+
+    Requirements (the spec-verify gate enforces them):
+    - ``kv_view`` % 128 == 0; every ACTIVE slot satisfies
+      ``position + T <= kv_view`` (the engine pads its view bucket by the
+      burst width; positions >= kv_view are parked rows — junk output,
+      cache rows preserved);
+    - head_dim tiles (% 128 == 0) unless running in interpret mode.
+
+    Returns ``(attn [B, T, H, D], k_cache', v_cache', k_scale',
+    v_scale')`` (scale entries None when ``kv_quant`` is None).
+    """
+    l, b = k_cache.shape[0], k_cache.shape[1]
+    t_burst, h, d = q.shape[1], q.shape[2], q.shape[3]
+    kh = k_new.shape[2]
+    g = h // kh
+    quantized = k_scale is not None
+    if (kv_quant is not None) != quantized:
+        raise ValueError("kv_quant requires k_scale/v_scale and vice versa")
+    s_tokens = k_cache.shape[2] * (2 if kv_quant == "int4" else 1)
+    view = min(kv_view, s_tokens)
+    if view % BLOCK_S == 0:
+        bs = BLOCK_S
+    elif view % 128 == 0:
+        bs = 128
+    else:
+        raise ValueError(
+            f"fused spec decode layer needs view % 128 == 0, got {view}")
+    n_sb = view // bs
+    if scale is None:
+        scale = d**-0.5
+    pos = positions.astype(jnp.int32)
+    win = (
+        jnp.full((1,), view + 1, jnp.int32) if window is None
+        else jnp.reshape(window, (1,)).astype(jnp.int32)
+    )
+    idx = jnp.reshape(layer_idx, (1,)).astype(jnp.int32)
+    q2 = q.reshape(b, t_burst * h, d)
+    kn2 = k_new.reshape(b, t_burst * kh, d)
+    vn2 = v_new.reshape(b, t_burst * kh, d)
+
+    kernel = functools.partial(
+        _fused_spec_decode_layer_kernel,
+        scale=scale,
+        softcap=softcap,
+        block_s=bs,
+        n_sblocks=n_sb,
+        t_burst=t_burst,
+        kh=kh,
+        g=g,
+        view=view,
+        rope_theta=rope_theta,
+        out_dtype=q.dtype,
+        kv_quant=kv_quant,
+    )
+
+    def slot_index(bi, sj, idx_r, pos_r, win_r):
+        return (bi, 0, 0)
+
+    pack = 2 if kv_quant == "int4" else 1
+
+    def _app_t(sj):
+        return jnp.clip(sj - n_sb, 0, t_burst - 1)
+
+    def kv_index(bi, sj, idx_r, pos_r, win_r):
+        # Flash steps clamp past-fmax fetches to the last needed block
+        # (same index -> Pallas elides the DMA).  Append steps re-stage
+        # the block CONTAINING the token being appended, so the old
+        # neighbour byte / parked row is in VMEM even when the burst
+        # crosses an s-block boundary (at most one extra fetch).
+        p = pos_r[bi]
+        fmax = jnp.minimum((p + t_burst - 1) // bs, n_sb - 1)
+        cp = jnp.minimum(p + _app_t(sj), view - 1)
+        blk = jnp.where(sj >= n_sb, cp // bs, jnp.minimum(sj, fmax))
+        return (idx_r[0], bi, blk, 0, 0)
+
+    def row_index(bi, sj, idx_r, pos_r, win_r):
+        # One (byte-)row output block per append step; during flash steps
+        # it parks at token 0's row (constant index -> no early flush).
+        cp = jnp.minimum(pos_r[bi] + _app_t(sj), view - 1)
+        return (idx_r[0], bi, cp // pack, 0, 0)
+
+    def srow_index(bi, sj, idx_r, pos_r, win_r):
+        cp = jnp.minimum(pos_r[bi] + _app_t(sj), view - 1)
+        return (idx_r[0], bi, cp, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((None, t_burst * h, d), slot_index),
+        pl.BlockSpec((None, t_burst * kh, d), slot_index),
+        pl.BlockSpec((None, t_burst * kh, d), slot_index),
+        pl.BlockSpec((None, None, bs // pack, kh, d), kv_index),
+        pl.BlockSpec((None, None, bs // pack, kh, d), kv_index),
+    ]
+    operands = [idx, pos, win, q2, kn2, vn2, k_cache, v_cache]
+    out_shapes = [
+        jax.ShapeDtypeStruct((b, t_burst * h, d), q.dtype),
+        jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+        jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+    ]
+    out_specs = [
+        pl.BlockSpec((None, t_burst * h, d), slot_index),
+        pl.BlockSpec((None, None, 1, kh, d), row_index),
+        pl.BlockSpec((None, None, 1, kh, d), row_index),
+    ]
+    # Operand index (scalar-prefetch args included) -> output index.
+    aliases = {6: 1, 7: 2}
+    scratch = [
+        pltpu.VMEM((t_burst * h, d), jnp.float32),  # q_sc (rope'd, scaled)
+        pltpu.VMEM((t_burst * kh, d), jnp.float32),  # kq_sc
+        pltpu.VMEM((t_burst * kh, d), jnp.float32),  # vq_sc
+    ]
+    if quantized:
+        ks5 = k_scale.astype(jnp.float32)[..., None]  # [L, B, S, K, 1]
+        vs5 = v_scale.astype(jnp.float32)[..., None]
+        in_specs += [
+            pl.BlockSpec((None, None, bs, kh, 1), kv_index),
+            pl.BlockSpec((None, None, bs, kh, 1), kv_index),
+        ]
+        operands += [ks5, vs5]
+        out_shapes += [
+            jax.ShapeDtypeStruct(ks5.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vs5.shape, jnp.float32),
+        ]
+        out_specs += [
+            pl.BlockSpec((None, None, 1, kh, 1), srow_index),
+            pl.BlockSpec((None, None, 1, kh, 1), srow_index),
+        ]
+        aliases.update({8: 3, 9: 4})
+        scratch += [
+            pltpu.VMEM((t_burst * kh, 128), jnp.float32),  # ksc_sc
+            pltpu.VMEM((t_burst * kh, 128), jnp.float32),  # vsc_sc
+        ]
+    scratch += [
+        pltpu.VMEM((t_burst * h, 128), jnp.float32),  # m
+        pltpu.VMEM((t_burst * h, 128), jnp.float32),  # l
+        pltpu.VMEM((t_burst * h, d), jnp.float32),  # acc
+    ]
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shapes),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, n_sb + t_burst),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+            scratch_shapes=scratch,
+        ),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    if quantized:
+        attn, kc, vc, ks5, vs5 = outs
+        return (attn.reshape(b, t_burst, h, d), kc, vc,
+                ks5[..., 0], vs5[..., 0])
+    attn, kc, vc = outs
+    return attn.reshape(b, t_burst, h, d), kc, vc, None, None

@@ -107,6 +107,18 @@ FLIGHT_SCHEMA: Dict[str, str] = {
         "this iteration (ISSUE 16; the thrash detector's context — "
         "page-ins racing pageouts over a small window is the signature)"
     ),
+    "spec_proposed": (
+        "draft tokens proposed to the fused verify burst this iteration "
+        "(ISSUE 17; greedy rows only, 0 when speculation is off/idle)"
+    ),
+    "spec_accepted": (
+        "proposed draft tokens the verify burst accepted this iteration "
+        "(ISSUE 17; excludes the always-emitted bonus token)"
+    ),
+    "spec_k": (
+        "burst width K the dispatched spec-verify program used this "
+        "iteration (ISSUE 17; 0 when no spec burst ran)"
+    ),
     "cold_compiles": "mid-serve cold compiles detected during this iteration",
     "streams_detached": (
         "streams parked in the detached-stream registry's grace window "

@@ -29,6 +29,19 @@ METRICS_CATALOG: Dict[str, str] = {
     "engine_prefill_segments_total": "chunked-prefill segments executed (counter)",
     "engine_spec_tokens_total": "tokens emitted via speculative decode (counter)",
     "engine_spec_accepted_tokens_total": "draft tokens accepted by verify (counter)",
+    "engine_spec_proposed_tokens_total": (
+        "draft tokens proposed to verify bursts across greedy rows "
+        "(counter; accepted/proposed is the lifetime acceptance rate)"
+    ),
+    "engine_spec_accept_rate": (
+        "verify acceptance rate over the last 64 bursts (gauge; the "
+        "windowed signal behind per-slot adaptive K — ISSUE 17)"
+    ),
+    "engine_spec_hist_entries": (
+        "live per-slot spec proposer histories (gauge; must return to 0 "
+        "when no requests are active — the ISSUE 17 leak gate loadgen "
+        "asserts post-run)"
+    ),
     "engine_prefix_hit_tokens_total": "prompt tokens served from prefix cache (counter)",
     "engine_prefix_saved_blocks_total": "KV blocks saved into prefix cache (counter)",
     "engine_prefix_dedup_hits_total": (

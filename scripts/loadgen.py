@@ -629,6 +629,7 @@ async def run_load(args) -> dict:
     streams_hz = (healthz or {}).get("streams") or {}
     pool_hz = (healthz or {}).get("prefix_pool") or {}
     spill_hz = pool_hz.get("spill") or {}
+    spec_hz = (healthz or {}).get("spec") or {}
     out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
@@ -637,6 +638,14 @@ async def run_load(args) -> dict:
         # 13): byte-identical to the client, so only the server counter
         # can report them; None = the scrape was unavailable.
         "resumed": resumed,
+        # ISSUE 17: the run's speculative-decode yield — lifetime verify
+        # acceptance over the whole run (None when spec was off or the
+        # scrape unavailable); the adaptive-K controller's input.
+        "spec_accept_rate": (
+            None if not spec_hz.get("proposed_total")
+            else round(spec_hz["accepted_total"]
+                       / spec_hz["proposed_total"], 3)
+        ),
         "tenants": tenant_rows(per_tenant),
         # Leak check: in-flight, occupancy, AND the detached-stream
         # registry must be back to zero once every client is done — a
@@ -661,6 +670,11 @@ async def run_load(args) -> dict:
             "pool_spill_inflight": spill_hz.get("inflight"),
             "pool_spill_pages": spill_hz.get("pages"),
             "pool_spill_bytes": spill_hz.get("bytes"),
+            # ISSUE 17 leak gate: the per-slot draft-history registry
+            # must be empty once every stream finished — an entry left
+            # behind by a cancel/eviction path pins stale proposals (and
+            # their EMA) to whatever request lands in the slot next.
+            "spec_hist_entries": spec_hz.get("hist_entries"),
             "tenants": healthz.get("tenants"),
             "retry_after_s": healthz.get("retry_after_s"),
         },
@@ -813,7 +827,8 @@ def main(argv=None) -> int:
             hz.get(k) or 0
             for k in ("inflight_requests", "queue_depth", "slot_occupancy",
                       "streams_detached", "replay_buffer_bytes",
-                      "pool_pages_reserved", "pool_spill_inflight")
+                      "pool_pages_reserved", "pool_spill_inflight",
+                      "spec_hist_entries")
         )
         if leaked:
             detail = ("unreachable" if hz is None

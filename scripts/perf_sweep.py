@@ -181,6 +181,30 @@ GRID = [
         "BENCH_RAGGED_PREFILL": "0",
         "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
         "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    # ISSUE 17 fused-spec twins at the hero shape, in decision order
+    # right after the ragged pair: identical weights/KV/kernels/herd,
+    # only speculation differs (spec_k / spec_accept_rate recorded in
+    # the row).  The spec row banks the headline — K-token verify
+    # bursts under the FULL composition the old fence forbade
+    # (kv-int4 + fused + mux) — and the off twin isolates the
+    # acceptance-dependent decode term at the identical shape.  The
+    # benched prompts are templated/repetitive, so the ngram proposer
+    # fires the way system-prompted traffic does.
+    ("int4-kv4-fused-mux-spec", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_SPEC_NGRAM": "3", "BENCH_SPEC_K": "4",
+        "BENCH_SPEC_K_MAX": "8",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    ("int4-kv4-fused-mux-specoff", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_SPEC_NGRAM": "0",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
     # Cold shared-prefix herd at the base shape (the ISSUE 5 TTFT bar):
     # 32 clients whose prompts share a ~256-token templated prefix the
     # warm request never touched.  The off twin quantifies what the herd

@@ -35,6 +35,9 @@ PINNED_ROW_KEYS = (
     # warmup_* cold-start fields and prefill_exec_p50_ms.
     "ragged_prefill",
     "decode_kernels_per_step", "prefix_cache", "spec_ngram",
+    # ISSUE 17 add-only extension: the fused spec-verify burst width and
+    # the measured acceptance rate (accepted/proposed over the window).
+    "spec_k", "spec_accept_rate",
     "mux", "mux_budget_tokens", "mux_prefill_chunk",
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
     # ISSUE 14 add-only extension: block-paged pool occupancy + the

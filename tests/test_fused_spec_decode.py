@@ -1,0 +1,249 @@
+"""Fused K-token speculative verify (ISSUE 17 tentpole): interpret-mode
+kernel oracles against sequential ``fused_decode_layer`` launches,
+transformer-level equivalence of ``spec_verify_into_cache`` against T
+sequential ``decode_step`` calls (every kv_quant mode, fused and unfused
+paths, odd int4 start positions), and the launch-count acceptance — the
+TPU-lowered layer body of a whole K-token verify burst is ONE Pallas
+custom call (utils/hlo.py, the ISSUE 4 methodology).
+
+The correctness bar is absolute and mirrors the spec-decode engine
+contract: a verify burst must be *indistinguishable in every byte it
+writes and every logit it returns* from running the same tokens one
+decode step at a time.  Anything weaker would let speculation change
+greedy output.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_tunnel_tpu.models.config import ModelConfig, get_config
+from p2p_llm_tunnel_tpu.models.quant import pack_int4, quantize_params_int4
+from p2p_llm_tunnel_tpu.models.transformer import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill_into_cache,
+    spec_verify_into_cache,
+)
+from p2p_llm_tunnel_tpu.ops.pallas_decode_attention import (
+    fused_decode_layer,
+    fused_spec_decode_layer,
+)
+
+# Compile-heavy (JAX jit of engine/model programs): excluded from
+# `make test-fast` (VERDICT r4 item 8).
+pytestmark = pytest.mark.slow
+
+T = 5  # burst width under test: K=4 drafts + 1 committed token
+
+
+# ---------------------------------------------------------------------------
+# kernel-level oracle: one spec launch vs T sequential fused launches
+# ---------------------------------------------------------------------------
+
+def _mk_cache(rng, kv_quant, l, b, s, kh, d):
+    if kv_quant == "int4":
+        k = jnp.asarray(rng.integers(-128, 128, (l, b, s // 2, kh, d)),
+                        jnp.int8)
+        v = jnp.asarray(rng.integers(-128, 128, (l, b, s // 2, kh, d)),
+                        jnp.int8)
+    elif kv_quant == "int8":
+        k = jnp.asarray(rng.integers(-127, 128, (l, b, s, kh, d)), jnp.int8)
+        v = jnp.asarray(rng.integers(-127, 128, (l, b, s, kh, d)), jnp.int8)
+    else:
+        k = jnp.asarray(rng.standard_normal((l, b, s, kh, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((l, b, s, kh, d)), jnp.float32)
+        return k, v, None, None
+    ks = jnp.asarray(rng.random((l, b, s, kh)) * 0.1 + 0.01, jnp.float32)
+    vs = jnp.asarray(rng.random((l, b, s, kh)) * 0.1 + 0.01, jnp.float32)
+    return k, v, ks, vs
+
+
+def _sequential(q, kn, vn, kc, vc, ks, vs, pos, idx, kw):
+    """The oracle: T independent fused_decode_layer launches, each
+    appending one token before the next attends over it."""
+    attn = []
+    for t in range(q.shape[1]):
+        a, kc, vc, ks, vs = fused_decode_layer(
+            q[:, t], kn[:, t], vn[:, t], kc, vc, ks, vs, pos + t, idx, **kw)
+        attn.append(a)
+    return jnp.stack(attn, axis=1), kc, vc, ks, vs
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int8", "int4"])
+@pytest.mark.parametrize(
+    "positions",
+    # in-block, straddling odd/even int4 parity, a row past the view end
+    # (parked: no writes land, junk never attendable), and a row whose
+    # burst crosses the view frontier mid-way.
+    [[7, 100, 255], [8, 13, 300], [0, 254, 251]],
+)
+def test_spec_kernel_matches_sequential_fused(kv_quant, positions):
+    l, b, s, kh, h, d = 2, 3, 256, 2, 4, 32
+    rng = np.random.default_rng(hash((str(kv_quant), tuple(positions)))
+                                % (2 ** 31))
+    kc, vc, ks, vs = _mk_cache(rng, kv_quant, l, b, s, kh, d)
+    q = jnp.asarray(rng.standard_normal((b, T, h, d)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((b, T, kh, d)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((b, T, kh, d)), jnp.float32)
+    pos = jnp.asarray(positions, jnp.int32)
+    idx = jnp.asarray(1, jnp.int32)
+    kw = dict(kv_view=s, rope_theta=10000.0, kv_quant=kv_quant,
+              scale=None, softcap=5.0, window=None, interpret=True)
+
+    seq_attn, skc, svc, sks, svs = _sequential(
+        q, kn, vn, kc, vc, ks, vs, pos, idx, kw)
+    attn, okc, ovc, oks, ovs = fused_spec_decode_layer(
+        q, kn, vn, kc, vc, ks, vs, pos, idx, **kw)
+
+    # Attention compared only for rows whose whole burst is in-bounds —
+    # overflowed rows return garbage on BOTH paths and the engine never
+    # reads them.  Cache bytes must match EVERYWHERE (parked rows write
+    # nothing at all).
+    act = np.asarray(pos) + T <= s
+    if act.any():
+        a_err = np.abs(np.asarray(attn) - np.asarray(seq_attn))[act].max()
+        assert a_err < 2e-5, a_err
+    assert np.array_equal(np.asarray(okc), np.asarray(skc))
+    assert np.array_equal(np.asarray(ovc), np.asarray(svc))
+    if ks is not None:
+        np.testing.assert_allclose(np.asarray(oks), np.asarray(sks))
+        np.testing.assert_allclose(np.asarray(ovs), np.asarray(svs))
+
+
+@pytest.mark.parametrize("kv_quant", [None, "int4"])
+@pytest.mark.parametrize("window", [None, 64])
+def test_spec_kernel_bitwise_multiblock_bf16(kv_quant, window):
+    """S=512 (two s-blocks) in bf16: the frontier-clamped block sweep,
+    sliding-window masking, and the stored-dtype roundtrip of burst rows
+    (earlier burst tokens must be re-read at CACHE precision, exactly as
+    the sequential path reads them back) — all BITWISE."""
+    l, b, s, kh, h, d = 2, 2, 512, 2, 4, 32
+    rng = np.random.default_rng(3)
+    kc, vc, ks, vs = _mk_cache(rng, kv_quant, l, b, s, kh, d)
+    q = jnp.asarray(rng.standard_normal((b, T, h, d)), jnp.bfloat16)
+    kn = jnp.asarray(rng.standard_normal((b, T, kh, d)), jnp.bfloat16)
+    vn = jnp.asarray(rng.standard_normal((b, T, kh, d)), jnp.bfloat16)
+    if kv_quant is None:
+        kc, vc = kc.astype(jnp.bfloat16), vc.astype(jnp.bfloat16)
+    pos = jnp.asarray([255, 300], jnp.int32)  # one straddles the blocks
+    idx = jnp.asarray(0, jnp.int32)
+    win = None if window is None else jnp.asarray(window, jnp.int32)
+    kw = dict(kv_view=s, rope_theta=10000.0, kv_quant=kv_quant,
+              scale=None, softcap=None, window=win, interpret=True)
+
+    seq_attn, skc, svc, sks, svs = _sequential(
+        q, kn, vn, kc, vc, ks, vs, pos, idx, kw)
+    attn, okc, ovc, oks, ovs = fused_spec_decode_layer(
+        q, kn, vn, kc, vc, ks, vs, pos, idx, **kw)
+
+    assert np.array_equal(np.asarray(attn, np.float32),
+                          np.asarray(seq_attn, np.float32))
+    assert np.array_equal(np.asarray(okc), np.asarray(skc))
+    assert np.array_equal(np.asarray(ovc), np.asarray(svc))
+    if ks is not None:
+        assert np.array_equal(np.asarray(oks), np.asarray(sks))
+        assert np.array_equal(np.asarray(ovs), np.asarray(svs))
+
+
+# ---------------------------------------------------------------------------
+# transformer-level: spec_verify_into_cache vs T sequential decode_steps
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant", [False, "int8", "int4"])
+@pytest.mark.parametrize("fused", [False, True])
+def test_spec_verify_matches_sequential_decode_steps(kv_quant, fused):
+    """The whole-model contract behind greedy spec/plain equivalence:
+    one spec_verify_into_cache call returns the same logits AND leaves
+    bitwise-identical cache planes as T sequential decode_steps.  Row 0
+    starts at an ODD position — the unaligned-int4 splice path (and the
+    kernel's parity-clamped append) must still land whole-byte writes."""
+    cfg = dataclasses.replace(
+        get_config("tiny"), fused_decode_layer=fused, flash_interpret=fused)
+    params = init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    rng = np.random.RandomState(0)
+    b, s, t = 3, 256, 4
+    lens = [7, 12, 250]
+
+    cache = init_kv_cache(cfg, b, s, jnp.float32, quant=kv_quant)
+    toks = jnp.zeros((b, s), jnp.int32)
+    for i, n in enumerate(lens):
+        toks = toks.at[i, :n].set(
+            jnp.asarray(rng.randint(1, 200, size=n), jnp.int32))
+    _, cache = prefill_into_cache(
+        cfg, params, toks, jnp.array(lens), cache, jnp.arange(b))
+    positions = jnp.array(lens, jnp.int32)
+    burst = jnp.asarray(rng.randint(1, 200, size=(b, t)), jnp.int32)
+
+    sc = cache
+    seq_logits = []
+    for i in range(t):
+        lg, sc = decode_step(cfg, params, sc, burst[:, i],
+                             positions + i, kv_view=s)
+        seq_logits.append(lg)
+    seq_logits = jnp.stack(seq_logits, axis=1)
+
+    logits, oc = spec_verify_into_cache(
+        cfg, params, burst, positions, cache, kv_view=s)
+
+    l_err = np.abs(np.asarray(logits) - np.asarray(seq_logits)).max()
+    assert l_err < 2e-3, l_err
+    assert np.array_equal(np.argmax(np.asarray(logits), -1),
+                          np.argmax(np.asarray(seq_logits), -1))
+    for key in ("k", "v"):
+        assert np.array_equal(np.asarray(oc[key]), np.asarray(sc[key])), key
+    for key in oc:
+        np.testing.assert_allclose(np.asarray(oc[key]), np.asarray(sc[key]),
+                                   atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# launch-count acceptance: ONE custom call per layer per K-token burst
+# ---------------------------------------------------------------------------
+
+#: TPU-tileable tiny config: head_dim 128 so the REAL (non-interpret)
+#: kernel lowers for the TPU platform from this CPU host.
+TILE_CFG = ModelConfig(
+    name="tiny128", vocab_size=256, dim=128, n_layers=2, n_heads=4,
+    n_kv_heads=2, head_dim=128, ffn_dim=256,
+)
+
+
+def test_spec_burst_layer_body_is_one_custom_call():
+    """ISSUE 17 acceptance: the TPU-lowered layer body of a whole K-token
+    verify burst is ONE Pallas custom call — the same launch shape as a
+    single fused decode step, so a burst costs n_layers launches instead
+    of (K+1) x n_layers.  Measured on the int4 + kv-int4 hero config."""
+    from p2p_llm_tunnel_tpu.utils.hlo import decode_launch_report
+
+    cfg = dataclasses.replace(
+        TILE_CFG, fused_decode_layer=True, flash_force=True)
+    params = quantize_params_int4(
+        init_params(TILE_CFG, jax.random.PRNGKey(0), jnp.float32),
+        group_size=64,
+    )
+    cache = init_kv_cache(TILE_CFG, 3, 256, jnp.float32, quant="int4")
+
+    jspec = jax.jit(lambda p, c, tk, pos: spec_verify_into_cache(
+        cfg, p, tk, pos, c, kv_view=256))
+    aspec = (params, cache, jnp.zeros((3, T), jnp.int32),
+             jnp.zeros((3,), jnp.int32))
+    jstep = jax.jit(lambda p, c, tk, pos: decode_step(
+        cfg, p, c, tk, pos, kv_view=256))
+    astep = (params, cache, jnp.zeros((3,), jnp.int32),
+             jnp.zeros((3,), jnp.int32))
+
+    rspec = decode_launch_report(jspec, *aspec)
+    rstep = decode_launch_report(jstep, *astep)
+    assert rspec is not None and rstep is not None, "TPU cross-lowering failed"
+    assert rspec["layer_body_pallas"] == 1, (
+        "K-token verify burst is not ONE pallas call per layer")
+    assert rstep["layer_body_pallas"] == 1
+    # The K-fold arithmetic: the burst body must cost far less than K+1
+    # single-step bodies — it IS (approximately) one single-step body.
+    assert rspec["layer_body_major"] < T * rstep["layer_body_major"], (
+        rspec["layer_body_major"], rstep["layer_body_major"])

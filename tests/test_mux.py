@@ -66,6 +66,30 @@ def test_controller_decode_stall_bound():
     assert pressed < 8 * 64
 
 
+def test_controller_spec_burst_charges_k_plus_one():
+    """Fused spec-verify bursts (ISSUE 17) emit up to K+1 tokens per slot
+    per iteration: the stall bound must treat each decode row as K+1
+    tokens of decode throughput, shrinking the prefill allowance
+    proportionally — but never below one row, and never touching the
+    drain branches (idle batch / demand >= active rows)."""
+    ctl = MuxController(64, 8)
+    calm = ctl.budget_tokens(queue_depth=0, backlog_rows=3, active_rows=16)
+    assert calm == 2 * 64
+    spec = ctl.budget_tokens(queue_depth=0, backlog_rows=3, active_rows=16,
+                             decode_row_tokens=5)
+    assert spec == 1 * 64  # max(1, (8 // 4) // 5) rows
+    assert spec < calm
+    pressed = ctl.budget_tokens(queue_depth=4, backlog_rows=8,
+                                active_rows=16, decode_row_tokens=5)
+    assert pressed == 1 * 64  # max(1, (8 // 2) // 5) rows
+    # Drain branches ignore the charge: an idle batch or demand-heavy
+    # wave drains the backlog whether or not speculation is live.
+    assert ctl.budget_tokens(queue_depth=3, backlog_rows=20, active_rows=0,
+                             decode_row_tokens=5) == 20 * 64
+    assert ctl.budget_tokens(queue_depth=8, backlog_rows=4, active_rows=4,
+                             decode_row_tokens=5) == 4 * 64
+
+
 def test_controller_deadline_rescue_overrides_stall_bound():
     ctl = MuxController(64, 8)
     assert ctl.budget_tokens(

@@ -2820,14 +2820,14 @@ def test_sarif_includes_tc00(tmp_path):
 
 def test_list_rules_pinned_against_code_and_readme(capsys):
     """Rule-id drift (docs vs code) fails fast: --list-rules must show
-    exactly TC00..TC18, every runnable rule must have a summary, and the
+    exactly TC00..TC19, every runnable rule must have a summary, and the
     README rule table must carry a row for every rule."""
     from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
 
     assert tunnelcheck_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     listed = [line.split()[0] for line in out.strip().splitlines()]
-    assert listed == [f"TC{i:02d}" for i in range(19)]
+    assert listed == [f"TC{i:02d}" for i in range(20)]
     assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
 
     readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")
@@ -3066,3 +3066,143 @@ def test_callgraph_indexes_defs_in_nested_compounds(tmp_path):
         assert id(fn) in indexed, f"call graph missed `{fn.name}`"
     meth = [n for n in graph.by_path[f] if n.name == "meth"]
     assert meth and meth[0].info.is_method  # class context survives nesting
+
+
+# ---------------------------------------------------------------------------
+# TC19 — packed-KV writes only through the byte-aligned helpers
+# ---------------------------------------------------------------------------
+
+KV_FIXTURE = "p2p_llm_tunnel_tpu/models/fixture_kv.py"
+
+
+def test_tc19_direct_packed_write_flags(tmp_path):
+    """The incident shape: a pack_int4 result fed straight into a plane
+    write at a call site — arbitrary-parity starts clobber the shared
+    edge bytes (the bug the spec_ngram x kv-int4 fence hid)."""
+    active, _ = check(
+        tmp_path,
+        """
+        def scatter(plane, rows, bpos, vals):
+            return plane.at[0, rows, bpos].set(pack_int4(vals, axis=1))
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert rules_of(active) == ["TC19"]
+    assert "splice_packed_rows" in active[0].message
+
+
+def test_tc19_taint_flows_through_locals(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def scatter(plane, rows, vals):
+            packed = pack_int4(vals, axis=1)
+            staged = packed
+            return plane.at[0, rows].set(staged)
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert rules_of(active) == ["TC19"]
+
+
+def test_tc19_hand_rolled_nibble_merge_flags(tmp_path):
+    """The pre-helper RMW idiom evades the packer taint by never calling
+    pack_int4 — the (hi << 4) | lo shape is flagged on its own."""
+    active, _ = check(
+        tmp_path,
+        """
+        def append(plane, idx, slots, bidx, lo, hi):
+            return plane.at[idx, slots, bidx].set(
+                ((hi << 4) | (lo & 0x0F)).astype(jnp.int8)
+            )
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert rules_of(active) == ["TC19"]
+    assert "nibble merge" in active[0].message
+
+
+def test_tc19_helper_bodies_are_sanctioned(tmp_path):
+    """The four audited commit points may (must) do exactly what every
+    other function is banned from doing."""
+    active, _ = check(
+        tmp_path,
+        """
+        def write_packed_chunk(plane, idx, rows, bpos, vals):
+            return plane.at[idx, rows, bpos].set(pack_int4(vals, axis=1))
+
+        def append_packed_token(plane, idx, slots, positions, vals):
+            old = plane[idx, slots, positions // 2]
+            lo = jnp.where(True, vals, old) & 0x0F
+            hi = jnp.where(True, old >> 4, vals)
+            return plane.at[idx, slots, positions // 2].set(
+                (jnp.left_shift(hi, 4) | lo).astype(jnp.int8)
+            )
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert active == []
+
+
+def test_tc19_unpacked_writes_and_helper_calls_clean(tmp_path):
+    """Raw (unpacked) values into a plane, and UNPACKED values handed to
+    an audited helper, are both fine — the helper packs internally."""
+    active, _ = check(
+        tmp_path,
+        """
+        def prefill(cache, slots, k, kq):
+            cache = cache.at[:, slots].set(k)
+            return write_packed_prefix(cache, slots, kq)
+
+        def verify(cache, idx, slots, starts, kq):
+            return splice_packed_rows(cache, idx, slots, starts, kq)
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert active == []
+
+
+def test_tc19_out_of_scope_tree_ignored(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def scatter(plane, vals):
+            return plane.at[0].set(pack_int4(vals, axis=1))
+        """,
+        filename="experiments/scratch.py",
+        rules=["TC19"],
+    )
+    assert active == []
+
+
+def test_tc19_waiver_parses(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        def scatter(plane, vals):
+            return plane.at[0].set(pack_int4(vals, axis=1))  # tunnelcheck: disable=TC19  scale plane, not a packed token plane
+        """,
+        filename=KV_FIXTURE,
+        rules=["TC19"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC19"]
+
+
+def test_tc19_kv_write_paths_self_run_clean():
+    """The real packed-write paths are TC19-clean WITHOUT waivers: since
+    ISSUE 17 every XLA-path packed write in quant/transformer/engine
+    routes through the four byte-aligned helpers."""
+    base = REPO_ROOT / "p2p_llm_tunnel_tpu"
+    files = [base / "models" / "quant.py",
+             base / "models" / "transformer.py",
+             base / "engine" / "engine.py",
+             base / "engine" / "prefix_cache.py"]
+    active, waived = run_paths(files, rules=["TC19"])
+    assert active == []
+    assert rules_of(waived) == []

@@ -268,3 +268,58 @@ def test_mux_herd_hits_zero_cold_compiles(persistent_cache, monkeypatch):
     assert not live_new, (
         f"multiplexed herd compiled {len(live_new)} programs warmup missed"
     )
+
+
+def test_mux_spec_herd_hits_zero_cold_compiles(persistent_cache,
+                                               monkeypatch):
+    """ISSUE 17 acceptance: warmup_plan() enumerates the fused spec-verify
+    program per (view, K) — the whole adaptive power-of-two K ladder, not
+    just the configured burst width — so a multiplexed spec-on herd of
+    repetitive prompts (the ngram proposer fires constantly, so verify
+    bursts really dispatch) serves with engine_cold_compiles_total == 0
+    and adds no fresh persistent-cache entries."""
+    monkeypatch.setenv("TUNNEL_WARMUP_VIEW_CAP", "100")
+    monkeypatch.setenv("TUNNEL_WARMUP_PAR", "2")
+    from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+    tok = ByteTokenizer()
+    rep = list(b"the cat sat on the mat. the cat sat on the mat. the cat")
+
+    async def run():
+        eng = InferenceEngine(
+            engine_cfg=EngineConfig(
+                **{**ECFG, "mux": True, "spec_ngram": 3, "spec_k": 2,
+                   "spec_k_max": 4}
+            ),
+            tokenizer=tok,
+        )
+        spec_shapes = [s for kind, s in eng.warmup_plan() if kind == "spec"]
+        assert spec_shapes, "warmup plan lost the spec-verify programs"
+        # Every view bucket appears with every K bucket of the ladder
+        # (adaptive mode: powers of two up to the cap, down to K=1).
+        assert {k for _v, k in spec_shapes} == {1, 2, 4}
+        await eng.start()
+        await eng.warmup()
+        warmed = _cache_files(persistent_cache)
+        cold0 = global_metrics.counter("engine_cold_compiles_total")
+        spec0 = global_metrics.counter("engine_spec_proposed_tokens_total")
+        herd = [rep + [100 + i] for i in range(3)]
+        outs = await asyncio.gather(
+            *(_collect(eng, p, max_new=24) for p in herd))
+        # Mid-decode admission while verify bursts are in flight.
+        outs.append(await _collect(eng, rep + [200], max_new=24))
+        cold = global_metrics.counter("engine_cold_compiles_total") - cold0
+        fired = (global_metrics.counter("engine_spec_proposed_tokens_total")
+                 - spec0)
+        await eng.stop()
+        return outs, warmed, cold, fired
+
+    outs, warmed, cold, fired = asyncio.run(run())
+    assert warmed, "warmup wrote nothing to the persistent cache"
+    assert all(len(o) == 24 for o in outs)
+    assert fired > 0, "the spec-on herd never dispatched a verify burst"
+    assert cold == 0, f"{cold} mid-serve cold compiles under mux+spec"
+    live_new = _cache_files(persistent_cache) - warmed
+    assert not live_new, (
+        f"mux+spec herd compiled {len(live_new)} programs warmup missed"
+    )

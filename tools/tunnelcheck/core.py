@@ -395,6 +395,7 @@ def all_rules() -> Dict[str, "object"]:
         rules_dispatch,
         rules_flight,
         rules_jax,
+        rules_kvalign,
         rules_labels,
         rules_lifecycle,
         rules_metrics,
@@ -426,6 +427,7 @@ def all_rules() -> Dict[str, "object"]:
         "TC16": rules_flight.check_tc16,
         "TC17": rules_warmup.check_tc17,
         "TC18": rules_tierpin.check_tc18,
+        "TC19": rules_kvalign.check_tc19,
     }
 
 
@@ -449,6 +451,7 @@ RULE_SUMMARIES = {
     "TC16": "flight/postmortem field not in the flight.py registries / ops path matched outside http11.ops_route",
     "TC17": "dispatch-site program kind unreachable from the warmup/AOT plan generators (mid-serve cold-compile hole)",
     "TC18": "KV page bytes spliced into a device pool without the registered tier-boundary pin check (verify_page_pin)",
+    "TC19": "packed-KV write outside the byte-aligned helpers (pack_int4 -> buffer write, or hand-rolled nibble merge)",
 }
 
 

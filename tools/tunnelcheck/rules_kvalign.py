@@ -1,0 +1,171 @@
+"""TC19: packed-KV bytes only land in a cache plane through the
+byte-aligned write helpers.
+
+The ISSUE 17 incident class this rule makes permanent: the packed int4 KV
+plane stores two tokens per byte, so any write at an odd token position
+(or of an odd token count) shares its edge bytes with neighbouring tokens
+that are NOT part of the write.  A plain ``plane.at[...].set(pack_int4(v))``
+at such a position clobbers the neighbour's nibble — and for two rounds
+the engine's answer was a *fence*: ``spec_ngram`` (whose verify bursts
+start at arbitrary parity) was disabled outright whenever
+``kv_quant=int4``.  ISSUE 17 deleted that fence by concentrating every
+packed write into four audited helpers in :mod:`p2p_llm_tunnel_tpu.models.
+quant` — ``write_packed_prefix`` / ``write_packed_chunk`` /
+``append_packed_token`` / ``splice_packed_rows`` — each of which gathers
+the covering whole bytes, merges boundary nibbles in registers, and
+scatters whole bytes back.  This rule is the static guard that keeps the
+fence dead: a new call site that packs nibbles by hand and writes them
+into a plane is exactly how the clobber (and then the fence) comes back.
+
+Two findings, both on the :func:`taint_locals` substrate (TC14's
+flow-insensitive lattice — for an integrity rule, over-approximation is
+the right failure direction):
+
+- **packed-taint**: the result of a ``pack_int4(...)`` call (or a local it
+  flowed into) reaches a buffer-write sink — ``.at[...].set`` /
+  ``.at[...].add``, ``jax.lax.dynamic_update_slice`` /
+  ``dynamic_update_index_in_dim`` / ``dynamic_update_slice_in_dim``.
+- **hand-rolled nibble merge**: a buffer-write sink whose value expression
+  does its own nibble surgery (a shift-by-4 combined with a bitwise OR) —
+  the pre-helper RMW idiom, which evades the taint finding by never
+  calling ``pack_int4``.
+
+The four helper bodies themselves are the sanctioned commit points
+(``BYTE_ALIGNED_HELPERS``) and are skipped; everything else routes through
+them, registers a new audited helper here, or waives naming why the write
+cannot touch a packed plane.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
+from tools.tunnelcheck.dataflow import (
+    call_name,
+    expr_tainted,
+    iter_functions,
+    taint_locals,
+)
+
+SCOPE_PART = "p2p_llm_tunnel_tpu/"
+
+#: The audited byte-aligned commit points (models/quant.py): the ONLY
+#: function bodies where a pack_int4 result may meet a buffer write.
+BYTE_ALIGNED_HELPERS = frozenset({
+    "write_packed_prefix",
+    "write_packed_chunk",
+    "append_packed_token",
+    "splice_packed_rows",
+})
+
+#: The packer whose result is "packed bytes" — the taint source.
+PACKERS = frozenset({"pack_int4"})
+
+#: Functional buffer-write entry points beyond ``.at[...].set``.
+UPDATE_CALLS = frozenset({
+    "dynamic_update_slice",
+    "dynamic_update_index_in_dim",
+    "dynamic_update_slice_in_dim",
+})
+
+
+def _in_scope(sf: SourceFile) -> bool:
+    return SCOPE_PART in sf.path.as_posix()
+
+
+def _is_packed_source(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and call_name(expr) in PACKERS
+
+
+def _at_buffer_write(node: ast.Call) -> bool:
+    """``arr.at[...].set(x)`` / ``.add(x)`` — the functional buffer write."""
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("set", "add")
+        and isinstance(node.func.value, ast.Subscript)
+        and isinstance(node.func.value.value, ast.Attribute)
+        and node.func.value.value.attr == "at"
+    )
+
+
+def _nibble_merge(expr: ast.AST) -> bool:
+    """Hand-rolled pack: a shift-by-4 AND a bitwise OR in one value
+    expression — the ``(hi << 4) | lo`` RMW idiom the helpers replaced."""
+    shift = or_ = False
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.BinOp):
+            if isinstance(sub.op, (ast.LShift, ast.RShift)) and (
+                isinstance(sub.right, ast.Constant) and sub.right.value == 4
+            ):
+                shift = True
+            elif isinstance(sub.op, ast.BitOr):
+                or_ = True
+        elif isinstance(sub, ast.Call) and call_name(sub) in (
+            "left_shift", "right_shift"
+        ):
+            args = sub.args
+            if len(args) == 2 and isinstance(args[1], ast.Constant) \
+                    and args[1].value == 4:
+                shift = True
+        elif isinstance(sub, ast.Call) and call_name(sub) in (
+            "bitwise_or", "bitwise_or_"
+        ):
+            or_ = True
+    return shift and or_
+
+
+def check_tc19(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    del ctx
+    if not _in_scope(sf):
+        return iter(())
+    out: List[Violation] = []
+    reported: Set[int] = set()
+
+    def report(node: ast.AST, what: str) -> None:
+        if node.lineno in reported:
+            return
+        reported.add(node.lineno)
+        out.append(Violation(
+            "TC19",
+            sf.path,
+            node.lineno,
+            f"packed-KV bytes reach a cache-plane write outside the "
+            f"byte-aligned helpers ({what}) — odd-parity edge bytes "
+            "shared with neighbouring tokens get clobbered, which is the "
+            "bug the spec_ngram x kv_quant=int4 fence existed to hide "
+            "(ISSUE 17 deleted it): route the write through "
+            "write_packed_prefix / write_packed_chunk / "
+            "append_packed_token / splice_packed_rows (models/quant.py), "
+            "register a new audited helper in "
+            "rules_kvalign.BYTE_ALIGNED_HELPERS, or waive naming why the "
+            "target is not a packed plane",
+            end_line=getattr(node, "end_lineno", None),
+        ))
+
+    for fn, _cls in iter_functions(sf.tree):
+        if fn.name in BYTE_ALIGNED_HELPERS:
+            continue  # the sanctioned commit points
+        tainted = taint_locals(fn, _is_packed_source, frozenset())
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, (ast.Name, ast.Attribute)) and (
+                call_name(sub) in UPDATE_CALLS
+            ):
+                vals = list(sub.args) + [kw.value for kw in sub.keywords]
+            elif _at_buffer_write(sub):
+                vals = list(sub.args) + [kw.value for kw in sub.keywords]
+            else:
+                continue
+            if any(
+                expr_tainted(a, tainted, _is_packed_source, frozenset())
+                for a in vals
+            ):
+                report(sub, "a pack_int4 result flows into the write")
+            elif _at_buffer_write(sub) and any(
+                _nibble_merge(a) for a in vals
+            ):
+                report(sub, "hand-rolled nibble merge in the written value")
+    return iter(out)
