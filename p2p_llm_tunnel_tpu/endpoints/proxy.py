@@ -798,7 +798,7 @@ async def _attempt_resume(
             link2 = candidates[0]
             sid = state.alloc_stream_id()
             q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in BYTES by FLOW credit once resumed (the serve relay stops at INITIAL_CREDIT unacked bytes); pre-resume it holds exactly one RES_RESUMED/ERROR answer
-            link2.pending[sid] = q  # tunnelcheck: disable=TC15  released on every path: refusal/timeout/give-up pop via _probe_answer/_abandon (the finally below sweeps outstanding probes); on success ownership transfers to body_stream, whose finally pops the CURRENT (link, sid)
+            link2.pending[sid] = q
             probes[id(link2)] = (link2, sid, q)
             try:
                 await link2.channel.send(TunnelMessage.res_resume(

@@ -473,7 +473,6 @@ class DetachedStreams:
     """
 
     def __init__(self):
-        # tunnelcheck: disable=TC15  cross-function lifecycle contract: every registration made by DetachedStreams.register is released by StreamRelay._pump's finally (registry.release), which runs on every pump exit path incl. grace expiry and cancellation
         self._detached: Dict[str, StreamRelay] = {}
         self._by_attachment: Dict[int, Dict[int, StreamRelay]] = {}
         self._bytes = 0

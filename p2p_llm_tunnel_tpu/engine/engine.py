@@ -2029,7 +2029,7 @@ class InferenceEngine:
             # the only compiles the tier ever pays.
             page = self._page_out_op(self._pool, jnp.int32(0))
             host = {k: np.asarray(v) for k, v in page.items()}
-            self._pool = self._page_in_op(self._pool, jnp.int32(0), host)
+            self._pool = self._page_in_op(self._pool, jnp.int32(0), host)  # tunnelcheck: disable=TC20  warmup compile round-trip: bytes never leave this process, so the page wire contract (verify_page_pin meta/checksum) has no boundary to guard
         log.info(
             "prefix-cache warmup: copy ops compiled in %.1fs",
             time.monotonic() - t0,
@@ -3291,7 +3291,7 @@ class InferenceEngine:
         ≈ engine_ttft_ms, ISSUE 5 observability)."""
         now = time.monotonic()
         global_metrics.inc("engine_admissions_total", len(admitted))
-        self._flight_admitted += len(admitted)  # tunnelcheck: disable=TC13  engine-loop task is the only writer: _note_admission runs only from the loop's admission paths, and the loop resets the counter at iteration start before any of them can run
+        self._flight_admitted += len(admitted)
         for run in admitted:
             st = self._requests.get(run.request.request_id)
             if st is not None and st.t_admitted is None:
@@ -3341,7 +3341,7 @@ class InferenceEngine:
                     self._segmented[run.slot] = (run, hist)
                     admitted.remove(run)
             if seg_hits:
-                await loop.run_in_executor(  # tunnelcheck: disable=TC07  one call for the WHOLE wave's segment hits; batches internally by prefill_rows
+                await loop.run_in_executor(
                     self._executor, self._prefix_copy_in, seg_hits
                 )
         # Group by (tail bucket, cached?): cached runs use the chunk-prefill
@@ -3519,7 +3519,7 @@ class InferenceEngine:
             # Dispatched before any of the wave's segments (same executor,
             # same device order), so reused history KV is in place when the
             # first tail segment reads it.
-            await loop.run_in_executor(  # tunnelcheck: disable=TC07  ONE batched copy call per admission wave (prefill_rows-batched internally), not per request
+            await loop.run_in_executor(
                 self._executor, self._prefix_copy_in, hits
             )
 
@@ -3826,7 +3826,7 @@ class InferenceEngine:
         if not self._conv_pending:
             return
         pending, self._conv_pending = self._conv_pending, []
-        self._flight_conv = len(pending)  # tunnelcheck: disable=TC13  engine-loop task is the only writer (same single-writer contract as _flight_admitted)
+        self._flight_conv = len(pending)
         await loop.run_in_executor(self._executor, self._conv_insert, pending)
 
     def _memory_exhausted(self) -> bool:
@@ -3873,7 +3873,7 @@ class InferenceEngine:
         plan = pi.spill_plan(batch)
         if not plan:
             return
-        self._spill_inflight += len(plan)  # tunnelcheck: disable=TC13  engine-loop task is the only writer; the executor call below only READS the plan
+        self._spill_inflight += len(plan)
         try:
             results = await loop.run_in_executor(
                 self._executor, self._spill_copy_out, plan
@@ -3890,7 +3890,7 @@ class InferenceEngine:
                 committed += 1
         if committed:
             global_metrics.inc("engine_spill_pageouts_total", committed)
-        self._flight_pageouts = committed  # tunnelcheck: disable=TC13  single-writer: reset by the loop, written here, read at _flight_record
+        self._flight_pageouts = committed
 
     def _spill_copy_out(self, plan) -> List[Tuple[bytes, Optional[Dict], bytes]]:
         """Executor thread: gather each planned page's leaves to host RAM
@@ -3913,7 +3913,7 @@ class InferenceEngine:
             elif fault == "fail":
                 out.append((key, None, b""))
                 continue
-            page = self._page_out_op(self._pool, jnp.int32(idx))  # tunnelcheck: disable=TC07  bounded batch (<= pool_capacity/8) at end of iteration, off the TTFT-critical path — not a per-request loop
+            page = self._page_out_op(self._pool, jnp.int32(idx))
             payload = {k: np.asarray(v) for k, v in page.items()}
             checksum = page_checksum(payload)
             if fault == "corrupt":
@@ -3985,7 +3985,7 @@ class InferenceEngine:
         items = pi.page_in_alloc(wanted[:cap], protect=frozenset(protect))
         if not items:
             return
-        self._spill_inflight += len(items)  # tunnelcheck: disable=TC13  engine-loop task is the only writer; the executor call below only READS the claims
+        self._spill_inflight += len(items)
         try:
             results = await loop.run_in_executor(
                 self._executor, self._spill_copy_in, items
@@ -4002,7 +4002,7 @@ class InferenceEngine:
                 global_metrics.inc("engine_spill_pagein_failures_total")
         if ok_n:
             global_metrics.inc("engine_spill_pageins_total", ok_n)
-        self._flight_pageins = ok_n  # tunnelcheck: disable=TC13  single-writer: reset by the loop, written here, read at _flight_record
+        self._flight_pageins = ok_n
 
     def _spill_copy_in(self, items) -> List[Tuple[bytes, int, bool]]:
         """Executor thread: verify + splice host-tier pages into their
@@ -4046,7 +4046,7 @@ class InferenceEngine:
                             "re-prefill", e)
                 out.append((key, idx, False))
                 continue
-            self._pool = self._page_in_op(  # tunnelcheck: disable=TC07  bounded by the peeked admission wave's own extension demand, ahead of admission — each splice displaces a full page of tail prefill, not a per-request loop
+            self._pool = self._page_in_op(
                 self._pool, jnp.int32(idx),
                 {k: jnp.asarray(v) for k, v in payload.items()},
             )

@@ -683,16 +683,21 @@ def save_pool_snapshot(
     # between the two renames must not pair new pool bytes with the old
     # index (recycled block ids would silently serve another prompt's KV).
     snap_id = f"{time.time_ns():x}"
+    host_pool = {k: np.asarray(v) for k, v in pool.items()}
     npz_tmp = os.path.join(dirpath, ".prefix_pool.npz.tmp")
     with open(npz_tmp, "wb") as f:
         np.savez(
             f,
             __snap_id__=np.frombuffer(snap_id.encode(), np.uint8),
-            **{k: np.asarray(v) for k, v in pool.items()},
+            **host_pool,
         )
     os.replace(npz_tmp, os.path.join(dirpath, "prefix_pool.npz"))
-    manifest = dict(meta, lru=index.export_state(), version=2,
-                    snap_id=snap_id)
+    # version 3 (ISSUE 18): the manifest carries page_checksum over the
+    # pool leaves — the same digest the spill tier verifies per page-in —
+    # so the loader can refuse bytes damaged (or swapped) after the save.
+    manifest = dict(meta, lru=index.export_state(), version=3,
+                    snap_id=snap_id,
+                    pool_checksum=page_checksum(host_pool).hex())
     man_tmp = os.path.join(dirpath, ".prefix_index.json.tmp")
     with open(man_tmp, "w") as f:
         json.dump(manifest, f)
@@ -717,17 +722,21 @@ def load_pool_snapshot(
     except (OSError, json.JSONDecodeError) as e:
         log.warning("prefix snapshot unreadable (%s); starting cold", e)
         return None
-    if manifest.get("version") != 2:
-        log.warning("prefix snapshot version %r unsupported (current: 2); starting cold",
+    if manifest.get("version") != 3:
+        # Version 2 manifests carry no pool_checksum: their bytes are
+        # unverifiable, so they are refused rather than grandfathered.
+        log.warning("prefix snapshot version %r unsupported (current: 3); starting cold",
                     manifest.get("version"))
         return None
-    for key, want in meta.items():
-        if manifest.get(key) != want:
-            log.warning(
-                "prefix snapshot incompatible (%s: %r != %r); starting cold",
-                key, manifest.get(key), want,
-            )
-            return None
+    try:
+        # The manifest IS the snapshot's pin metadata; route it through
+        # THE registered tier-boundary check (TC18/TC20) rather than an
+        # inline comparison, so the snapshot import obeys the same page
+        # wire contract as every spill-tier page-in.
+        verify_page_pin(None, manifest, meta)
+    except PagePinError as e:
+        log.warning("prefix snapshot incompatible (%s); starting cold", e)
+        return None
     try:
         npz = np.load(npz_path)
         files = set(npz.files)
@@ -744,14 +753,28 @@ def load_pool_snapshot(
     if files - {"__snap_id__"} != set(pool):
         log.warning("prefix snapshot leaves mismatch; starting cold")
         return None
-    out = {}
+    host = {}
     for key, arr in pool.items():
-        loaded = npz[key]
+        try:
+            loaded = npz[key]
+        except Exception as e:  # corrupt zip member surfaces on read
+            log.warning("prefix snapshot unreadable (%s); starting cold", e)
+            return None
         if loaded.shape != arr.shape:
             log.warning("prefix snapshot shape mismatch on %s; starting cold",
                         key)
             return None
-        out[key] = jnp.asarray(loaded, arr.dtype)
+        host[key] = loaded
+    # Integrity gate (ISSUE 18): recompute the save-time digest over the
+    # bytes we actually read.  The zip CRC only catches in-member rot;
+    # a rewritten/swapped npz passes it — page_checksum is end-to-end.
+    got = page_checksum(host).hex()
+    if got != manifest.get("pool_checksum"):
+        log.warning("prefix snapshot pool checksum mismatch (%s != %s); "
+                    "starting cold", got, manifest.get("pool_checksum"))
+        return None
+    out = {key: jnp.asarray(host[key], arr.dtype)
+           for key, arr in pool.items()}
     index.import_state(manifest.get("lru", []))
     log.info("prefix pool snapshot restored: %d blocks from %s",
              len(index._lru), dirpath)

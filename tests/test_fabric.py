@@ -52,7 +52,7 @@ def _stub_link(ps: PeerSet, pid: str, state: str = PEER_LIVE,
     link.ready = True
     link.state = state
     for i in range(inflight):
-        link.pending[i] = asyncio.Queue()  # tunnelcheck: disable=TC10  test stub: fixed-size fake inflight set
+        link.pending[i] = asyncio.Queue()
     ps.peers[pid] = link
     return link
 
@@ -123,7 +123,7 @@ def test_mark_dead_aborts_pending_with_typed_error():
     async def main():
         ps = PeerSet()
         link = _stub_link(ps, "a")
-        q: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test stub
+        q: asyncio.Queue = asyncio.Queue()
         link.pending[7] = q
         ps.mark_dead(link, TunnelMessage.typed_error(
             0, "peer_lost", "tunnel closed"))
@@ -381,7 +381,7 @@ class _FakeWs:
     remote_address = ("127.0.0.1", 4242)
 
     def __init__(self):
-        self.inbox: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test driver: scripted handful of messages
+        self.inbox: asyncio.Queue = asyncio.Queue()
         self.sent = []
 
     def __aiter__(self):
@@ -566,7 +566,7 @@ from p2p_llm_tunnel_tpu.transport import fabric as fabric_mod  # noqa: E402
 
 class _FakeSignalClient:
     def __init__(self):
-        self.rx: asyncio.Queue = asyncio.Queue()  # tunnelcheck: disable=TC10  test driver: scripted handful of messages
+        self.rx: asyncio.Queue = asyncio.Queue()
         self.closed = False
         self.role = ""
         self.reply_to = ""

@@ -626,3 +626,109 @@ def test_engine_prefix_concurrent_batch():
         return [first] + list(outs)
 
     assert asyncio.run(run(True)) == asyncio.run(run(False))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18 drive-by: snapshot integrity on the tier-residency import path.
+# The PR 16 importer verified the manifest pins and the snap_id pairing but
+# spliced the POOL BYTES themselves unverified — a snapshot whose npz was
+# damaged (or swapped) after the save splices silently, serving corrupted
+# KV.  The fix mirrors the spill tier's page contract: the manifest carries
+# a page_checksum over the pool leaves, the loader recomputes it before
+# splicing, and the pin loop routes through verify_page_pin — THE
+# registered boundary check (TC18/TC20) — instead of an inline comparison.
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_fixture(tmp_path):
+    """A synthetic saved snapshot: 2-leaf pool + 1-page index."""
+    from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+        load_pool_snapshot,
+        save_pool_snapshot,
+    )
+
+    pool = {
+        "k": jnp.arange(64, dtype=jnp.float32).reshape(4, 16),
+        "v": jnp.arange(64, 128, dtype=jnp.float32).reshape(4, 16),
+    }
+    index = PrefixIndex(block=16, capacity=4)
+    index.import_state([["clock", 0.0], ["ab" * 16, 1, 2.0, 0, 2.0]])
+    meta = {"quant": "none", "kv_quant": "off", "group_size": 128}
+    save_pool_snapshot(str(tmp_path), pool, index, meta)
+    return pool, meta, load_pool_snapshot, save_pool_snapshot
+
+
+def test_pool_snapshot_roundtrip_direct(tmp_path):
+    """Control: an untouched snapshot restores bytes AND index."""
+    pool, meta, load, _save = _snapshot_fixture(tmp_path)
+    fresh = PrefixIndex(block=16, capacity=4)
+    out = load(str(tmp_path), {k: jnp.zeros_like(v) for k, v in pool.items()},
+               fresh, meta)
+    assert out is not None
+    for key in pool:
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(pool[key]))
+    assert len(fresh._lru) == 1
+
+
+def test_pool_snapshot_rejects_corrupt_pool_bytes(tmp_path):
+    """A snapshot whose pool bytes were altered AFTER the save — same
+    shapes, same snap_id, a legitimately re-written npz so no zip-level
+    error fires — must start cold, not splice damaged KV."""
+    import os
+
+    pool, meta, load, _save = _snapshot_fixture(tmp_path)
+    npz_path = os.path.join(str(tmp_path), "prefix_pool.npz")
+    with np.load(npz_path) as npz:
+        arrays = {k: npz[k].copy() for k in npz.files}
+    arrays["k"][1, 3] += 1.0  # one flipped element
+    with open(npz_path, "wb") as f:
+        np.savez(f, **arrays)
+
+    fresh = PrefixIndex(block=16, capacity=4)
+    out = load(str(tmp_path), {k: jnp.zeros_like(v) for k, v in pool.items()},
+               fresh, meta)
+    assert out is None, "corrupt pool bytes must not splice"
+    assert len(fresh._lru) == 0, "index must stay untouched on refusal"
+
+
+def test_pool_snapshot_rejects_pre_checksum_manifest_version(tmp_path):
+    """A version-2 (pre-checksum) manifest has no pool_checksum to verify
+    — the loader must refuse it rather than trust unverifiable bytes."""
+    import json as _json
+    import os
+
+    pool, meta, load, _save = _snapshot_fixture(tmp_path)
+    man_path = os.path.join(str(tmp_path), "prefix_index.json")
+    with open(man_path) as f:
+        manifest = _json.load(f)
+    assert manifest["version"] == 3
+    assert "pool_checksum" in manifest
+    manifest["version"] = 2
+    del manifest["pool_checksum"]
+    with open(man_path, "w") as f:
+        _json.dump(manifest, f)
+
+    fresh = PrefixIndex(block=16, capacity=4)
+    out = load(str(tmp_path), {k: jnp.zeros_like(v) for k, v in pool.items()},
+               fresh, meta)
+    assert out is None
+
+
+def test_pool_snapshot_pin_loop_routes_verify_page_pin(tmp_path, monkeypatch):
+    """Runtime agreement with the static rules: the loader's compatibility
+    gate IS verify_page_pin (the TC18/TC20 registered check), not an inline
+    reimplementation — a monkeypatched always-refuse pin check must force a
+    cold start even on a pristine snapshot."""
+    from p2p_llm_tunnel_tpu.engine import prefix_cache as pc
+
+    pool, meta, load, _save = _snapshot_fixture(tmp_path)
+
+    def refuse(page, m, want):
+        raise pc.PagePinError("refused by test")
+
+    monkeypatch.setattr(pc, "verify_page_pin", refuse)
+    fresh = PrefixIndex(block=16, capacity=4)
+    out = load(str(tmp_path), {k: jnp.zeros_like(v) for k, v in pool.items()},
+               fresh, meta)
+    assert out is None

@@ -2820,14 +2820,14 @@ def test_sarif_includes_tc00(tmp_path):
 
 def test_list_rules_pinned_against_code_and_readme(capsys):
     """Rule-id drift (docs vs code) fails fast: --list-rules must show
-    exactly TC00..TC19, every runnable rule must have a summary, and the
+    exactly TC00..TC21, every runnable rule must have a summary, and the
     README rule table must carry a row for every rule."""
     from tools.tunnelcheck.core import RULE_SUMMARIES, all_rules
 
     assert tunnelcheck_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     listed = [line.split()[0] for line in out.strip().splitlines()]
-    assert listed == [f"TC{i:02d}" for i in range(20)]
+    assert listed == [f"TC{i:02d}" for i in range(22)]
     assert set(all_rules()) | {"TC00"} == set(RULE_SUMMARIES)
 
     readme = (REPO_ROOT / "README.md").read_text(encoding="utf-8")
@@ -3206,3 +3206,608 @@ def test_tc19_kv_write_paths_self_run_clean():
     active, waived = run_paths(files, rules=["TC19"])
     assert active == []
     assert rules_of(waived) == []
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural summary engine (ISSUE 18 tentpole) — unit tests against
+# dataflow.interproc_taint directly: transfer functions, fixpoint
+# termination, and the depth bound.
+# ---------------------------------------------------------------------------
+
+
+def _interproc_engine(tmp_path, code, *, on_sink_calls=("sink",),
+                      max_depth=4):
+    """Build an InterprocTaint over one fixture module under a toy policy:
+    ``taint_src()`` is THE source, ``clean()`` THE sanitizer, ``sink()``'s
+    first argument THE sink."""
+    import ast as _ast
+
+    from tools.tunnelcheck.callgraph import CallGraph
+    from tools.tunnelcheck.core import load_source
+    from tools.tunnelcheck.dataflow import (
+        TaintPolicy,
+        call_name,
+        interproc_taint,
+    )
+
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(code))
+    sf, err = load_source(f)
+    assert err is None
+
+    def is_source(expr):
+        return isinstance(expr, __import__("ast").Call) and \
+            call_name(expr) == "taint_src"
+
+    def sink_args(call):
+        if call_name(call) in on_sink_calls and call.args:
+            return [(call.args[0], f"the `{call_name(call)}` sink")]
+        return []
+
+    policy = TaintPolicy(
+        is_source=is_source,
+        sanitizers=frozenset({"clean"}),
+        seed_params=frozenset(),
+        sink_args=sink_args,
+        sink_assign=lambda node: [],
+    )
+    graph = CallGraph([sf])
+    return interproc_taint(graph, policy, max_depth=max_depth), graph
+
+
+def _summary(engine, graph, name):
+    node = graph.by_name[name][0].node
+    s = engine.summary_for(node)
+    assert s is not None
+    return s
+
+
+def test_interproc_summary_param_to_return_transfer(tmp_path):
+    engine, graph = _interproc_engine(
+        tmp_path,
+        """
+        def ident(x):
+            return x
+
+        def fresh(x):
+            return 1
+
+        def srcfn():
+            return taint_src()
+
+        def laundered(x):
+            return clean(x)
+        """,
+    )
+    from tools.tunnelcheck.dataflow import SRC
+
+    assert _summary(engine, graph, "ident").ret == {"x"}
+    assert _summary(engine, graph, "fresh").ret == set()
+    assert _summary(engine, graph, "srcfn").ret == {SRC}
+    # The sanitizer's RESULT is clean whatever it read: the registered-
+    # sanitizer contract, applied at the summary level.
+    assert _summary(engine, graph, "laundered").ret == set()
+
+
+def test_interproc_sink_params_and_cross_function_report(tmp_path):
+    engine, graph = _interproc_engine(
+        tmp_path,
+        """
+        def stamp(v):
+            sink(v)
+
+        def top():
+            stamp(taint_src())
+        """,
+    )
+    s = _summary(engine, graph, "stamp")
+    assert set(s.sink_params) == {"v"}
+    hits = []
+    engine.analyze(graph.by_name["top"][0].node,
+                   on_sink=lambda node, d: hits.append((node.lineno, d)))
+    assert len(hits) == 1
+    # The report lands at top's CALL to stamp and names the chain.
+    assert "via `stamp()`" in hits[0][1]
+
+
+def test_interproc_fixpoint_terminates_on_mutual_recursion(tmp_path):
+    engine, graph = _interproc_engine(
+        tmp_path,
+        """
+        def ping(x):
+            return pong(x)
+
+        def pong(x):
+            if x:
+                return ping(x)
+            return x
+
+        def forever_a(x):
+            return forever_b(x)
+
+        def forever_b(x):
+            return forever_a(x)
+        """,
+    )
+    # Monotone-from-empty: summaries only grow, so the iteration stops at
+    # the fixpoint within the depth bound instead of chasing the cycle.
+    assert engine.rounds <= engine.max_depth
+    # A cycle with NO base case never returns its argument — the empty
+    # summary is the semantically correct answer, not a missed fact.
+    assert _summary(engine, graph, "forever_a").ret == set()
+    # A cycle WITH a base case transfers its parameter through both hops.
+    assert _summary(engine, graph, "ping").ret == {"x"}
+    assert _summary(engine, graph, "pong").ret == {"x"}
+
+
+def test_interproc_depth_bound_caps_chain_length(tmp_path):
+    chain = """
+        def h5(x):
+            return x
+
+        def h4(x):
+            return h5(x)
+
+        def h3(x):
+            return h4(x)
+
+        def h2(x):
+            return h3(x)
+
+        def h1(x):
+            return h2(x)
+        """
+    shallow, graph_s = _interproc_engine(tmp_path, chain, max_depth=2)
+    assert _summary(shallow, graph_s, "h1").ret == set()
+    deep, graph_d = _interproc_engine(tmp_path, chain, max_depth=8)
+    assert _summary(deep, graph_d, "h1").ret == {"x"}
+    # 5 hops resolve in ~5 rounds + 1 no-change round, never the full 8.
+    assert deep.rounds <= 7
+
+
+# ---------------------------------------------------------------------------
+# TC20 — extracted page bytes must pass verify_page_pin before any
+# tunnel send / tier write / splice (interprocedural)
+# ---------------------------------------------------------------------------
+
+
+def test_tc20_extracted_page_sent_flags(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def evict(self, idx):
+            page = self._page_out_op(self._pool, idx)
+            self._link.send_bytes(page)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert rules_of(active) == ["TC20"]
+    assert "verify_page_pin" in active[0].message
+
+
+def test_tc20_cross_function_laundering_flags_at_call_site(tmp_path):
+    """The boundary-crossing shape TC18 cannot see: extraction in one
+    function, the send hidden inside a helper."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Tier:
+            def ship(self, link, page):
+                link.send_bytes(page)
+
+            def evict(self, link, idx):
+                page = self._page_out_op(self._pool, idx)
+                self.ship(link, page)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert rules_of(active) == ["TC20"]
+    assert "via `ship()`" in active[0].message
+    assert "self.ship(link, page)" in (tmp_path / SPILL_FIXTURE).read_text(
+    ).splitlines()[active[0].line - 1]
+
+
+def test_tc20_cross_function_sanitizer_clears(tmp_path):
+    """verify_page_pin inside a helper launders for every caller: the
+    summary records the cleared return, not the raw parameter."""
+    active, _ = check(
+        tmp_path,
+        """
+        class Tier:
+            def pin(self, page):
+                return verify_page_pin(page, self._meta, self._want)
+
+            def evict(self, link, idx):
+                page = self._page_out_op(self._pool, idx)
+                link.send_bytes(self.pin(page))
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert active == []
+
+
+def test_tc20_call_graph_cycle_terminates_and_flags(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        class Tier:
+            def hop_a(self, link, page, n):
+                if n:
+                    self.hop_b(link, page, n - 1)
+                link.send_bytes(page)
+
+            def hop_b(self, link, page, n):
+                self.hop_a(link, page, n)
+
+            def evict(self, link, idx):
+                page = self._page_out_op(self._pool, idx)
+                self.hop_b(link, page, 2)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert rules_of(active) == ["TC20"]
+
+
+def test_tc20_payload_receiver_heuristic(tmp_path):
+    """``spill_page.payload`` is page bytes; ``msg.payload`` is frame
+    plumbing — only receivers named like pages seed the taint, so the
+    signaling/frames layer's ubiquitous payload fields stay silent."""
+    active, _ = check(
+        tmp_path,
+        """
+        def drain(self, spill_page, key):
+            self._index.note_spilled(key, spill_page.payload)
+
+        def pump(self, msg):
+            self._link.send_bytes(msg.payload)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert rules_of(active) == ["TC20"]
+    assert active[0].message.count("tier write") == 1
+
+
+def test_tc20_waiver_and_out_of_scope(tmp_path):
+    code = """
+        def evict(self, idx):
+            page = self._page_out_op(self._pool, idx)
+            self._link.send_bytes(page)  # tunnelcheck: disable=TC20  loopback self-test: bytes re-enter this process through the same pins
+        """
+    active, waived = check(tmp_path, code, filename=SPILL_FIXTURE,
+                           rules=["TC20"])
+    assert active == []
+    assert rules_of(waived) == ["TC20"]
+    active, _ = check(tmp_path, code, filename="elsewhere/spill.py",
+                      rules=["TC20"])
+    assert active == []
+
+
+def test_tc20_meta_fixture_stripped_real_chain_flags():
+    """Acceptance meta-fixture: take the ENGINE'S real page-in chain
+    (_spill_copy_in), strip the verify_page_pin reassignment, and TC20
+    must fire — proof the rule guards the production shape, not a toy.
+    The unstripped copy is the control: clean with zero waivers."""
+    import ast as _ast
+    import tempfile
+
+    src = (REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "engine.py"
+           ).read_text(encoding="utf-8")
+    fn = next(
+        n for n in _ast.walk(_ast.parse(src))
+        if isinstance(n, _ast.FunctionDef) and n.name == "_spill_copy_in"
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        active, _ = check(Path(td), _ast.unparse(fn),
+                          filename=SPILL_FIXTURE, rules=["TC20"])
+        assert active == [], "the real chain must be clean as shipped"
+
+    class StripPin(_ast.NodeTransformer):
+        def visit_Assign(self, node):
+            if (isinstance(node.value, _ast.Call)
+                    and isinstance(node.value.func, _ast.Name)
+                    and node.value.func.id == "verify_page_pin"):
+                return None
+            return node
+
+    stripped = _ast.fix_missing_locations(StripPin().visit(fn))
+    with tempfile.TemporaryDirectory() as td:
+        active, _ = check(Path(td), _ast.unparse(stripped),
+                          filename=SPILL_FIXTURE, rules=["TC20"])
+        assert rules_of(active) == ["TC20"]
+        assert "splice" in active[0].message
+
+
+def test_tc20_registries_match_runtime():
+    """Runtime agreement: the sanitizer TC20 credits and the extraction /
+    tier-write names it watches are the REAL prefix_cache symbols — the
+    static model cannot drift from what the runtime enforces."""
+    from p2p_llm_tunnel_tpu.engine import prefix_cache
+    from tools.tunnelcheck import rules_tierpin as rt
+
+    for name in rt.SANITIZERS:
+        assert callable(getattr(prefix_cache, name)), name
+    assert hasattr(prefix_cache.PrefixIndex, "export_state")
+    for name in rt.TIER_WRITE_CALLS:
+        assert callable(getattr(prefix_cache.PrefixIndex, name)), name
+
+
+def test_tc20_engine_and_prefix_cache_self_run():
+    """The shipped extraction->boundary paths pass TC20 with only the
+    documented warmup waiver (engine.py's compile round-trip)."""
+    eng = REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "engine.py"
+    pfx = REPO_ROOT / "p2p_llm_tunnel_tpu" / "engine" / "prefix_cache.py"
+    active, waived = run_paths([eng, pfx], rules=["TC20"])
+    assert active == []
+    assert rules_of(waived) == ["TC20"]
+
+
+# ---------------------------------------------------------------------------
+# TC21 — interprocedural header taint (TC14 across function boundaries)
+# ---------------------------------------------------------------------------
+
+TAINT21_FIXTURE = "p2p_llm_tunnel_tpu/endpoints/fixture_taint21.py"
+
+
+def test_tc21_extraction_helper_flags_at_call_site(tmp_path):
+    """The pre-PR-7 minting hole one call deep: a helper RETURNS the raw
+    header value, so TC14's flat lattice sees a clean call result."""
+    active, _ = check(
+        tmp_path,
+        """
+        def grab(req):
+            return req.headers.get("x-tunnel-tenant", "")
+
+        def admit(req, sched):
+            sched.tenant_begin(grab(req))
+        """,
+        filename=TAINT21_FIXTURE,
+        rules=["TC14", "TC21"],
+    )
+    assert rules_of(active) == ["TC21"]
+    assert "helper" in active[0].message
+
+
+def test_tc21_stamping_helper_flags_at_call_site(tmp_path):
+    """The dual shape: the SINK hides inside the helper."""
+    active, _ = check(
+        tmp_path,
+        """
+        def stamp(kw, raw):
+            kw["tenant"] = raw
+
+        def admit(req, kw):
+            stamp(kw, req.headers.get("x-tunnel-tenant", ""))
+        """,
+        filename=TAINT21_FIXTURE,
+        rules=["TC14", "TC21"],
+    )
+    assert rules_of(active) == ["TC21"]
+
+
+def test_tc21_sanitized_helper_is_clean(tmp_path):
+    active, _ = check(
+        tmp_path,
+        """
+        def grab(req):
+            return parse_tenant(req.headers.get("x-tunnel-tenant", ""))
+
+        def admit(req, sched):
+            sched.tenant_begin(grab(req))
+        """,
+        filename=TAINT21_FIXTURE,
+        rules=["TC14", "TC21"],
+    )
+    assert active == []
+
+
+def test_tc21_does_not_duplicate_tc14_findings(tmp_path):
+    """Same-line flows belong to TC14; TC21 reporting them too would
+    double every waiver in the tree."""
+    active, _ = check(
+        tmp_path,
+        """
+        def admit(req, sched):
+            sched.tenant_begin(req.headers.get("x-tunnel-tenant", ""))
+        """,
+        filename=TAINT21_FIXTURE,
+        rules=["TC14", "TC21"],
+    )
+    assert rules_of(active) == ["TC14"]
+
+
+def test_tc21_waiver_and_cycle(tmp_path):
+    active, waived = check(
+        tmp_path,
+        """
+        def bounce(req, sched, n):
+            if n:
+                relay(req, sched, n - 1)
+            return req.headers.get("x-t", "")
+
+        def relay(req, sched, n):
+            sched.tenant_begin(bounce(req, sched, n))  # tunnelcheck: disable=TC21  herd-test harness: headers are fixture constants
+        """,
+        filename=TAINT21_FIXTURE,
+        rules=["TC14", "TC21"],
+    )
+    assert active == []
+    assert rules_of(waived) == ["TC21"]
+
+
+def test_tc21_package_self_run_is_clean():
+    pkg = REPO_ROOT / "p2p_llm_tunnel_tpu"
+    active, _ = run_paths([pkg], rules=["TC21"])
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# Per-file result cache (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_cold_then_warm_same_results(tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(
+        """
+        import time
+
+        async def handler():
+            time.sleep(1)
+            time.sleep(2)  # tunnelcheck: disable=TC01  fixture
+        """
+    ))
+    cache = tmp_path / "cache"
+    stats_cold: dict = {}
+    a_cold, w_cold = run_paths([f], rules=["TC01"], stats=stats_cold,
+                               cache_dir=cache)
+    assert stats_cold["cache_misses"] == 1
+    assert stats_cold["cache_hits"] == 0
+    stats_warm: dict = {}
+    a_warm, w_warm = run_paths([f], rules=["TC01"], stats=stats_warm,
+                               cache_dir=cache)
+    assert stats_warm["cache_hits"] == 1
+    assert stats_warm["cache_misses"] == 0
+    # The warm partition is IDENTICAL, waived findings included.
+    assert [(v.rule, v.line) for v in a_warm] == \
+        [(v.rule, v.line) for v in a_cold]
+    assert [(v.rule, v.line) for v in w_warm] == \
+        [(v.rule, v.line) for v in w_cold]
+
+
+def test_cache_invalidated_by_any_edit(tmp_path):
+    """The key commits to the WHOLE tree digest: interprocedural rules
+    make per-file isolation unsound, so editing one file must invalidate
+    every entry — honest, not clever."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = 2\n")
+    cache = tmp_path / "cache"
+    run_paths([a, b], rules=["TC01"], stats={}, cache_dir=cache)
+    stats: dict = {}
+    run_paths([a, b], rules=["TC01"], stats=stats, cache_dir=cache)
+    assert stats["cache_hits"] == 2
+    b.write_text("y = 3\n")
+    stats = {}
+    run_paths([a, b], rules=["TC01"], stats=stats, cache_dir=cache)
+    assert stats["cache_hits"] == 0
+    assert stats["cache_misses"] == 2
+
+
+def test_cache_keyed_on_selected_rules(tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+    cache = tmp_path / "cache"
+    run_paths([f], rules=["TC01"], stats={}, cache_dir=cache)
+    stats: dict = {}
+    active, _ = run_paths([f], rules=["TC05"], stats=stats, cache_dir=cache)
+    assert stats["cache_hits"] == 0  # different rule set, different key
+    assert active == []
+
+
+# ---------------------------------------------------------------------------
+# Waiver audit (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_waiver_audit_flags_stale_and_keeps_live(tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(
+        """
+        import time
+
+        async def handler():
+            time.sleep(1)  # tunnelcheck: disable=TC01  live: suppresses a real finding
+            x = 1  # tunnelcheck: disable=TC01  stale: nothing fires here
+        """
+    ))
+    audit: list = []
+    active, waived = run_paths([f], rules=["TC01"], waiver_audit=audit)
+    assert active == []
+    assert rules_of(waived) == ["TC01"]
+    assert len(audit) == 1
+    path, line, msg = audit[0]
+    assert line == 6 and "stale waiver" in msg and "TC01" in msg
+
+
+def test_waiver_audit_unknown_rule_id_always_reported(tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text("x = 1  # tunnelcheck: disable=TC99  typo'd id\n")
+    audit: list = []
+    run_paths([f], rules=["TC01"], waiver_audit=audit)
+    assert len(audit) == 1
+    assert "unknown rule" in audit[0][2] and "TC99" in audit[0][2]
+
+
+def test_waiver_audit_stale_file_waiver(tmp_path):
+    f = tmp_path / "snippet.py"
+    f.write_text("# tunnelcheck: disable-file=TC01\nx = 1\n")
+    audit: list = []
+    run_paths([f], rules=["TC01"], waiver_audit=audit)
+    assert len(audit) == 1
+    assert "file waiver" in audit[0][2] and audit[0][1] == 1
+
+
+def test_waiver_audit_skips_unselected_rules(tmp_path):
+    """A subset run cannot judge a waiver for a rule it didn't execute —
+    silence, not a false stale report."""
+    f = tmp_path / "snippet.py"
+    f.write_text("x = 1  # tunnelcheck: disable=TC05  judged only when TC05 runs\n")
+    audit: list = []
+    run_paths([f], rules=["TC01"], waiver_audit=audit)
+    assert audit == []
+    audit = []
+    run_paths([f], rules=["TC05"], waiver_audit=audit)
+    assert len(audit) == 1
+
+
+def test_waiver_audit_shipped_tree_has_no_stale_waivers():
+    """Waiver hygiene as an invariant: every `# tunnelcheck: disable=`
+    comment in the tree suppresses a finding that actually fires (the
+    16 dead comments found when the audit landed are gone)."""
+    audit: list = []
+    run_paths(
+        [REPO_ROOT / "p2p_llm_tunnel_tpu", REPO_ROOT / "scripts",
+         REPO_ROOT / "tests", REPO_ROOT / "bench.py",
+         REPO_ROOT / "__graft_entry__.py"],
+        waiver_audit=audit,
+    )
+    assert audit == [], f"stale waivers: {audit}"
+
+
+# ---------------------------------------------------------------------------
+# CLI: wall-time budget + cache/audit plumbing (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_budget_gate(tmp_path, capsys):
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    assert tunnelcheck_main([str(f), "--budget-s", "600"]) == 0
+    capsys.readouterr()
+    assert tunnelcheck_main([str(f), "--budget-s", "0"]) == 1
+    err = capsys.readouterr().err
+    assert "exceeded" in err and "budget" in err
+
+
+def test_cli_cache_and_audit_summary(tmp_path, capsys):
+    f = tmp_path / "snippet.py"
+    f.write_text("x = 1  # tunnelcheck: disable=TC99  typo\n")
+    cache = tmp_path / "cache"
+    args = [str(f), "--cache", str(cache), "--waiver-audit"]
+    assert tunnelcheck_main(args) == 0
+    err = capsys.readouterr().err
+    assert "0 hit(s) 1 miss(es)" in err
+    assert "1 stale waiver(s)" in err
+    assert "waiver-audit: waiver names unknown rule `TC99`" in err
+    assert tunnelcheck_main(args) == 0
+    err = capsys.readouterr().err
+    assert "1 hit(s) 0 miss(es)" in err
+    # The audit still reports from the CACHED entry's re-parse.
+    assert "1 stale waiver(s)" in err

@@ -6,7 +6,12 @@ Exit status: 0 = clean, 1 = violations, 2 = usage error.
 picks the CPU count); ``--changed-only`` restricts FINDINGS to files git
 reports as changed while the whole path set still feeds cross-file
 context; ``--sarif out.json`` writes the machine-consumable SARIF 2.1.0
-log alongside the human output.
+log alongside the human output; ``--cache DIR`` enables the per-file
+result cache (a warm no-change run skips the whole check phase);
+``--waiver-audit`` prints stale ``# tunnelcheck: disable=`` comments as
+warnings (never exit-code-affecting); ``--budget-s N`` fails the run when
+wall time exceeds the budget, so an interprocedural regression cannot
+silently slow the dev loop.
 
 The printed summary and the exit code are computed from the SAME
 violation list — TC00 parse errors included — so they can never disagree
@@ -94,6 +99,27 @@ def main(argv=None) -> int:
         help="also write findings (waived included, as suppressed results) "
         "as a SARIF 2.1.0 log",
     )
+    parser.add_argument(
+        "--cache",
+        metavar="DIR",
+        help="per-file result cache directory (keyed on file content, the "
+        "rule-module digest, and the whole-tree digest — interprocedural "
+        "rules make per-file isolation unsound, so any edit invalidates "
+        "everything); ignored with --changed-only",
+    )
+    parser.add_argument(
+        "--waiver-audit",
+        action="store_true",
+        help="warn about `# tunnelcheck: disable=` comments whose rule no "
+        "longer fires on the annotated statement (stale-waiver rot); "
+        "warnings never affect the exit code",
+    )
+    parser.add_argument(
+        "--budget-s",
+        type=float,
+        metavar="SECONDS",
+        help="fail (exit 1) when the run's wall time exceeds this budget",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -152,9 +178,12 @@ def main(argv=None) -> int:
 
     root = Path.cwd()
     stats: dict = {}
+    audit: Optional[list] = [] if args.waiver_audit else None
+    cache_dir = Path(args.cache) if args.cache else None
     t0 = time.monotonic()
     active, waived = run_paths(
         paths, rules=selected, stats=stats, jobs=jobs, restrict=restrict,
+        cache_dir=cache_dir, waiver_audit=audit,
     )
     elapsed = time.monotonic() - t0
     for v in active:
@@ -162,6 +191,14 @@ def main(argv=None) -> int:
     if args.show_waived:
         for v in waived:
             print(f"{v.render(root)} [waived]")
+    if audit:
+        for path, line, msg in audit:
+            p = path
+            try:
+                p = path.relative_to(root)
+            except ValueError:
+                pass
+            print(f"{p}:{line}: waiver-audit: {msg}", file=sys.stderr)
 
     if args.sarif:
         from tools.tunnelcheck.sarif import write_sarif
@@ -173,12 +210,27 @@ def main(argv=None) -> int:
         if restrict is not None
         else f"{stats.get('files', 0)}"
     )
+    cache_note = ""
+    if cache_dir is not None and restrict is None:
+        cache_note = (
+            f", cache: {stats.get('cache_hits', 0)} hit(s) "
+            f"{stats.get('cache_misses', 0)} miss(es)"
+        )
     summary = (
         f"tunnelcheck: {len(active)} violation(s), {len(waived)} waived, "
         f"{checked} file(s) scanned in {elapsed:.2f}s"
-        f" ({jobs} job(s))"
+        f" ({jobs} job(s){cache_note})"
     )
+    if audit:
+        summary += f" [{len(audit)} stale waiver(s)]"
     print(summary, file=sys.stderr)
+    if args.budget_s is not None and elapsed > args.budget_s:
+        print(
+            f"tunnelcheck: error: wall time {elapsed:.2f}s exceeded the "
+            f"--budget-s {args.budget_s:g}s budget",
+            file=sys.stderr,
+        )
+        return 1
     return 1 if active else 0
 
 

@@ -9,8 +9,11 @@ of the code, never by importing it.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
+import json
 import re
+import sys
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -311,6 +314,8 @@ class ProjectContext:
         self.files = list(files)
         self._callgraph = None
         self._attr_counts: Optional[Dict[str, int]] = None
+        self._scoped_graphs: Dict[str, object] = {}
+        self._interproc: Dict[str, object] = {}
 
         frames = _parse_registry_file(
             "p2p_llm_tunnel_tpu/protocol/frames.py", self.files
@@ -364,6 +369,31 @@ class ProjectContext:
                 sf.tree for sf in self.files
             )
         return self._attr_counts.get(attr, 0)
+
+    def scoped_callgraph(self, scope_part: str):
+        """Call graph restricted to files whose path contains
+        ``scope_part`` — the interprocedural rules analyze the package,
+        not the tests/fixtures that happen to share a scan."""
+        got = self._scoped_graphs.get(scope_part)
+        if got is None:
+            from tools.tunnelcheck.callgraph import CallGraph
+
+            got = CallGraph([
+                sf for sf in self.files
+                if scope_part in sf.path.as_posix()
+            ])
+            self._scoped_graphs[scope_part] = got
+        return got
+
+    def interproc(self, key: str, build):
+        """Memoized interprocedural fixpoint shared across the per-file
+        rule passes of one run (and warmed before the fork in parallel
+        runs, like :attr:`callgraph`)."""
+        got = self._interproc.get(key)
+        if got is None:
+            got = build()
+            self._interproc[key] = got
+        return got
 
 
 
@@ -428,6 +458,8 @@ def all_rules() -> Dict[str, "object"]:
         "TC17": rules_warmup.check_tc17,
         "TC18": rules_tierpin.check_tc18,
         "TC19": rules_kvalign.check_tc19,
+        "TC20": rules_tierpin.check_tc20,
+        "TC21": rules_taint.check_tc21,
     }
 
 
@@ -452,7 +484,209 @@ RULE_SUMMARIES = {
     "TC17": "dispatch-site program kind unreachable from the warmup/AOT plan generators (mid-serve cold-compile hole)",
     "TC18": "KV page bytes spliced into a device pool without the registered tier-boundary pin check (verify_page_pin)",
     "TC19": "packed-KV write outside the byte-aligned helpers (pack_int4 -> buffer write, or hand-rolled nibble merge)",
+    "TC20": "extracted KV page bytes reach a tunnel send / tier write / splice without verify_page_pin on every path (interprocedural)",
+    "TC21": "client-controlled header/body bytes laundered through helper functions reach a trusted sink (interprocedural TC14)",
 }
+
+
+# ---------------------------------------------------------------------------
+# Per-file result cache (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: Entries kept before the oldest are evicted — a soft cap so an abandoned
+#: cache dir cannot grow without bound across branch switches.
+_CACHE_MAX_ENTRIES = 4096
+
+
+def _rules_digest() -> str:
+    """Content hash of every module in tools/tunnelcheck plus the Python
+    version: editing ANY rule or substrate file invalidates the whole
+    cache, which is what keeps the self-run-clean gate honest."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(f"py{sys.version_info[0]}.{sys.version_info[1]}".encode())
+    pkg = Path(__file__).resolve().parent
+    for f in sorted(pkg.glob("*.py")):
+        h.update(f.name.encode())
+        try:
+            h.update(f.read_bytes())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def _file_sha(path: Path) -> Optional[str]:
+    try:
+        return hashlib.blake2b(path.read_bytes(), digest_size=16).hexdigest()
+    except OSError:
+        return None
+
+
+def _cache_base(scan: Sequence[Tuple[Path, Optional[str]]],
+                selected_key: str) -> str:
+    """Digest of the ENTIRE scanned tree (paths + content hashes) plus the
+    rule modules and selected-rule set.  Interprocedural rules make
+    per-file isolation unsound — a helper edited in one file changes the
+    findings in its callers — so a single changed file invalidates every
+    entry.  The warm run this accelerates is the common one: nothing
+    changed since the last ``make lint``."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(_rules_digest().encode())
+    h.update(selected_key.encode())
+    for path, sha in scan:
+        h.update(path.as_posix().encode())
+        h.update((sha or "<unreadable>").encode())
+    return h.hexdigest()
+
+
+def _cache_entry_path(cache_dir: Path, base: str, path: Path,
+                      sha: Optional[str]) -> Path:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(base.encode())
+    h.update(path.as_posix().encode())
+    h.update((sha or "<unreadable>").encode())
+    return cache_dir / f"{h.hexdigest()}.json"
+
+
+def _violations_to_wire(violations: Iterable[Violation]) -> List[List]:
+    return [[v.rule, v.line, v.end_line, v.message] for v in violations]
+
+
+def _violations_from_wire(rows: Iterable[List], path: Path) -> List[Violation]:
+    return [Violation(r[0], path, r[1], r[3], end_line=r[2]) for r in rows]
+
+
+def _cache_write(cache_dir: Path, base: str,
+                 scan: Sequence[Tuple[Path, Optional[str]]],
+                 active: Sequence[Violation],
+                 waived: Sequence[Violation]) -> None:
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return
+    by_path: Dict[str, Tuple[List[Violation], List[Violation]]] = {}
+    for v in active:
+        by_path.setdefault(str(v.path), ([], []))[0].append(v)
+    for v in waived:
+        by_path.setdefault(str(v.path), ([], []))[1].append(v)
+    for path, sha in scan:
+        a, w = by_path.get(str(path), ([], []))
+        entry = {
+            "path": path.as_posix(),
+            "active": _violations_to_wire(a),
+            "waived": _violations_to_wire(w),
+        }
+        target = _cache_entry_path(cache_dir, base, path, sha)
+        try:
+            tmp = target.with_suffix(".tmp")
+            tmp.write_text(json.dumps(entry), encoding="utf-8")
+            tmp.replace(target)
+        except OSError:
+            return
+    try:
+        entries = sorted(cache_dir.glob("*.json"),
+                         key=lambda p: p.stat().st_mtime)
+        for stale in entries[:-_CACHE_MAX_ENTRIES]:
+            stale.unlink(missing_ok=True)
+    except OSError:
+        pass
+
+
+def _cache_try(cache_dir: Path, base: str,
+               scan: Sequence[Tuple[Path, Optional[str]]]
+               ) -> Optional[Tuple[List[Violation], List[Violation]]]:
+    """All-or-nothing warm load: every scanned file must have an entry
+    under the current tree digest, or the run falls back to a cold pass.
+    A hit skips parsing entirely — the waiver partition was computed from
+    identical bytes, so replaying it is sound."""
+    active: List[Violation] = []
+    waived: List[Violation] = []
+    for path, sha in scan:
+        entry_path = _cache_entry_path(cache_dir, base, path, sha)
+        try:
+            entry = json.loads(entry_path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return None
+        active.extend(_violations_from_wire(entry.get("active", []), path))
+        waived.extend(_violations_from_wire(entry.get("waived", []), path))
+    return active, waived
+
+
+# ---------------------------------------------------------------------------
+# Waiver audit (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def audit_waivers(
+    files: Sequence[SourceFile],
+    waived: Sequence[Violation],
+    selected: Sequence[str],
+    full_run: bool,
+) -> List[Tuple[Path, int, str]]:
+    """Stale ``# tunnelcheck: disable=`` comments: waivers whose rule no
+    longer fires on the statement they annotate.
+
+    No second no-waiver pass is needed — ``run_paths`` already computes
+    every violation and only *partitions* on waivers, so the ``waived``
+    list IS the set of suppressions that earned their keep.  A line waiver
+    for rule R is live iff some waived R-violation's statement span covers
+    its line; a file waiver iff some waived R-violation exists in the
+    file.  ``all`` waivers are only judged on full runs (a subset run
+    cannot tell whether an unselected rule justifies them), and rule ids
+    that do not exist are always reported — a typo'd waiver suppresses
+    nothing and reads as if it did.
+    """
+    known = set(RULE_SUMMARIES)
+    judged = set(selected)
+    covered: Dict[Tuple[str, str], List[Tuple[int, int]]] = {}
+    for v in waived:
+        covered.setdefault((str(v.path), v.rule), []).append(
+            (v.line, v.end_line or v.line)
+        )
+    out: List[Tuple[Path, int, str]] = []
+    for sf in files:
+        key_path = str(sf.path)
+
+        def live(rule: str, line: Optional[int]) -> bool:
+            rules = [rule] if rule != "all" else sorted(
+                {r for (p, r) in covered if p == key_path}
+            )
+            for r in rules:
+                for lo, hi in covered.get((key_path, r), ()):
+                    if line is None or lo <= line <= hi:
+                        return True
+            return False
+
+        for line in sorted(sf.line_waivers):
+            for rule in sorted(sf.line_waivers[line]):
+                if rule != "all" and rule not in known:
+                    out.append((sf.path, line,
+                                f"waiver names unknown rule `{rule}`"))
+                    continue
+                if rule == "all" and not full_run:
+                    continue
+                if rule != "all" and rule not in judged:
+                    continue
+                if not live(rule, line):
+                    out.append((
+                        sf.path, line,
+                        f"stale waiver: `{rule}` no longer fires on this "
+                        "statement — delete the comment",
+                    ))
+        for rule in sorted(sf.file_waivers):
+            if rule != "all" and rule not in known:
+                out.append((sf.path, 1,
+                            f"file waiver names unknown rule `{rule}`"))
+                continue
+            if rule == "all" and not full_run:
+                continue
+            if rule != "all" and rule not in judged:
+                continue
+            if not live(rule, None):
+                out.append((
+                    sf.path, 1,
+                    f"stale file waiver: `{rule}` fires nowhere in this "
+                    "file — delete the comment",
+                ))
+    return out
 
 
 #: Fork-inherited state for parallel workers: set by :func:`run_paths`
@@ -486,17 +720,35 @@ def _fork_worker(indices: Sequence[int]) -> Tuple[List[Violation], List[Violatio
     return active, waived
 
 
+def _selected_rules(
+    checks: Dict[str, object], rules: Optional[Sequence[str]]
+) -> List[str]:
+    if rules is None:
+        return list(checks)
+    # TC00 (parse errors) is always on; anything else unknown is a
+    # caller bug — silently running zero rules would read as "clean".
+    unknown = set(rules) - set(checks) - {"TC00"}
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s): {', '.join(sorted(unknown))}"
+        )
+    return [r for r in rules if r in checks]
+
+
 def run_paths(
     paths: Sequence[Path],
     rules: Optional[Sequence[str]] = None,
     stats: Optional[Dict[str, int]] = None,
     jobs: int = 1,
     restrict: Optional[Set[Path]] = None,
+    cache_dir: Optional[Path] = None,
+    waiver_audit: Optional[List[Tuple[Path, int, str]]] = None,
 ) -> Tuple[List[Violation], List[Violation]]:
     """Run the suite. Returns (active_violations, waived_violations).
 
     ``stats``, when given, receives ``{"files": <count scanned>}`` so the
-    CLI summary doesn't re-walk the tree.
+    CLI summary doesn't re-walk the tree (plus ``cache_hits``/
+    ``cache_misses`` when ``cache_dir`` is set).
 
     ``jobs`` > 1 fans the per-file rule passes across a fork-based
     multiprocessing pool (135 files × 15 rules is embarrassingly parallel;
@@ -510,35 +762,66 @@ def run_paths(
     mode) while the whole path set still feeds cross-file context — a
     changed-file scan must see the unchanged registries and callees or
     TC02/TC06/TC07 would lose their cross-file resolution.
+
+    ``cache_dir`` enables the per-file result cache.  Keys include every
+    file's content hash, the rule-module digest, and the whole-tree digest
+    — with interprocedural rules a single edited helper changes findings
+    in its callers, so any change invalidates everything (the honest
+    all-or-nothing trade, documented in README).  A full hit skips the
+    check phase entirely.  ``restrict`` runs bypass the cache.
+
+    ``waiver_audit``, when a list, is filled with :func:`audit_waivers`
+    results for the checked files.
     """
-    files: List[SourceFile] = []
-    active: List[Violation] = []
-    waived: List[Violation] = []
-    n_files = 0
+    scan: List[Tuple[Path, Optional[str]]] = []
     for path in iter_python_files(paths):
-        n_files += 1
+        scan.append((path, None))
+    if stats is not None:
+        stats["files"] = len(scan)
+
+    checks = all_rules()
+    selected = _selected_rules(checks, rules)
+    full_run = rules is None
+
+    use_cache = cache_dir is not None and restrict is None
+    base = ""
+    if use_cache:
+        scan = [(p, _file_sha(p)) for p, _ in scan]
+        base = _cache_base(scan, ",".join(selected))
+        cached = _cache_try(cache_dir, base, scan)
+        if cached is not None:
+            active, waived = cached
+            if stats is not None:
+                stats["cache_hits"] = len(scan)
+                stats["cache_misses"] = 0
+            if waiver_audit is not None:
+                warm_files = []
+                for p, _sha in scan:
+                    sf, _err = load_source(p)
+                    if sf is not None:
+                        warm_files.append(sf)
+                waiver_audit.extend(
+                    audit_waivers(warm_files, waived, selected, full_run)
+                )
+            active.sort(key=lambda v: (str(v.path), v.line, v.rule))
+            waived.sort(key=lambda v: (str(v.path), v.line, v.rule))
+            return active, waived
+        if stats is not None:
+            stats["cache_hits"] = 0
+            stats["cache_misses"] = len(scan)
+
+    files: List[SourceFile] = []
+    active = []
+    waived = []
+    for path, _sha in scan:
         sf, err = load_source(path)
         if err is not None:
             if restrict is None or path.resolve() in restrict:
                 active.append(err)
         if sf is not None:
             files.append(sf)
-    if stats is not None:
-        stats["files"] = n_files
 
     ctx = ProjectContext(files)
-    checks = all_rules()
-    if rules is None:
-        selected = list(checks)
-    else:
-        # TC00 (parse errors) is always on; anything else unknown is a
-        # caller bug — silently running zero rules would read as "clean".
-        unknown = set(rules) - set(checks) - {"TC00"}
-        if unknown:
-            raise ValueError(
-                f"unknown rule id(s): {', '.join(sorted(unknown))}"
-            )
-        selected = [r for r in rules if r in checks]
 
     if restrict is None:
         check_files = files
@@ -558,6 +841,10 @@ def run_paths(
             # every worker inherits them instead of rebuilding per process.
             ctx.callgraph
             ctx.attr_function_count("")
+            for rule_id in selected:
+                warm = getattr(checks[rule_id], "warm", None)
+                if warm is not None:
+                    warm(ctx)
             global _FORK_STATE
             file_index = {id(sf): i for i, sf in enumerate(files)}
             chunks: List[List[int]] = [[] for _ in range(jobs)]
@@ -578,6 +865,13 @@ def run_paths(
             a, w = _check_one(sf, ctx, selected, checks)
             active.extend(a)
             waived.extend(w)
+
+    if use_cache:
+        _cache_write(cache_dir, base, scan, active, waived)
+    if waiver_audit is not None:
+        waiver_audit.extend(
+            audit_waivers(check_files, waived, selected, full_run)
+        )
 
     active.sort(key=lambda v: (str(v.path), v.line, v.rule))
     waived.sort(key=lambda v: (str(v.path), v.line, v.rule))

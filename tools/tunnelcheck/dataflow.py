@@ -32,6 +32,16 @@ Three layers, all stdlib-``ast`` (never importing the scanned code):
   Sources and sanitizers are injected by the rule (TC14 seeds at
   client-controlled request headers/bodies and clears at the registered
   sanitizers), so the engine itself stays policy-free.
+- :func:`interproc_taint` — the ISSUE 18 layer: per-function taint
+  *summaries* (which params flow to the return value, which params reach
+  a sink inside the function, whether the body taints its result from a
+  source regardless of arguments) computed over the
+  :mod:`~tools.tunnelcheck.callgraph` project graph and iterated to a
+  fixpoint with a bounded number of rounds.  A page extracted in one
+  helper and serialized in another — the exact shape the disaggregated
+  prefill/decode and peer-KV-tier work will introduce — is invisible to
+  every per-function rule; the summaries make the boundary crossing
+  visible at the CALL SITE, where the waiver/fix belongs.
 """
 
 from __future__ import annotations
@@ -686,3 +696,530 @@ def taint_locals(
                                 tainted.add(t.id)
                                 changed = True
     return tainted
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural taint summaries (TC20/TC21's engine)
+# ---------------------------------------------------------------------------
+
+#: Label meaning "a source was observed on this path" — distinct from the
+#: param-name labels so one pass computes both the param→return transfer
+#: (which arguments contaminate my result?) and the always-tainted case
+#: (my body reads a source no matter what callers pass).
+SRC = "<src>"
+
+#: Passes over a loop body before declaring the loop state stable.  Labels
+#: only ever accumulate inside a pass, so pass k sees everything a chain of
+#: k intra-loop assignments can carry; deeper chains through a back edge
+#: are vanishingly rare in review-scale code and the cap keeps the walker
+#: linear on the pathological inputs the checker must never hang on.
+LOOP_PASSES = 4
+
+
+def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that treats nested defs/lambdas as opaque — their
+    bodies run in another activation (or never), not in this flow."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+@dataclass
+class TaintPolicy:
+    """What a rule injects into the interprocedural engine.
+
+    ``is_source`` / ``sanitizers`` mirror :func:`taint_locals`.
+    ``seed_params`` are parameter names presumed hostile at *reporting*
+    time only (public entry points whose callers live outside the scanned
+    universe); summaries are never seeded, so a helper taking a ``payload``
+    argument stays exactly as trustworthy as what each call site passes.
+    ``sink_args`` maps a call to ``(argument expression, sink description)``
+    pairs the rule wants judged; ``sink_assign`` does the same for
+    assignment statements (subscript-store sinks like ``kwargs["tenant"]``).
+    """
+
+    is_source: Callable[[ast.AST], bool]
+    sanitizers: "frozenset[str] | Set[str]"
+    seed_params: "frozenset[str] | Set[str]" = frozenset()
+    sink_args: Optional[
+        Callable[[ast.Call], List[Tuple[ast.AST, str]]]
+    ] = None
+    sink_assign: Optional[
+        Callable[[ast.Assign], List[Tuple[ast.AST, str]]]
+    ] = None
+
+
+@dataclass
+class FuncSummary:
+    """One function's taint behaviour as seen from a call site.
+
+    ``ret`` — labels reaching a ``return``/``yield`` value: parameter
+    names (the result is as dirty as that argument) and/or :data:`SRC`
+    (the body taints its result unconditionally).  ``sink_params`` —
+    parameter name → description of the sink it can reach inside the
+    function (transitively, via callee summaries) without passing a
+    sanitizer on that path.
+    """
+
+    ret: Set[str] = field(default_factory=set)
+    sink_params: Dict[str, str] = field(default_factory=dict)
+
+
+def _copy_env(env: Optional[Dict[str, Set[str]]]) -> Optional[Dict[str, Set[str]]]:
+    if env is None:
+        return None
+    return {k: set(v) for k, v in env.items()}
+
+
+def _join_env(
+    a: Optional[Dict[str, Set[str]]], b: Optional[Dict[str, Set[str]]]
+) -> Optional[Dict[str, Set[str]]]:
+    """Path join: ``None`` means "all paths left the scope" and is the
+    identity; otherwise key-wise label union (may-taint)."""
+    if a is None:
+        return _copy_env(b)
+    if b is None:
+        return a
+    out = {k: set(v) for k, v in a.items()}
+    for k, v in b.items():
+        out.setdefault(k, set()).update(v)
+    return out
+
+
+def map_call_args(call: ast.Call, info) -> Dict[str, ast.AST]:
+    """Best-effort argument-expression-per-parameter map for a call against
+    a :class:`~tools.tunnelcheck.core.FuncInfo` signature.  A method called
+    through an attribute binds the receiver to its first parameter;
+    positions after a ``*args`` splat are unknowable and dropped (the
+    engine falls back to judging splatted values conservatively)."""
+    mapped: Dict[str, ast.AST] = {}
+    drop_self = info.is_method and isinstance(call.func, ast.Attribute)
+    pos = info.effective_pos(drop_self)
+    if drop_self and info.pos:
+        mapped[info.pos[0]] = call.func.value
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(pos):
+            mapped[pos[i]] = a
+    for kw in call.keywords:
+        if kw.arg:
+            mapped[kw.arg] = kw.value
+    return mapped
+
+
+class _SummaryFlow:
+    """Flow-sensitive label propagation over one function body.
+
+    The environment maps local name → label set; ``None`` means every path
+    left the scope.  A whole-name reassignment from clean values KILLS the
+    taint (that is what makes ``payload = verify_page_pin(payload, ...)``
+    the sanctioned idiom), while subscript stores, ``AugAssign`` and
+    mutating-method calls only ever ADD labels — mutating part of a
+    container never launders the rest of it.
+    """
+
+    def __init__(self, engine: "InterprocTaint", fn: ast.AST,
+                 summary: FuncSummary,
+                 on_sink: Optional[Callable[[ast.AST, str], None]]):
+        self.engine = engine
+        self.policy = engine.policy
+        self.fn = fn
+        self.params = param_names(fn)
+        self.summary = summary
+        self.on_sink = on_sink
+        self._breaks: List[List[Optional[Dict[str, Set[str]]]]] = []
+        self._continues: List[List[Optional[Dict[str, Set[str]]]]] = []
+
+    def run(self) -> FuncSummary:
+        env: Dict[str, Set[str]] = {p: {p} for p in self.params}
+        if self.on_sink is not None:
+            for p in self.params & set(self.policy.seed_params):
+                env[p].add(SRC)
+        self.run_body(list(self.fn.body), env)
+        return self.summary
+
+    # -- label evaluation -------------------------------------------------
+
+    def eval(self, expr: Optional[ast.AST],
+             env: Dict[str, Set[str]]) -> Set[str]:
+        if expr is None:
+            return set()
+        out: Set[str] = set()
+        if isinstance(expr, ast.Name):
+            if isinstance(expr.ctx, ast.Load):
+                out |= env.get(expr.id, set())
+        elif isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return set()
+        elif isinstance(expr, ast.Call):
+            name = call_name(expr)
+            if name in self.policy.sanitizers:
+                return set()
+            if isinstance(expr.func, ast.Attribute):
+                # A method result on a tainted receiver stays tainted
+                # (``page.copy()``, ``payload.items()``).
+                out |= self.eval(expr.func.value, env)
+            resolved = self.engine._callee(name) if name else None
+            if resolved is not None:
+                info, summary = resolved
+                if SRC in summary.ret:
+                    out.add(SRC)
+                mapped = map_call_args(expr, info)
+                for p in summary.ret - {SRC}:
+                    arg = mapped.get(p)
+                    if arg is not None:
+                        out |= self.eval(arg, env)
+                for a in expr.args:
+                    if isinstance(a, ast.Starred):
+                        out |= self.eval(a.value, env)
+                for kw in expr.keywords:
+                    if kw.arg is None:
+                        out |= self.eval(kw.value, env)
+            else:
+                for a in expr.args:
+                    out |= self.eval(
+                        a.value if isinstance(a, ast.Starred) else a, env)
+                for kw in expr.keywords:
+                    out |= self.eval(kw.value, env)
+        elif isinstance(expr, ast.Attribute):
+            out |= self.eval(expr.value, env)
+        else:
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    out |= self.eval(child, env)
+                elif isinstance(child, ast.comprehension):
+                    out |= self.eval(child.iter, env)
+                elif isinstance(child, ast.keyword):
+                    out |= self.eval(child.value, env)
+        if self.policy.is_source(expr):
+            out.add(SRC)
+        return out
+
+    # -- sink / mutation scan ---------------------------------------------
+
+    def _hit(self, node: ast.AST, desc: str, labels: Set[str]) -> None:
+        if not labels:
+            return
+        if SRC in labels and self.on_sink is not None:
+            self.on_sink(node, desc)
+        for p in labels & self.params:
+            self.summary.sink_params.setdefault(p, desc)
+
+    def scan(self, expr: Optional[ast.AST], env: Dict[str, Set[str]]) -> None:
+        """Judge every call in ``expr`` against the policy's intrinsic
+        sinks and against callee summaries, and apply container-mutation
+        taint (``out.append(page)`` dirties ``out``)."""
+        if expr is None:
+            return
+        for sub in walk_same_scope(expr):
+            if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                if sub.value is not None:
+                    self.summary.ret |= self.eval(sub.value, env)
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in MUTATING_METHODS \
+                    and isinstance(sub.func.value, ast.Name):
+                labels: Set[str] = set()
+                for a in sub.args:
+                    labels |= self.eval(
+                        a.value if isinstance(a, ast.Starred) else a, env)
+                for kw in sub.keywords:
+                    labels |= self.eval(kw.value, env)
+                if labels:
+                    env.setdefault(sub.func.value.id, set()).update(labels)
+            if self.policy.sink_args is not None:
+                for arg, desc in self.policy.sink_args(sub):
+                    self._hit(sub, desc, self.eval(arg, env))
+            name = call_name(sub)
+            if name and name not in self.policy.sanitizers:
+                resolved = self.engine._callee(name)
+                if resolved is not None:
+                    info, summary = resolved
+                    if summary.sink_params:
+                        mapped = map_call_args(sub, info)
+                        for p, desc in sorted(summary.sink_params.items()):
+                            arg = mapped.get(p)
+                            if arg is not None:
+                                self._hit(sub, f"{desc} via `{info.name}()`",
+                                          self.eval(arg, env))
+
+    # -- statements -------------------------------------------------------
+
+    def assign(self, target: ast.AST, labels: Set[str],
+               env: Dict[str, Set[str]]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = set(labels)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, labels, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, labels, env)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name) and labels:
+                env.setdefault(base.id, set()).update(labels)
+        # Attribute stores are out of scope: cross-attribute flow belongs
+        # to attr_reach/TC13, and tracking it here would make summaries
+        # depend on object identity the name-keyed graph cannot see.
+
+    def run_body(self, body: List[ast.stmt],
+                 env: Optional[Dict[str, Set[str]]]
+                 ) -> Optional[Dict[str, Set[str]]]:
+        for stmt in body:
+            if env is None:
+                return None
+            env = self.stmt(stmt, env)
+        return env
+
+    def stmt(self, node: ast.stmt,
+             env: Dict[str, Set[str]]) -> Optional[Dict[str, Set[str]]]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return env
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.scan(node.value, env)
+                self.summary.ret |= self.eval(node.value, env)
+            return None
+        if isinstance(node, ast.Raise):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.scan(child, env)
+            return None
+        if isinstance(node, ast.Break):
+            if self._breaks:
+                self._breaks[-1].append(_copy_env(env))
+            return None
+        if isinstance(node, ast.Continue):
+            if self._continues:
+                self._continues[-1].append(_copy_env(env))
+            return None
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if value is None:
+                return env
+            self.scan(value, env)
+            labels = self.eval(value, env)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                self.assign(t, labels, env)
+            if isinstance(node, ast.Assign) \
+                    and self.policy.sink_assign is not None:
+                for arg, desc in self.policy.sink_assign(node):
+                    self._hit(node, desc, self.eval(arg, env))
+            return env
+        if isinstance(node, ast.AugAssign):
+            self.scan(node.value, env)
+            labels = self.eval(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env.setdefault(node.target.id, set()).update(labels)
+            elif isinstance(node.target, ast.Subscript):
+                self.assign(node.target, labels, env)
+            return env
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    env.pop(t.id, None)
+            return env
+        if isinstance(node, ast.Expr):
+            self.scan(node.value, env)
+            return env
+        if isinstance(node, ast.If):
+            self.scan(node.test, env)
+            t_env = self.run_body(list(node.body), _copy_env(env))
+            e_env = self.run_body(list(node.orelse), _copy_env(env))
+            return _join_env(t_env, e_env)
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            self._breaks.append([])
+            loop_env: Optional[Dict[str, Set[str]]] = _copy_env(env)
+            for _ in range(LOOP_PASSES):
+                it_env = _copy_env(loop_env)
+                assert it_env is not None
+                if isinstance(node, ast.While):
+                    self.scan(node.test, it_env)
+                else:
+                    self.scan(node.iter, it_env)
+                    labels = self.eval(node.iter, it_env)
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            it_env[t.id] = set(labels)
+                self._continues.append([])
+                body_out = self.run_body(list(node.body), it_env)
+                for c in self._continues.pop():
+                    body_out = _join_env(body_out, c)
+                merged = _join_env(loop_env, body_out)
+                if merged == loop_env:
+                    break
+                loop_env = merged
+            breaks = self._breaks.pop()
+            normal: Optional[Dict[str, Set[str]]] = _copy_env(loop_env)
+            if node.orelse:
+                normal = self.run_body(list(node.orelse), normal)
+            out = normal
+            for b in breaks:
+                out = _join_env(out, b)
+            return out
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            cur: Optional[Dict[str, Set[str]]] = env
+            for item in node.items:
+                self.scan(item.context_expr, cur)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars,
+                                self.eval(item.context_expr, cur), cur)
+            return self.run_body(list(node.body), cur)
+        if isinstance(node, ast.Try):
+            body_env = self.run_body(list(node.body), _copy_env(env))
+            # Any statement in the body may raise: handlers see the join
+            # of the entry state and the body's exit state — a sanitizer
+            # call inside the try must NOT count as having run on the
+            # exception path (the _spill_copy_in except-continue shape).
+            h_in = _join_env(_copy_env(env), body_env)
+            outs: List[Optional[Dict[str, Set[str]]]] = []
+            if node.orelse:
+                outs.append(self.run_body(list(node.orelse),
+                                          _copy_env(body_env)))
+            else:
+                outs.append(body_env)
+            for handler in node.handlers:
+                h_env = _copy_env(h_in)
+                if h_env is not None and handler.name:
+                    h_env[handler.name] = set()
+                outs.append(self.run_body(list(handler.body), h_env)
+                            if h_env is not None else None)
+            out: Optional[Dict[str, Set[str]]] = None
+            for o in outs:
+                out = _join_env(out, o)
+            if node.finalbody:
+                # finally also runs on raising/early-leaving paths.
+                fin_in = _join_env(out, h_in)
+                out = self.run_body(list(node.finalbody), fin_in)
+            return out
+        if isinstance(node, ast.Match):
+            self.scan(node.subject, env)
+            out: Optional[Dict[str, Set[str]]] = None
+            for case in node.cases:
+                out = _join_env(out, self.run_body(list(case.body),
+                                                   _copy_env(env)))
+            return _join_env(out, env)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.scan(child, env)
+        return env
+
+
+class InterprocTaint:
+    """Fixpoint of :class:`FuncSummary` over a project call graph.
+
+    Round k propagates facts through call chains of length ≤ k (each round
+    reads the PREVIOUS round's summaries — a Jacobi iteration — so the
+    round cap IS the call-depth bound the ISSUE asks for).  Summaries only
+    grow: an unresolved callee starts from the empty summary, labels union
+    monotonically, and recursion/cycles therefore terminate at either the
+    fixpoint or the ``max_depth`` cutoff, whichever comes first.
+
+    Callee resolution is name-keyed like the rest of tunnelcheck: every
+    same-name def must agree on signature shape, otherwise the call is
+    treated as unknown and its result is conservatively as dirty as its
+    arguments.  Higher-order flow (``run_in_executor(None, self._fn, x)``)
+    and closure capture are invisible — the same blind spots as
+    :class:`~tools.tunnelcheck.callgraph.CallGraph`, documented there.
+    """
+
+    def __init__(self, graph, policy: TaintPolicy, max_depth: int = 4):
+        self.graph = graph
+        self.policy = policy
+        self.max_depth = max(1, max_depth)
+        self.rounds = 0
+        self.summaries: Dict[int, FuncSummary] = {}
+        self._prev: Dict[int, FuncSummary] = {}
+        self._callee_memo: Dict[
+            str, Optional[Tuple[object, FuncSummary]]] = {}
+        self._fixpoint()
+
+    # -- callee lookup ----------------------------------------------------
+
+    def _callee(self, name: str):
+        if name in self._callee_memo:
+            return self._callee_memo[name]
+        out = None
+        nodes = self.graph.by_name.get(name)
+        if nodes:
+            shapes = {
+                (tuple(n.info.pos), n.info.has_vararg, n.info.has_kwarg,
+                 n.info.is_method)
+                for n in nodes
+            }
+            if len(shapes) == 1:
+                merged = FuncSummary()
+                for n in nodes:
+                    s = self._prev.get(id(n.node))
+                    if s is not None:
+                        merged.ret |= s.ret
+                        for p, d in s.sink_params.items():
+                            merged.sink_params.setdefault(p, d)
+                out = (nodes[0].info, merged)
+        self._callee_memo[name] = out
+        return out
+
+    # -- fixpoint ---------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        all_nodes = [n for nodes in self.graph.by_name.values()
+                     for n in nodes]
+        pending = all_nodes
+        for _ in range(self.max_depth):
+            self.rounds += 1
+            self._prev = self.summaries
+            self.summaries = dict(self._prev)
+            self._callee_memo = {}
+            changed: Set[str] = set()
+            for fn in pending:
+                s = self.analyze(fn.node)
+                old = self._prev.get(id(fn.node))
+                if old is None or s.ret != old.ret \
+                        or s.sink_params != old.sink_params:
+                    changed.add(fn.name)
+                self.summaries[id(fn.node)] = s
+            if not changed:
+                break
+            # Only re-analyze functions whose callee set intersects what
+            # changed — the worklist that keeps tree-wide runs O(edges).
+            pending = [n for n in all_nodes if n.calls & changed]
+            if not pending:
+                break
+        self._prev = self.summaries
+        self._callee_memo = {}
+
+    # -- public API -------------------------------------------------------
+
+    def analyze(self, fn: ast.AST,
+                on_sink: Optional[Callable[[ast.AST, str], None]] = None
+                ) -> FuncSummary:
+        """Walk one function against the current summaries.  With
+        ``on_sink``, runs in reporting mode: seeds ``policy.seed_params``
+        and invokes the callback at every sink reached by a label set
+        containing :data:`SRC`."""
+        summary = FuncSummary()
+        _SummaryFlow(self, fn, summary, on_sink).run()
+        return summary
+
+    def summary_for(self, fn: ast.AST) -> Optional[FuncSummary]:
+        """The fixpoint summary for a def node from the graph, if any."""
+        return self.summaries.get(id(fn))
+
+
+def interproc_taint(graph, policy: TaintPolicy,
+                    max_depth: int = 4) -> InterprocTaint:
+    """Build the interprocedural taint fixpoint for ``graph`` (a
+    :class:`~tools.tunnelcheck.callgraph.CallGraph`) under ``policy``.
+    ``max_depth`` bounds both the fixpoint rounds and, equivalently, the
+    call-chain length facts can travel (see :class:`InterprocTaint`)."""
+    return InterprocTaint(graph, policy, max_depth=max_depth)

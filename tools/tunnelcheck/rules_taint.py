@@ -41,12 +41,14 @@ the self-run keeps both honest (README "Static analysis & invariants").
 from __future__ import annotations
 
 import ast
-from typing import Iterator, List, Optional, Set
+from typing import Iterator, List, Optional, Set, Tuple
 
 from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
 from tools.tunnelcheck.dataflow import (
+    TaintPolicy,
     call_name,
     expr_tainted,
+    interproc_taint,
     iter_functions,
     param_names,
     taint_locals,
@@ -114,6 +116,72 @@ def _log_receiver(node: ast.Call) -> bool:
     return bool(LOG_RECEIVER_WORDS & set(name.lower().split("_")))
 
 
+#: One judged sink operand: (expression to judge, sink description, hint).
+SinkSpec = Tuple[ast.AST, str, str]
+
+
+def call_sink_specs(node: ast.Call) -> List[SinkSpec]:
+    """Structural sink-operand extraction shared by TC14 (flat lattice)
+    and TC21 (interprocedural summaries): every expression that, if
+    tainted, lands client bytes on a trusted surface."""
+    specs: List[SinkSpec] = []
+    name = call_name(node)
+    # tenant=/to= keywords anywhere: fair admission / relay routing key
+    # on them.
+    for kw in node.keywords:
+        if kw.arg == "tenant":
+            specs.append((kw.value, "the scheduler tenant identity",
+                          "parse_tenant"))
+        if kw.arg == "to":
+            specs.append((kw.value, "a relay `to=` target",
+                          "validate the peer id"))
+    if name in TENANT_SINK_CALLS and node.args:
+        specs.append((node.args[0], f"per-tenant accounting (`{name}`)",
+                      "parse_tenant"))
+    elif name == "set_labeled_gauge" and len(node.args) >= 3:
+        specs.append((node.args[2], "a labeled-metrics value",
+                      "prom_label_escape / the bounded registry"))
+    elif name in FS_CALLS and node.args:
+        specs.append((node.args[0], f"a filesystem path (`{name}`)",
+                      "never derive paths from request bytes"))
+    elif _log_receiver(node) and node.args:
+        fmt = node.args[0]
+        hint = "use lazy %s args, which never interpret the value"
+        if isinstance(fmt, (ast.JoinedStr, ast.BinOp)):
+            specs.append((fmt, "log interpolation", hint))
+        elif isinstance(fmt, ast.Call) and call_name(fmt) == "format":
+            for a in fmt.args:
+                specs.append((a, "log interpolation", hint))
+            for kw in fmt.keywords:
+                specs.append((kw.value, "log interpolation", hint))
+            if isinstance(fmt.func, ast.Attribute):
+                specs.append((fmt.func.value, "log interpolation", hint))
+        elif not isinstance(fmt, (ast.Constant, ast.Call)):
+            specs.append((fmt, "log interpolation", hint))
+    # {"to": <tainted>} inside any call payload (signaling sends).
+    for a in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(a, ast.Dict):
+            for k, v in zip(a.keys, a.values):
+                if isinstance(k, ast.Constant) and k.value == "to":
+                    specs.append((v, "a relay `to=` target",
+                                  "validate the peer id"))
+    return specs
+
+
+def assign_sink_specs(node: ast.Assign) -> List[SinkSpec]:
+    """``kwargs["tenant"] = <tainted>`` — the scheduler-identity store."""
+    specs: List[SinkSpec] = []
+    for t in node.targets:
+        if (
+            isinstance(t, ast.Subscript)
+            and isinstance(t.slice, ast.Constant)
+            and t.slice.value == "tenant"
+        ):
+            specs.append((node.value, "the scheduler tenant identity",
+                          "parse_tenant"))
+    return specs
+
+
 def check_tc14(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
     del ctx
     if not _in_scope(sf):
@@ -149,67 +217,88 @@ def check_tc14(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
             )
 
         for node in ast.walk(fn):
-            # kwargs["tenant"] = <tainted> — the scheduler-identity store.
             if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if (
-                        isinstance(t, ast.Subscript)
-                        and isinstance(t.slice, ast.Constant)
-                        and t.slice.value == "tenant"
-                        and dirty(node.value)
-                    ):
-                        report(node, "the scheduler tenant identity",
-                               "parse_tenant")
+                for expr, sink, hint in assign_sink_specs(node):
+                    if dirty(expr):
+                        report(node, sink, hint)
                 continue
             if not isinstance(node, ast.Call):
                 continue
-            name = call_name(node)
-            # tenant= keyword anywhere: fair admission keys on it.
-            for kw in node.keywords:
-                if kw.arg == "tenant" and dirty(kw.value):
-                    report(node, "the scheduler tenant identity",
-                           "parse_tenant")
-                if kw.arg == "to" and dirty(kw.value):
-                    report(node, "a relay `to=` target", "validate the peer id")
-            if name in TENANT_SINK_CALLS and node.args and dirty(node.args[0]):
-                report(node, f"per-tenant accounting (`{name}`)",
-                       "parse_tenant")
-            elif name == "set_labeled_gauge" and len(node.args) >= 3 \
-                    and dirty(node.args[2]):
-                report(node, "a labeled-metrics value",
-                       "prom_label_escape / the bounded registry")
-            elif name in FS_CALLS and node.args and dirty(node.args[0]):
-                report(node, f"a filesystem path (`{name}`)",
-                       "never derive paths from request bytes")
-            elif _log_receiver(node) and node.args:
-                fmt = node.args[0]
-                interpolated = dirty(fmt) if isinstance(
-                    fmt, (ast.JoinedStr, ast.BinOp)
-                ) else False
-                if isinstance(fmt, ast.Call) and call_name(fmt) == "format":
-                    interpolated = (
-                        any(dirty(a) for a in fmt.args)
-                        or any(dirty(kw.value) for kw in fmt.keywords)
-                        or dirty(
-                            fmt.func.value
-                            if isinstance(fmt.func, ast.Attribute) else None
-                        )
-                    )
-                if not interpolated and not isinstance(
-                    fmt, (ast.Constant, ast.JoinedStr, ast.BinOp, ast.Call)
-                ):
-                    interpolated = dirty(fmt)  # tainted format string itself
-                if interpolated:
-                    report(node, "log interpolation",
-                           "use lazy %s args, which never interpret the value")
-            # {"to": <tainted>} inside any call payload (signaling sends).
-            for a in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(a, ast.Dict):
-                    for k, v in zip(a.keys, a.values):
-                        if (
-                            isinstance(k, ast.Constant) and k.value == "to"
-                            and dirty(v)
-                        ):
-                            report(node, "a relay `to=` target",
-                                   "validate the peer id")
+            for expr, sink, hint in call_sink_specs(node):
+                if dirty(expr):
+                    report(node, sink, hint)
     return iter(out)
+
+
+# ---------------------------------------------------------------------------
+# TC21: interprocedural header taint (ISSUE 18)
+# ---------------------------------------------------------------------------
+#
+# TC14's lattice is per-function: a helper that EXTRACTS a header value
+# (``return req.headers.get("x-tunnel-tenant", "")``) returns what TC14
+# sees as a clean call result, and a helper that STAMPS its argument into
+# a sink (``kw["tenant"] = raw``) hides the sink from its callers — the
+# pre-PR-7 minting hole, one function-call deep.  TC21 runs the identical
+# source/sanitizer/sink contract through the interprocedural summary
+# engine and reports only flows TC14 cannot see (same-line findings are
+# TC14's; duplicating them would double every waiver).
+
+
+def _tc21_sink_args(call: ast.Call) -> List[Tuple[ast.AST, str]]:
+    return [(expr, sink) for expr, sink, _hint in call_sink_specs(call)]
+
+
+def _tc21_sink_assign(node: ast.Assign) -> List[Tuple[ast.AST, str]]:
+    return [(expr, sink) for expr, sink, _hint in assign_sink_specs(node)]
+
+
+def _tc21_engine(ctx: ProjectContext):
+    def build():
+        policy = TaintPolicy(
+            is_source=_is_source,
+            sanitizers=SANITIZERS,
+            seed_params=TAINTED_PARAMS,
+            sink_args=_tc21_sink_args,
+            sink_assign=_tc21_sink_assign,
+        )
+        return interproc_taint(ctx.scoped_callgraph(SCOPE_PART), policy)
+
+    return ctx.interproc("TC21", build)
+
+
+def warm_tc21(ctx: ProjectContext) -> None:
+    _tc21_engine(ctx)
+
+
+def check_tc21(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if not _in_scope(sf):
+        return iter(())
+    engine = _tc21_engine(ctx)
+    intra_lines = {v.line for v in check_tc14(sf, ctx)}
+    out: List[Violation] = []
+    reported: Set = set()
+
+    def on_sink(node: ast.AST, sink: str) -> None:
+        key = (node.lineno, sink)
+        if node.lineno in intra_lines or key in reported:
+            return
+        reported.add(key)
+        out.append(Violation(
+            "TC21",
+            sf.path,
+            node.lineno,
+            f"client-controlled bytes reach {sink} through a helper-"
+            "function chain without a registered sanitizer — the "
+            "x-tunnel-tenant minting hole, one call deep (the flow TC14's "
+            "per-function lattice cannot see): sanitize at the ingress "
+            "(parse_tenant/tenant_fingerprint/prom_label_escape), or "
+            "waive naming why these bytes are trusted",
+            end_line=getattr(node, "end_lineno", None),
+        ))
+
+    for fn, _cls in iter_functions(sf.tree):
+        engine.analyze(fn, on_sink=on_sink)
+    return iter(out)
+
+
+check_tc21.warm = warm_tc21

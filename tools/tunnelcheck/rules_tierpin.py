@@ -48,8 +48,10 @@ from typing import Iterator, List, Optional, Set
 
 from tools.tunnelcheck.core import ProjectContext, SourceFile, Violation
 from tools.tunnelcheck.dataflow import (
+    TaintPolicy,
     call_name,
     expr_tainted,
+    interproc_taint,
     iter_functions,
     param_names,
 )
@@ -311,3 +313,119 @@ def check_tc18(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
         seed = param_names(fn) & TAINTED_PARAMS
         _Flow(report).run_body(fn.body, set(seed))
     return iter(out)
+
+
+# ---------------------------------------------------------------------------
+# TC20: interprocedural page-boundary pinning (ISSUE 18)
+# ---------------------------------------------------------------------------
+#
+# TC18 sees one function at a time, so a page EXTRACTED in one helper and
+# serialized in another is invisible to it — exactly the shape the
+# disaggregated-prefill and peer-KV-tier work will introduce.  TC20 runs
+# the same source/sanitizer contract through the interprocedural summary
+# engine: a value tainted by prefix-pool page extraction (a ``page_out``/
+# ``_page_out_op`` pool read, an ``export_state`` tier chain, a
+# ``*page*.payload`` body) must pass ``verify_page_pin`` on every path
+# before reaching a tunnel send, a tier write (``note_spilled``), or a
+# device-pool splice — including when the extraction and the boundary live
+# in different functions.
+
+#: Calls whose RESULT is raw page bytes leaving the pool: the jitted
+#: gather op and its engine handle, and the exported tier/LRU chain.
+PAGE_EXTRACT_CALLS = frozenset({"page_out", "_page_out_op", "export_state"})
+
+#: Tier-write entry points: page bytes entering the host-RAM spill tier.
+TIER_WRITE_CALLS = frozenset({"note_spilled"})
+
+#: Tunnel/socket sends: page bytes leaving the process.  Generic names on
+#: purpose — every transport layer (fabric, chaos wrapper, signaling,
+#: frame clients) exposes ``send``-shaped methods, and the rule only fires
+#: when PAGE-tainted bytes reach one, not on ordinary frame traffic.
+SEND_CALLS = frozenset({"send", "send_bytes", "send_frame"})
+
+#: Words in a receiver name that mark ``x.payload`` as a PAGE body rather
+#: than a protocol-frame body (``msg.payload`` is every tunnel message;
+#: ``page.payload`` / ``spill.payload`` is pool bytes).  TC18 can afford
+#: the broad ``.payload`` source because its sinks only exist in engine
+#: code; TC20's send sink would otherwise flag every frame relay.
+PAGE_RECEIVER_WORDS = ("page", "spill")
+
+
+def _is_page_source(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        return call_name(expr) in PAGE_EXTRACT_CALLS
+    if (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "payload"
+        and isinstance(expr.ctx, ast.Load)
+        and isinstance(expr.value, ast.Name)
+    ):
+        recv = expr.value.id.lower()
+        return any(w in recv for w in PAGE_RECEIVER_WORDS)
+    return False
+
+
+def _tc20_sink_args(call: ast.Call):
+    name = call_name(call)
+    if name in SPLICE_CALLS:
+        desc = f"a device-pool splice (`{name}`)"
+    elif name in TIER_WRITE_CALLS:
+        desc = f"a tier write (`{name}`)"
+    elif name in SEND_CALLS:
+        desc = f"a tunnel send (`{name}`)"
+    elif _at_set_buffer_write(call):
+        desc = "an `.at[...].set` buffer write"
+    else:
+        return []
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    return [(a, desc) for a in args]
+
+
+def _tc20_engine(ctx: ProjectContext):
+    def build():
+        policy = TaintPolicy(
+            is_source=_is_page_source,
+            sanitizers=SANITIZERS,
+            sink_args=_tc20_sink_args,
+        )
+        return interproc_taint(ctx.scoped_callgraph(SCOPE_PART), policy)
+
+    return ctx.interproc("TC20", build)
+
+
+def warm_tc20(ctx: ProjectContext) -> None:
+    _tc20_engine(ctx)
+
+
+def check_tc20(sf: SourceFile, ctx: ProjectContext) -> Iterator[Violation]:
+    if not _in_scope(sf):
+        return iter(())
+    engine = _tc20_engine(ctx)
+    out: List[Violation] = []
+    reported: Set = set()
+
+    def on_sink(node: ast.AST, desc: str) -> None:
+        key = (node.lineno, desc)
+        if key in reported:
+            return
+        reported.add(key)
+        out.append(Violation(
+            "TC20",
+            sf.path,
+            node.lineno,
+            f"extracted KV page bytes reach {desc} without passing "
+            "verify_page_pin on every path — the page wire contract "
+            "(quant mode + group size pinned, checksum verified) follows "
+            "the bytes across function and tier boundaries: re-assign "
+            "through verify_page_pin before the boundary (or register "
+            "the new check in rules_tierpin.SANITIZERS), or waive naming "
+            "the contract that makes these bytes pin-safe",
+            end_line=getattr(node, "end_lineno", None),
+        ))
+
+    for fn, _cls in iter_functions(sf.tree):
+        engine.analyze(fn, on_sink=on_sink)
+    return iter(out)
+
+
+check_tc20.warm = warm_tc20
