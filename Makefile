@@ -148,6 +148,14 @@ chaos:             ## request-lifecycle suite under seeded fault injection
 	CHAOS_TEST_SEED=5  python -m pytest tests/test_spill_tier.py -q
 	CHAOS_TEST_SEED=19 python -m pytest tests/test_spill_tier.py \
 		-k "two_run or chaos or identity" -q
+	@# ISSUE 20 matrix row: the PREFILL peer's channel killed by the
+	@# seeded schedule mid-KV-page-transfer (kill=3 lands ON the chunk
+	@# frame) — the decode peer must fall back to local prefill with a
+	@# client stream byte-identical to the unfaulted disagg stack, zero
+	@# pages spliced, and identical outcomes across two seeded runs
+	@# (asserted INSIDE the test).
+	CHAOS_TEST_SEED=5  python -m pytest tests/test_disagg.py -k chaos_kill -q
+	CHAOS_TEST_SEED=19 python -m pytest tests/test_disagg.py -k chaos_kill -q
 
 loadgen:           ## out-of-process SSE ingress herd against a spawned loopback stack
 	JAX_PLATFORMS=cpu python scripts/loadgen.py --spawn \

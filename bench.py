@@ -70,6 +70,7 @@ SAFE_OVERRIDES = {
     "BENCH_CONV_CACHE": "0",
     "BENCH_RAGGED_PREFILL": "0",
     "BENCH_SPILL_PAGES": "0",
+    "BENCH_DISAGG": "0",
 }
 
 
@@ -96,6 +97,15 @@ RESULT_ROW_KEYS = (
     "shared_prefix_tokens", "prefix_hit_tokens", "prefix_dedup_hits",
     "pages_used", "pages_free", "conversation_hit_rate",
     "spill_pages", "spill_tier_hit_rate", "spill_pagein_p50_ms",
+    # ISSUE 20 add-only extension: the disaggregated A/B.  `disagg` is
+    # the topology knob (two-engine prefill/decode fabric vs the
+    # single-engine loopback), the counters are the page wire motion,
+    # and kv_export_p50_ms is the TTFT split's transfer leg — the
+    # queue_wait/prefill_exec decomposition above carries the local
+    # legs, so a disagg-on/off twin pair splits TTFT end to end.
+    "disagg", "pages_shipped", "pages_spliced", "page_xfer_bytes",
+    "disagg_handoffs", "disagg_fallbacks", "affinity_hits",
+    "kv_export_p50_ms",
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",
     "clients", "engine_tok_s", "engine_tokens", "visible_tokens",
     "wall_s",
@@ -245,6 +255,19 @@ async def _run_attempt(model: str) -> dict:
     # Host-RAM KV spill tier (ISSUE 16) — off by default (the default
     # bench pool never fills); the memory-pressure sweep configs size it.
     spill_pages = int(os.environ.get("BENCH_SPILL_PAGES", "0"))
+    # Disaggregated prefill/decode A/B (ISSUE 20): BENCH_DISAGG=1 builds
+    # the two-engine fabric — a prefill-role peer exporting KV pages and
+    # a decode-role peer splicing them — behind run_proxy_fabric with
+    # prefix-affinity routing, instead of the single-engine loopback.
+    # Needs the prefix pool on both peers (the engine fences role=* back
+    # to "both" without it), so a pool-less config runs undisaggregated
+    # and the row says so.  SAFE_OVERRIDES pins it off: the fallback
+    # ladder must never gamble an 8B datapoint on a two-engine topology.
+    disagg = os.environ.get("BENCH_DISAGG", "0") == "1"
+    if disagg and not prefix_cache:
+        _log("BENCH_DISAGG=1 needs BENCH_PREFIX_CACHE=1; "
+             "running undisaggregated")
+        disagg = False
     # Cold-shared-prefix herd (the ISSUE 5 TTFT workload): prepend this
     # many tokens of IDENTICAL templated text to every measured client's
     # prompt — but not the warm client's, so the herd hits the prefix
@@ -296,27 +319,43 @@ async def _run_attempt(model: str) -> dict:
     # each decoded token crosses the tunnel as a RES_BODY-framed SSE chunk
     # and the headline number can be counted CLIENT-side (VERDICT r3
     # item 3: the r3 run measured with the tunnel idle).
+    ecfg_kw = dict(
+        model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
+        decode_steps=decode_steps, decode_steps_eager=eager_steps,
+        prefill_rows=prefill_rows, quant=quant,
+        quant_group_size=quant_group,
+        prefill_act_quant=pf8, flash_decode=flash_decode,
+        flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
+        kv_quant=kv_quant, prefix_cache=prefix_cache,
+        prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
+        spec_k=spec_k, spec_k_max=spec_k_max,
+        ragged_prefill=ragged_prefill,
+        mux=mux, mux_budget_tokens=mux_budget,
+        conv_cache=conv_cache and prefix_cache,
+        prefix_evict=prefix_evict,
+        spill_pages=spill_pages,
+    )
     engine = InferenceEngine(
         engine_cfg=EngineConfig(
-            model=model, num_slots=slots, max_seq=max_seq, dtype=dtype,
-            decode_steps=decode_steps, decode_steps_eager=eager_steps,
-            prefill_rows=prefill_rows, quant=quant,
-            quant_group_size=quant_group,
-            prefill_act_quant=pf8, flash_decode=flash_decode,
-            flash_sgrid=flash_sgrid, fused_decode_layer=fused_decode,
-            kv_quant=kv_quant, prefix_cache=prefix_cache,
-            prefill_chunk=prefill_chunk, spec_ngram=spec_ngram,
-            spec_k=spec_k, spec_k_max=spec_k_max,
-            ragged_prefill=ragged_prefill,
-            mux=mux, mux_budget_tokens=mux_budget,
-            conv_cache=conv_cache and prefix_cache,
-            prefix_evict=prefix_evict,
-            spill_pages=spill_pages,
+            role="decode" if disagg else "both", **ecfg_kw,
         ),
         tokenizer=NumericTokenizer(vocab_size=get_config(model).vocab_size),
     )
+    # The prefill half of the disaggregated pair: EVERY numerics-relevant
+    # knob identical (same ecfg_kw — the pin check + byte-identity depend
+    # on it), only the role differs.
+    pre_engine = None
+    if disagg:
+        pre_engine = InferenceEngine(
+            engine_cfg=EngineConfig(role="prefill", **ecfg_kw),
+            tokenizer=NumericTokenizer(
+                vocab_size=get_config(model).vocab_size
+            ),
+        )
     _log(f"engine init (weights on device) took {time.monotonic() - t0:.1f}s")
     await engine.start()
+    if pre_engine is not None:
+        await pre_engine.start()
 
     # Warmup hints (see engine._warmup_views / _warm_aot_parallel): the
     # bench KNOWS its maximum reachable context — the server's OWN chat
@@ -353,6 +392,11 @@ async def _run_attempt(model: str) -> dict:
 
     t0 = time.monotonic()
     await engine.warmup()
+    if pre_engine is not None:
+        # Same hint env vars: the prefill peer prefills the same prompt
+        # shapes; its decode programs are dead weight but warmup is the
+        # only place the shared compile cache gets populated.
+        await pre_engine.warmup()
     _log(f"decode warmup (view x steps compiles) took {time.monotonic() - t0:.1f}s")
     # Cold-start breakdown (ISSUE 12): captured NOW — the post-warmup
     # global_metrics.reset() below wipes the gauges, and cold start
@@ -367,14 +411,35 @@ async def _run_attempt(model: str) -> dict:
         global_metrics.gauge("engine_warmup_compile_max_s"), 2
     )
 
-    serve_ch, proxy_ch = loopback_pair()
-    serve_task = asyncio.create_task(
-        run_serve(serve_ch, backend=engine_backend(engine, model))
-    )
     ready: asyncio.Future = asyncio.get_running_loop().create_future()
-    proxy_task = asyncio.create_task(
-        run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready)
-    )
+    serve_tasks = []
+    if disagg:
+        # Two serve peers behind one fabric proxy (mirrors
+        # testing/local_stack._amain_disagg): the decode peer is the
+        # measured engine; the prefill peer exists to ship KV pages.
+        from p2p_llm_tunnel_tpu.endpoints.proxy import (
+            ProxyState,
+            run_proxy_fabric,
+        )
+
+        state = ProxyState(fabric=True)
+        for pid, eng in (("prefill-0", pre_engine), ("decode-0", engine)):
+            serve_ch, proxy_ch = loopback_pair()
+            serve_tasks.append(asyncio.create_task(run_serve(
+                serve_ch, backend=engine_backend(eng, model),
+            )))
+            await state.admit(proxy_ch, pid)
+        proxy_task = asyncio.create_task(
+            run_proxy_fabric(state, "127.0.0.1", 0, ready=ready)
+        )
+    else:
+        serve_ch, proxy_ch = loopback_pair()
+        serve_tasks.append(asyncio.create_task(
+            run_serve(serve_ch, backend=engine_backend(engine, model))
+        ))
+        proxy_task = asyncio.create_task(
+            run_proxy(proxy_ch, "127.0.0.1", 0, ready=ready)
+        )
     port = await asyncio.wait_for(ready, 30.0)
 
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
@@ -438,14 +503,17 @@ async def _run_attempt(model: str) -> dict:
 
             jax.profiler.stop_trace()
             _log(f"profiler trace written to {profile_dir}")
-        serve_task.cancel()
         proxy_task.cancel()
-        for t in (serve_task, proxy_task):
+        for t in serve_tasks:
+            t.cancel()
+        for t in (*serve_tasks, proxy_task):
             try:
                 await t
             except (asyncio.CancelledError, RuntimeError):
                 pass
         await engine.stop()
+        if pre_engine is not None:
+            await pre_engine.stop()
 
     # Headline tok/s counts tokens RECEIVED BY THE HTTP CLIENTS as SSE
     # deltas — every one crossed the tunnel as a RES_BODY frame, so frame
@@ -585,6 +653,34 @@ async def _run_attempt(model: str) -> dict:
         "spill_tier_hit_rate": spill_hit_rate,
         "spill_pagein_p50_ms": round(
             global_metrics.percentile("engine_spill_pagein_ms", 50), 1
+        ),
+        # Disaggregated A/B (ISSUE 20): topology knob + page wire motion
+        # (both engines share this process's registry, so shipped counts
+        # the prefill peer and spliced the decode peer) + the transfer
+        # leg of the TTFT split — queue_wait/prefill_exec above are the
+        # local legs.
+        "disagg": disagg,
+        "pages_shipped": int(
+            global_metrics.counter("engine_pages_shipped_total")
+        ),
+        "pages_spliced": int(
+            global_metrics.counter("engine_pages_spliced_total")
+        ),
+        "page_xfer_bytes": int(
+            global_metrics.counter("engine_page_xfer_bytes_total")
+        ),
+        "disagg_handoffs": int(
+            global_metrics.counter("proxy_disagg_handoffs_total")
+        ),
+        "disagg_fallbacks": int(
+            global_metrics.counter("proxy_disagg_fallbacks_total")
+        ),
+        "affinity_hits": int(
+            global_metrics.counter("proxy_affinity_hits_total")
+        ),
+        "kv_export_p50_ms": (
+            round(global_metrics.percentile("engine_page_export_ms", 50), 1)
+            if disagg else None
         ),
         # Cold-start breakdown (ISSUE 12): captured before the
         # post-warmup metrics reset above.

@@ -293,6 +293,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="pinned host-RAM spill tier capacity in KV "
                             "pages (0 = off); cold pages migrate out of "
                             "HBM under pressure and splice back on reuse")
+    serve.add_argument("--role",
+                       choices=["both", "prefill", "decode"],
+                       default=_env("TUNNEL_ROLE", "both"),
+                       help="disaggregated serving role: 'prefill' peers "
+                            "take proxy export probes and ship KV pages "
+                            "over the tunnel; 'decode' peers splice "
+                            "shipped pages and stream tokens; 'both' "
+                            "(default) serves classic single-engine")
     serve.add_argument("--conv-cache",
                        action=argparse.BooleanOptionalAction,
                        default=_env("TUNNEL_CONV_CACHE", "1") == "1",
@@ -676,6 +684,7 @@ async def _engine_backend(args):
                     tenant_weights=args.tenant_weights,
                     watchdog_budget_s=args.watchdog_budget,
                     seed=seed,
+                    role=getattr(args, "role", "both"),
                 )
             )
 

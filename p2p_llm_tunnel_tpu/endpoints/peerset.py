@@ -31,15 +31,19 @@ and doubling the cooldown.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import random
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Union
+from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from p2p_llm_tunnel_tpu.protocol.frames import (
+    KV_EXPORT_HEADER,
+    MAX_BODY_CHUNK,
     Agree,
     Hello,
+    KvPagesManifest,
     MessageType,
     ProtocolError,
     RequestHeaders,
@@ -119,7 +123,36 @@ class _Resumed:
     token: str
 
 
-_StreamEvent = Union[_Headers, _Body, _Error, _End, _Resumed]
+@dataclass
+class _KvHdr:
+    """KV_PAGES_HDR: a prefill peer is answering our export probe with a
+    page manifest (ISSUE 20); CHUNK payloads follow as _Body events."""
+
+    manifest: KvPagesManifest
+
+
+@dataclass
+class _KvAck:
+    """KV_PAGES_ACK: the decode peer spliced ``spliced`` pages from the
+    transfer we pushed (ISSUE 20)."""
+
+    spliced: int
+
+
+_StreamEvent = Union[_Headers, _Body, _Error, _End, _Resumed, _KvHdr, _KvAck]
+
+
+def _hrw_score(peer_id: str, key: bytes) -> int:
+    """Rendezvous (highest-random-weight) hash: every proxy ranks every
+    peer for a given affinity key identically, and a peer join/leave only
+    remaps the keys that hashed to the changed peer — exactly the
+    stability prefix-affinity routing needs (a rebalance that reshuffled
+    every key would cold-start every conversation's prefix)."""
+    return int.from_bytes(
+        hashlib.blake2b(
+            peer_id.encode() + key, digest_size=8
+        ).digest(), "big",
+    )
 
 
 class PeerLink:
@@ -131,6 +164,13 @@ class PeerLink:
         self.state = PEER_LIVE
         self.ready = False  # set once HELLO/AGREE completes
         self.flow_enabled = False
+        #: Serving role from AGREE (ISSUE 20): "both" (classic), "prefill"
+        #: (takes export probes, skipped for normal dispatch when any
+        #: alternative exists), or "decode" (preferred dispatch target in
+        #: a disaggregated topology).
+        self.role = "both"
+        #: Peer negotiated the "kvpages" feature — KV transfers allowed.
+        self.kvpages = False
         self.pending: Dict[int, "asyncio.Queue[_StreamEvent]"] = {}
         self.rtt_ms: Optional[float] = None
         self.health = ""  # last /healthz status string ("" = never probed)
@@ -277,9 +317,11 @@ class PeerSet:
         if agree_msg.msg_type != MessageType.AGREE:
             raise RuntimeError(f"expected AGREE, got {agree_msg.msg_type.name}")
         agree = Agree.from_json(agree_msg.payload)
-        log.info("received AGREE: version=%d features=%s",
-                 agree.version, agree.features)
+        log.info("received AGREE: version=%d features=%s role=%s",
+                 agree.version, agree.features, agree.role)
         link.flow_enabled = "flow" in agree.features
+        link.role = agree.role
+        link.kvpages = "kvpages" in agree.features
         link.ready = True
         self.peers[peer_id] = link
         self.ever_ready = True
@@ -292,12 +334,23 @@ class PeerSet:
 
     # -- dispatch policy (ReplicaRouter's pick, proxy-side) ---------------
 
-    def pick(self, exclude: Iterable[str] = ()) -> Optional[PeerLink]:
+    def pick(self, exclude: Iterable[str] = (),
+             affinity: Optional[bytes] = None) -> Optional[PeerLink]:
         """Health-aware least-loaded link, round-robin tiebreak.
 
         Live peers win over degraded ones; draining/dead/breaker-open links
         are skipped.  A link whose breaker cooldown just elapsed is
         admitted as the single half-open probe.
+
+        ``affinity`` (ISSUE 20) is the request's prefix-chain affinity key:
+        when present, the pick WITHIN the best health tier is the
+        rendezvous-hash winner instead of the least-loaded link, so
+        same-prefix requests land on the peer whose pool already holds the
+        chain.  Health always overrides affinity — a degraded/draining/
+        breaker-open favorite loses the request to a healthy peer exactly
+        as before; affinity only replaces the tie-break among equals.
+        Prefill-role peers are skipped for normal dispatch whenever any
+        alternative exists (they serve export probes, not clients).
         """
         now = time.monotonic()
         excluded = set(exclude)
@@ -306,17 +359,53 @@ class PeerSet:
             if l.peer_id not in excluded
             and l.dispatchable(now, enforce_breaker=self.fabric)
         ]
+        non_prefill = [l for l in candidates if l.role != "prefill"]
+        if non_prefill:
+            candidates = non_prefill
         if not candidates:
             return None
-        key = lambda l: (0 if l.state == PEER_LIVE else 1, l.inflight)
-        low = min(key(l) for l in candidates)
-        lowest = [l for l in candidates if key(l) == low]
-        self._rr = (self._rr + 1) % len(lowest)
-        chosen = lowest[self._rr % len(lowest)]
+        if affinity:
+            tier = min(0 if l.state == PEER_LIVE else 1 for l in candidates)
+            pool = [
+                l for l in candidates
+                if (0 if l.state == PEER_LIVE else 1) == tier
+            ]
+            chosen = max(
+                pool, key=lambda l: _hrw_score(l.peer_id, affinity)
+            )
+            if len(pool) > 1:
+                # Only meaningful when affinity actually had a choice to
+                # make — a 1-candidate "hit" would just count dispatches.
+                global_metrics.inc("proxy_affinity_hits_total")
+        else:
+            key = lambda l: (0 if l.state == PEER_LIVE else 1, l.inflight)
+            low = min(key(l) for l in candidates)
+            lowest = [l for l in candidates if key(l) == low]
+            self._rr = (self._rr + 1) % len(lowest)
+            chosen = lowest[self._rr % len(lowest)]
         if self.fabric and chosen.consec_failures >= CB_THRESHOLD:
             # Past-cooldown pick of a tripped link IS the half-open probe.
             chosen.half_open_inflight = True
         return chosen
+
+    def kv_prefill_peer(self, exclude: Iterable[str] = ()) -> Optional[PeerLink]:
+        """The link to send a disaggregated export probe to (ISSUE 20):
+        a dispatchable prefill-role peer that negotiated "kvpages", or
+        None — in which case the proxy simply dispatches undisaggregated.
+        """
+        now = time.monotonic()
+        excluded = set(exclude)
+        pool = [
+            l for l in self.peers.values()
+            if l.peer_id not in excluded and l.role == "prefill"
+            and l.kvpages
+            and l.dispatchable(now, enforce_breaker=self.fabric)
+        ]
+        if not pool:
+            return None
+        return min(
+            pool, key=lambda l: (0 if l.state == PEER_LIVE else 1, l.inflight)
+        )
 
     def resume_candidates(
         self, prefer_peer_id: str, exclude: Iterable[str] = (),
@@ -505,6 +594,37 @@ class PeerSet:
                     # overload the typed codes exist for.
                     log.debug("post-stream tunnel error for %d: %s",
                               msg.stream_id, text)
+            elif msg.msg_type == MessageType.KV_PAGES_HDR:
+                # Disaggregated transfer answers (ISSUE 20): a prefill peer
+                # responding to our export probe.  CHUNK payloads reuse the
+                # _Body event; END reuses _End — the consuming helper knows
+                # which vocabulary it asked for.
+                try:
+                    manifest = KvPagesManifest.from_json(msg.payload)
+                except ProtocolError as e:
+                    log.warning("bad KV_PAGES_HDR payload: %s", e)
+                    continue
+                q = link.pending.get(msg.stream_id)
+                if q is not None:
+                    q.put_nowait(_KvHdr(manifest))
+            elif msg.msg_type == MessageType.KV_PAGES_CHUNK:
+                q = link.pending.get(msg.stream_id)
+                if q is not None:
+                    q.put_nowait(_Body(msg.payload))
+            elif msg.msg_type == MessageType.KV_PAGES_END:
+                q = link.pending.pop(msg.stream_id, None)
+                if q is not None:
+                    q.put_nowait(_End())
+                    self._publish_gauges()
+            elif msg.msg_type == MessageType.KV_PAGES_ACK:
+                q = link.pending.pop(msg.stream_id, None)
+                if q is not None:
+                    try:
+                        q.put_nowait(_KvAck(msg.kv_ack_spliced()))
+                    except ProtocolError as e:
+                        log.warning("bad KV_PAGES_ACK payload: %s", e)
+                        q.put_nowait(_Error("bad kv ack", None))
+                    self._publish_gauges()
             elif msg.msg_type == MessageType.PING:
                 try:
                     await channel.send(TunnelMessage.pong().encode())
@@ -614,6 +734,116 @@ class PeerSet:
             status = ""
         self.apply_health(link, status)
         return status
+
+    # -- disaggregated KV transfers (ISSUE 20) ----------------------------
+
+    async def kv_export_fetch(
+        self, link: PeerLink, req: RequestHeaders, body: bytes,
+        timeout: float,
+    ) -> Optional[Tuple[KvPagesManifest, bytes]]:
+        """Ask a prefill peer to prefill ``req`` and ship its KV pages.
+
+        Sends the original request (method/path/headers/body unchanged)
+        on a DEDICATED stream tagged KV_EXPORT_HEADER; the peer answers in
+        the KV_PAGES vocabulary or a plain ERROR.  Returns (manifest,
+        page bytes) or None on refusal/timeout/death — every None means
+        "dispatch without pages", never a client-visible failure.  The
+        transfer stream is flow-controlled like a response body: credit is
+        granted back as chunks are consumed here.
+        """
+        sid = self.alloc_stream_id()
+        q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  bounded in bytes by the transfer's own flow-control credit window; the stream is torn down at `timeout`
+        link.pending[sid] = q
+        try:
+            return await asyncio.wait_for(
+                self._kv_export_inner(link, sid, req, body, q), timeout
+            )
+        except (asyncio.TimeoutError, ChannelClosed):
+            return None
+        finally:
+            link.pending.pop(sid, None)
+
+    async def _kv_export_inner(
+        self, link: PeerLink, sid: int, req: RequestHeaders, body: bytes,
+        q: "asyncio.Queue[_StreamEvent]",
+    ) -> Optional[Tuple[KvPagesManifest, bytes]]:
+        headers = dict(req.headers)
+        headers[KV_EXPORT_HEADER] = "1"
+        await link.channel.send(TunnelMessage.req_headers(
+            RequestHeaders(sid, req.method, req.path, headers)
+        ).encode())
+        for off in range(0, len(body), MAX_BODY_CHUNK):
+            await link.channel.send(TunnelMessage.req_body(
+                sid, body[off:off + MAX_BODY_CHUNK]
+            ).encode())
+        await link.channel.send(TunnelMessage.req_end(sid).encode())
+        manifest: Optional[KvPagesManifest] = None
+        buf = bytearray()
+        while True:
+            ev = await q.get()
+            if isinstance(ev, _KvHdr):
+                manifest = ev.manifest
+            elif isinstance(ev, _Body):
+                buf.extend(ev.data)
+                if link.flow_enabled:
+                    # The serve side debits its per-stream credit per
+                    # chunk exactly like a response body — replenish as
+                    # we consume, or a transfer > INITIAL_CREDIT stalls.
+                    await link.channel.send(
+                        TunnelMessage.flow(sid, len(ev.data)).encode()
+                    )
+            elif isinstance(ev, _End):
+                if manifest is None or manifest.total_bytes() != len(buf):
+                    return None
+                return manifest, bytes(buf)
+            elif isinstance(ev, (_Error, _KvAck)):
+                # ERROR = typed/plain refusal ("no pages", draining, pin
+                # trouble); an ACK here is a protocol mixup.  Either way:
+                # no pages.
+                return None
+
+    async def kv_splice_push(
+        self, link: PeerLink, manifest: KvPagesManifest, blob: bytes,
+        timeout: float,
+    ) -> Optional[int]:
+        """Relay an exported transfer to a decode peer and await its ACK.
+
+        Opens a DEDICATED stream on ``link`` (request direction — these
+        frames carry no RES_* machinery), pushes HDR + CHUNK* + END, and
+        returns the spliced-page count from KV_PAGES_ACK — or None on a
+        typed ``page_pin`` refusal, malformed-transfer ERROR, timeout, or
+        link death.  None tells the proxy the decode peer will re-prefill
+        locally; the follow-up request is dispatched either way.
+        """
+        sid = self.alloc_stream_id()
+        q: "asyncio.Queue[_StreamEvent]" = asyncio.Queue()  # tunnelcheck: disable=TC10  receives exactly one ACK or ERROR event; the stream is torn down at `timeout`
+        link.pending[sid] = q
+        try:
+            return await asyncio.wait_for(
+                self._kv_splice_inner(link, sid, manifest, blob, q), timeout
+            )
+        except (asyncio.TimeoutError, ChannelClosed):
+            return None
+        finally:
+            link.pending.pop(sid, None)
+
+    async def _kv_splice_inner(
+        self, link: PeerLink, sid: int, manifest: KvPagesManifest,
+        blob: bytes, q: "asyncio.Queue[_StreamEvent]",
+    ) -> Optional[int]:
+        manifest.stream_id = sid
+        await link.channel.send(TunnelMessage.kv_pages_hdr(manifest).encode())
+        for off in range(0, len(blob), MAX_BODY_CHUNK):
+            await link.channel.send(TunnelMessage.kv_pages_chunk(
+                sid, blob[off:off + MAX_BODY_CHUNK]
+            ).encode())
+        await link.channel.send(TunnelMessage.kv_pages_end(sid).encode())
+        while True:
+            ev = await q.get()
+            if isinstance(ev, _KvAck):
+                return ev.spliced
+            if isinstance(ev, (_Error, _End)):
+                return None
 
     # -- fleet scraping (ISSUE 9) -----------------------------------------
 

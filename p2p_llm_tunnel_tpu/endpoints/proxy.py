@@ -30,6 +30,7 @@ single-channel proxy, except that abort errors now carry typed
 from __future__ import annotations
 
 import asyncio
+import json
 import random
 import time
 from typing import AsyncIterator, Dict, Optional
@@ -262,6 +263,98 @@ async def _fleet_postmortem_response(state: ProxyState) -> HttpResponse:
     )
 
 
+#: Generation paths whose requests carry a prompt worth affinity-routing
+#: and disaggregating (the engine API's four serving surfaces).
+_GEN_PATHS = frozenset({
+    "/v1/chat/completions", "/v1/completions", "/api/generate", "/api/chat",
+})
+
+#: Budget for one disaggregated handoff leg (export fetch, splice push).
+#: Blown budget = fall back to undisaggregated dispatch, never an error.
+DISAGG_XFER_TIMEOUT = 30.0
+
+
+def _affinity_key(req: HttpRequest) -> Optional[bytes]:
+    """The request's prefix-chain affinity key (ISSUE 20), or None.
+
+    Same-prefix requests must hash identically, so the key is the stable
+    ROOT of the prefix chain: a chat conversation's first message content
+    (turn N keeps routing where turns 1..N-1 warmed the pool), or the
+    first 256 bytes of a completion prompt.  Non-generation paths and
+    unparseable bodies return None — those dispatch least-loaded exactly
+    as before.
+    """
+    path = req.path.split("?")[0]
+    if path not in _GEN_PATHS:
+        return None
+    try:
+        payload = json.loads(req.body or b"{}")
+    except ValueError:
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if path in ("/v1/chat/completions", "/api/chat"):
+        msgs = payload.get("messages")
+        if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
+            root = str(msgs[0].get("content", ""))
+            return root.encode("utf-8", "replace")[:256] or None
+        return None
+    prompt = payload.get("prompt", "")
+    if isinstance(prompt, list):
+        prompt = prompt[0] if prompt else ""
+    return str(prompt).encode("utf-8", "replace")[:256] or None
+
+
+async def _disagg_handoff(
+    state: ProxyState, pre: PeerLink, target: PeerLink,
+    req: HttpRequest, headers_out_tunnel: Dict[str, str],
+) -> None:
+    """One disaggregated prefill→decode handoff (ISSUE 20), best-effort.
+
+    Sends the request to the prefill peer as an export probe (it runs
+    admission + prefill and ships the prompt's KV pages), then relays the
+    transfer to the decode target, which splices the pages into its own
+    pool through the two-phase verify/commit path.  The follow-up
+    dispatch is wire-unchanged — the decode peer's own prefix match finds
+    the spliced pages by content address.
+
+    NEVER raises and never blocks the request beyond the transfer
+    budget: any refusal, pin mismatch, timeout, or peer death counts a
+    fallback and the request dispatches undisaggregated — disaggregation
+    is a pure optimization, not a new failure mode.
+    """
+    t0 = time.monotonic()
+    try:
+        tun_req = RequestHeaders(
+            0, req.method, req.path, headers_out_tunnel,
+        )
+        got = await state.kv_export_fetch(
+            pre, tun_req, req.body, DISAGG_XFER_TIMEOUT,
+        )
+        if got is None:
+            global_metrics.inc("proxy_disagg_fallbacks_total")
+            return
+        manifest, blob = got
+        spliced = await state.kv_splice_push(
+            target, manifest, blob, DISAGG_XFER_TIMEOUT,
+        )
+        if spliced is None:
+            # None = the transfer itself failed (refusal, timeout, dead
+            # peer).  An ack of ZERO pages is a completed transfer — the
+            # target already holds every offered page — not a fallback.
+            global_metrics.inc("proxy_disagg_fallbacks_total")
+            return
+        global_metrics.inc("proxy_disagg_handoffs_total")
+        log.debug(
+            "disagg handoff %s -> %s: %d page(s), %d bytes, %.1fms",
+            pre.peer_id, target.peer_id, spliced, len(blob),
+            (time.monotonic() - t0) * 1000.0,
+        )
+    except Exception as e:  # best-effort by contract
+        log.warning("disagg handoff failed: %s", e)
+        global_metrics.inc("proxy_disagg_fallbacks_total")
+
+
 async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpResponse:
     """One HTTP request through the tunnel (proxy.rs:249-426), with
     health-routed dispatch and transparent failover across the PeerSet."""
@@ -411,21 +504,40 @@ async def handle_proxy_request(state: ProxyState, req: HttpRequest) -> HttpRespo
         for k, v in req.headers.items()
     )
 
+    # Prefix-affinity routing + disaggregation (ISSUE 20): generation
+    # requests carry a stable affinity key so same-prefix traffic lands on
+    # the peer whose pool is already warm; health still overrides (pick()
+    # only applies affinity within the best health tier).
+    affinity = _affinity_key(req)
+
     failures = 0
     tried: set = set()
     first_fail_t: Optional[float] = None
     while True:
-        link = state.pick(exclude=tried)
+        link = state.pick(exclude=tried, affinity=affinity)
         if link is None and tried:
             # Every untried peer is gone; a previously-tried one may have
             # recovered (or be the only one left) — better than failing.
-            link = state.pick()
+            link = state.pick(affinity=affinity)
         if link is None:
             finish_span(503, attempts=failures)
             return _plain(
                 503, "Tunnel error: [peer_lost] no live serve peer",
                 {"retry-after": str(PEER_LOST_RETRY_AFTER_S)},
             )
+        if (affinity is not None and failures == 0
+                and link.kvpages and link.role != "prefill"):
+            # Disaggregated handoff (first attempt only — a failover is
+            # already paying a latency bill): if a prefill-role peer is
+            # up, have it prefill this prompt and ship the KV pages to
+            # the chosen decode target before the request itself goes
+            # out.  Best-effort: every failure path inside falls back to
+            # plain dispatch.
+            pre = state.kv_prefill_peer(exclude=(link.peer_id,))
+            if pre is not None:
+                await _disagg_handoff(
+                    state, pre, link, req, headers_out_tunnel,
+                )
         outcome = await _dispatch_once(
             state, link, req, headers_out_tunnel, t_start, first_fail_t,
             trace_id, root_span, finish_span, failures, idempotent,

@@ -34,9 +34,11 @@ from p2p_llm_tunnel_tpu.protocol.frames import (
     ERROR_CODE_HEADER,
     ERROR_CODES,
     INITIAL_CREDIT,
+    KV_EXPORT_HEADER,
     MAX_BODY_CHUNK,
     Agree,
     Hello,
+    KvPagesManifest,
     MessageType,
     ProtocolError,
     RequestHeaders,
@@ -582,6 +584,105 @@ async def _send_simple(
     await channel.send(TunnelMessage.res_end(stream_id).encode())
 
 
+async def _handle_kv_export(
+    channel: Channel, req: RequestHeaders, body: bytes, flow: FlowControl,
+    kv_export,
+) -> None:
+    """Prefill-side half of a disaggregated handoff (ISSUE 20).
+
+    The proxy sent a normal generation request tagged KV_EXPORT_HEADER;
+    the backend hook runs admission + prefill for it (one truncated
+    generation — every existing scheduling/chunking/mux path untouched)
+    and hands back the prompt's resident chain prefix.  The answer rides
+    the SAME stream in the KV_PAGES vocabulary: HDR (manifest) + CHUNK*
+    (page bytes, flow-controlled like a response body) + END.
+
+    Anything that prevents a useful export — backend refusal, admission
+    shed, empty chain, crash — answers a plain ERROR frame instead.  The
+    proxy treats any non-KV answer as "dispatch without pages": this
+    path can only ever decline the optimization, never fail a request.
+    """
+    sid = req.stream_id
+    try:
+        flow.open(sid)
+        try:
+            export = await kv_export(req, body)
+        except Exception as e:  # advisory path: never tear down the link
+            log.warning("kv export failed for stream %d: %s", sid, e)
+            export = None
+        if not export or not export.get("pages"):
+            await channel.send(TunnelMessage.error(
+                sid, "kv export: no resident pages to ship"
+            ).encode())
+            return
+        manifest = KvPagesManifest(
+            sid, meta=dict(export["meta"]), pages=list(export["pages"]),
+        )
+        await channel.send(TunnelMessage.kv_pages_hdr(manifest).encode())
+        blob = b"".join(export["blobs"])
+        for off in range(0, len(blob), MAX_BODY_CHUNK):
+            chunk = bytes(blob[off:off + MAX_BODY_CHUNK])
+            await flow.consume(sid, len(chunk))
+            await channel.send(
+                TunnelMessage.kv_pages_chunk(sid, chunk).encode()
+            )
+        await channel.send(TunnelMessage.kv_pages_end(sid).encode())
+        log.debug("kv export %d: shipped %d page(s), %d bytes",
+                  sid, len(manifest.pages), len(blob))
+    except ChannelClosed:
+        log.debug("channel closed during kv export for stream %d", sid)
+    finally:
+        flow.close(sid)
+
+
+async def _handle_kv_import(
+    channel: Channel, stream_id: int, manifest: KvPagesManifest,
+    buf: bytes, kv_import,
+) -> None:
+    """Decode-side half of a disaggregated handoff (ISSUE 20).
+
+    Splits the accumulated transfer into per-page blobs (manifest order,
+    sizes from the manifest — the same accounting the checksums cover)
+    and splices them through the engine's two-phase page-in.  A pin
+    mismatch answers the TYPED ``page_pin`` refusal — legal here because
+    this is a dedicated transfer stream, never a request stream a proxy
+    would demux as a request failure.  Success answers KV_PAGES_ACK with
+    the spliced count.  Either way the decode peer serves the follow-up
+    request normally: with a warm prefix on ACK, with a local re-prefill
+    otherwise.
+    """
+    try:
+        blobs = []
+        off = 0
+        for p in manifest.pages:
+            n = int(p["nbytes"])
+            blobs.append(bytes(buf[off:off + n]))
+            off += n
+        if off != len(buf):
+            raise ProtocolError(
+                f"kv transfer size mismatch: manifest claims {off} "
+                f"bytes, received {len(buf)}"
+            )
+        spliced = await kv_import(manifest.meta, manifest.pages, blobs)
+        await channel.send(
+            TunnelMessage.kv_pages_ack(stream_id, int(spliced)).encode()
+        )
+        log.debug("kv import %d: spliced %d page(s)", stream_id, spliced)
+    except ChannelClosed:
+        log.debug("channel closed during kv import for stream %d", stream_id)
+    except Exception as e:
+        log.warning("kv import failed for stream %d: %s", stream_id, e)
+        code = getattr(e, "tunnel_code", None)
+        if code is not None:
+            frame = TunnelMessage.typed_error(stream_id, code, str(e))
+        else:
+            frame = TunnelMessage.error(stream_id, f"kv import failed: {e}")
+        try:
+            await channel.send(frame.encode())
+        except ChannelClosed:
+            pass
+
+
 def _retry_after_s(inflight: int) -> float:
     """Advisory Retry-After for a serve-layer 429, derived from the live
     load instead of a constant: the time to turn over the current
@@ -595,7 +696,7 @@ def _retry_after_s(inflight: int) -> float:
 
 async def _send_healthz(
     channel: Channel, stream_id: int, draining: bool, inflight: int,
-    peer_label: str = "",
+    peer_label: str = "", disagg: Optional[Dict[str, object]] = None,
 ) -> None:
     """/healthz: ok|degraded|draining + queue/occupancy from the metrics
     registry (engine gauges; zeros under the plain HTTP backend).  200 only
@@ -704,6 +805,12 @@ async def _send_healthz(
         "config": {
             "fences": global_metrics.info("config_fences", []) or [],
         },
+        # ISSUE 20 observability: the disaggregated prefill/decode ledger —
+        # this peer's serving role, pages shipped (prefill side) and
+        # spliced from the wire (decode side), and the in-flight transfer
+        # count (nonzero at rest is a leak; loadgen's post-run gate
+        # asserts it).  null under backends with no engine.
+        "disagg": disagg,
         "prefix_pool": {
             "blocks_used": int(
                 global_metrics.gauge("engine_prefix_pool_blocks_used")
@@ -865,18 +972,26 @@ async def run_serve(
         raise RuntimeError(f"expected HELLO, got {hello_msg.msg_type.name}")
     hello = Hello.from_json(hello_msg.payload)
     agree = Agree.from_hello(hello)
+    # Role advertisement (ISSUE 20): a role-split engine stamps its serving
+    # role into AGREE so the proxy's PeerSet can route by it — prefill
+    # peers take export probes, decode peers take the affinity-routed
+    # dispatch.  "both" (the default) is omitted from the wire entirely.
+    agree.role = str(getattr(backend, "engine_role", "both") or "both")
     await channel.send(TunnelMessage.agree(agree).encode())
     flow = FlowControl("flow" in agree.features)
+    features = frozenset(agree.features)
     # Fabric identity (ISSUE 9): a fabric proxy stamps the peer id it
     # assigned this link into HELLO; serve-side spans carry it so the
     # stitched fleet trace can attribute them to the right process lane.
     # Empty for classic 2-peer rooms and reference peers (wire unchanged).
     peer_label = hello.peer
-    log.info("sent AGREE, tunnel ready (flow control %s%s)",
+    log.info("sent AGREE, tunnel ready (flow control %s%s%s)",
              "on" if flow.enabled else "off",
+             f", role {agree.role}" if agree.role != "both" else "",
              f", fabric peer id {peer_label!r}" if peer_label else "")
 
     pending: Dict[int, Tuple[RequestHeaders, bytearray]] = {}
+    kv_pending: Dict[int, Tuple[KvPagesManifest, bytearray]] = {}
     request_tasks: set[asyncio.Task] = set()
 
     async def keepalive() -> None:
@@ -970,6 +1085,7 @@ async def run_serve(
                 await _serve_dispatch(
                     channel, backend, flow, pending, request_tasks,
                     max_inflight, drain, msg, peer_label, resume_cfg,
+                    features, kv_pending,
                 )
             except ChannelClosed:
                 # The drainer can close the channel between our recv and a
@@ -1007,12 +1123,23 @@ async def _serve_dispatch(
     msg: TunnelMessage,
     peer_label: str = "",
     resume_cfg: Optional[ResumeConfig] = None,
+    features: frozenset = frozenset(),
+    kv_pending: Optional[Dict[int, Tuple[KvPagesManifest, bytearray]]] = None,
 ) -> None:
     """Handle one decoded inbound frame for the serve loop.
 
     ChannelClosed from any reply send propagates to the caller, which
     distinguishes a drain-close (clean return) from a dead tunnel (retry).
+
+    ``features`` is the negotiated AGREE feature set; the KV_PAGES arms
+    (ISSUE 20) only engage when "kvpages" was negotiated AND the backend
+    exposes the engine hooks — otherwise transfers get a plain ERROR and
+    the proxy falls back to undisaggregated dispatch.  ``kv_pending``
+    accumulates in-flight inbound transfers (HDR → CHUNK* → END), keyed
+    by stream id like ``pending``.
     """
+    if kv_pending is None:
+        kv_pending = {}
     if msg.msg_type == MessageType.REQ_HEADERS:
         try:
             headers = RequestHeaders.from_json(msg.payload)
@@ -1039,6 +1166,26 @@ async def _serve_dispatch(
                     parent_id=tctx.span_id or None, track="serve",
                     attrs={"stream_id": req.stream_id, "path": path},
                 )
+            if any(k.lower() == KV_EXPORT_HEADER for k in req.headers):
+                # Disaggregated export probe (ISSUE 20): answered in the
+                # KV_PAGES vocabulary by its own task — prefill for a real
+                # prompt rides the engine's normal admission path and must
+                # not block the serve loop.  Unavailable (no engine hook,
+                # feature not negotiated, draining) → plain ERROR, which
+                # the proxy reads as "dispatch without pages".
+                kv_export = getattr(backend, "kv_export", None)
+                if (kv_export is None or "kvpages" not in features
+                        or (drain is not None and drain.is_set())):
+                    await channel.send(TunnelMessage.error(
+                        req.stream_id, "kv export unavailable"
+                    ).encode())
+                    return
+                task = asyncio.create_task(_handle_kv_export(
+                    channel, req, bytes(body), flow, kv_export,
+                ))
+                request_tasks.add(task)
+                task.add_done_callback(request_tasks.discard)
+                return
             route = http11.ops_route(req.method, req.path)
             if route is not None and route[0] == "healthz":
                 # Answered by the serve loop itself (not the backend) so
@@ -1075,11 +1222,13 @@ async def _serve_dispatch(
                         {"content-type": "application/json"},
                     )
                     return
+                stats = getattr(backend, "disagg_stats", None)
                 await _send_healthz(
                     channel, req.stream_id,
                     draining=drain is not None and drain.is_set(),
                     inflight=len(request_tasks),
                     peer_label=peer_label,
+                    disagg=stats() if stats is not None else None,
                 )
                 return
             if route is not None and route[0] == "metrics":
@@ -1196,6 +1345,55 @@ async def _serve_dispatch(
         if global_streams.detach_attachment(channel, msg.stream_id):
             log.info("proxy cancelled resumed stream %d: %s; re-parking",
                      msg.stream_id, msg.payload.decode("utf-8", "replace"))
+    elif msg.msg_type == MessageType.KV_PAGES_HDR:
+        # Inbound disaggregated transfer (ISSUE 20): the proxy is relaying
+        # a prefill peer's pages toward this decode peer on a dedicated
+        # stream.  Accumulate HDR → CHUNK* → END, then splice off-loop.
+        kv_import = getattr(backend, "kv_import", None)
+        if kv_import is None or "kvpages" not in features:
+            await channel.send(TunnelMessage.error(
+                msg.stream_id, "kv import unavailable"
+            ).encode())
+            return
+        try:
+            manifest = KvPagesManifest.from_json(msg.payload)
+        except ProtocolError as e:
+            log.warning("bad KV_PAGES_HDR payload: %s", e)
+            await channel.send(TunnelMessage.error(
+                msg.stream_id, f"bad kv manifest: {e}"
+            ).encode())
+            return
+        # The frame header's stream id is authoritative — the manifest was
+        # minted on the PREFILL link with that link's stream id and the
+        # proxy relays it verbatim.
+        manifest.stream_id = msg.stream_id
+        kv_pending[msg.stream_id] = (manifest, bytearray())  # tunnelcheck: disable=TC15  multi-frame lifecycle: released by the KV_PAGES_END arm below (pop) or the size-overrun eviction in the CHUNK arm; the registry dies with the serve loop's channel on disconnect
+    elif msg.msg_type == MessageType.KV_PAGES_CHUNK:
+        kv_entry = kv_pending.get(msg.stream_id)
+        if kv_entry is not None:
+            kv_entry[1].extend(msg.payload)
+            if len(kv_entry[1]) > kv_entry[0].total_bytes():
+                # A transfer larger than its own manifest is malformed —
+                # stop buffering it NOW (the manifest bounds memory).
+                kv_pending.pop(msg.stream_id, None)
+                await channel.send(TunnelMessage.error(
+                    msg.stream_id, "kv transfer exceeds manifest size"
+                ).encode())
+    elif msg.msg_type == MessageType.KV_PAGES_END:
+        kv_entry = kv_pending.pop(msg.stream_id, None)
+        if kv_entry is not None:
+            kv_import = getattr(backend, "kv_import", None)
+            if kv_import is None:
+                await channel.send(TunnelMessage.error(
+                    msg.stream_id, "kv import unavailable"
+                ).encode())
+                return
+            task = asyncio.create_task(_handle_kv_import(
+                channel, msg.stream_id, kv_entry[0], bytes(kv_entry[1]),
+                kv_import,
+            ))
+            request_tasks.add(task)
+            task.add_done_callback(request_tasks.discard)
     elif msg.msg_type == MessageType.PING:
         await channel.send(TunnelMessage.pong().encode())
     elif msg.msg_type == MessageType.PONG:

@@ -397,6 +397,64 @@ class EngineAPI:
                 f"prompt of {len(prompt_ids)} tokens exceeds max context {max_seq}"
             )
 
+    def _request_prompt_ids(self, path: str, payload: dict) -> list:
+        """The prompt token ids a generation request at ``path`` would
+        prefill — the same tokenization handle() runs, factored out so the
+        disagg export path (ISSUE 20) computes KV for EXACTLY the prompt
+        the decode peer will serve."""
+        if path in ("/v1/chat/completions", "/api/chat"):
+            messages = payload.get("messages")
+            if not isinstance(messages, list):
+                raise ValueError("messages must be a list")
+            return self._chat_prompt_ids(messages)
+        if path == "/v1/completions":
+            prompts = self._parse_prompts(payload.get("prompt", ""))
+            return prompts[0] if prompts else []
+        if path == "/api/generate":
+            return self.engine.tokenizer.encode(
+                str(payload.get("prompt", ""))
+            )
+        raise ValueError(f"path {path} has no prompt to export KV for")
+
+    async def kv_export(self, req: RequestHeaders, body: bytes):
+        """Prefill-role export entry (ISSUE 20): parse the request exactly
+        like handle() would, run admission + prefill for ONE token so the
+        prompt's pages land in the pool (ragged/chunked/mux paths all
+        unchanged — this IS a normal generation, truncated), then export
+        the resident chain prefix for the wire.
+
+        Returns the engine's export dict, or None when there is nothing
+        to ship — parse failure, admission shed, empty pool.  None means
+        "dispatch without pages" to the orchestrator; disaggregation must
+        never fail a request that plain routing would have served."""
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                return None
+            prompt_ids = self._request_prompt_ids(req.path, payload)
+            self._check_prompt(prompt_ids)
+            tenant = parse_tenant(req.headers)
+            if self.engine.admission_check(1, tenant) is not None:
+                return None
+            kwargs: dict = {"max_new_tokens": 1, "temperature": 0.0}
+            if tenant:
+                kwargs["tenant"] = tenant
+            deadline_ms = parse_deadline_ms(req.headers)
+            if deadline_ms is not None:
+                kwargs["deadline"] = (
+                    time.monotonic() + deadline_ms / 1000.0
+                )
+            gen = self.engine.generate(prompt_ids, **kwargs)
+            try:
+                async for _ev in gen:
+                    pass
+            finally:
+                await gen.aclose()
+            return await self.engine.export_kv_pages(prompt_ids)
+        except (QueueFull, DeadlineExceeded, ValueError, TypeError,
+                json.JSONDecodeError):
+            return None
+
     # -- OpenAI ----------------------------------------------------------
 
     def _models_payload(self):
@@ -1152,10 +1210,23 @@ class EngineAPI:
 
 
 def engine_backend(engine: InferenceEngine, model_name: str | None = None):
-    """Adapter: EngineAPI as a serve-endpoint Backend (endpoints/serve.py)."""
+    """Adapter: EngineAPI as a serve-endpoint Backend (endpoints/serve.py).
+
+    Disaggregation hooks (ISSUE 20) ride as attributes so run_serve can
+    discover them with getattr — the Backend callable contract itself is
+    unchanged, and http_backend (no engine, no pool) simply has none:
+    ``kv_export`` answers a prefill-side page export, ``kv_import``
+    splices a transfer into this engine's pool, ``disagg_stats`` feeds
+    the /healthz "disagg" section, and ``engine_role`` is stamped into
+    the AGREE handshake so the proxy's PeerSet routes by role.
+    """
     api = EngineAPI(engine, model_name)
 
     async def backend(req: RequestHeaders, body: bytes):
         return await api.handle(req, body)
 
+    backend.kv_export = api.kv_export
+    backend.kv_import = engine.import_kv_pages
+    backend.disagg_stats = engine.disagg_stats
+    backend.engine_role = engine.ecfg.role
     return backend

@@ -318,6 +318,17 @@ class EngineConfig:
     # count sinks below this mark, keeping a reserve of pre-paid shadows
     # so eviction under pressure migrates instead of destroys.
     spill_low_water: int = 4  # tunnelcheck: disable=TC08  derived drain-pacing knob (a fraction of prefix_pool_blocks in spirit); one more CLI surface would just invite mis-tuning the hysteresis — programmatic only
+    # Disaggregated prefill/decode (ISSUE 20): "both" (classic — every
+    # engine does everything), "prefill" (this peer computes prompt KV and
+    # EXPORTS finished-prompt pages over the tunnel; it remains a full
+    # engine so routing to it still works when the fleet degrades), or
+    # "decode" (this peer IMPORTS a prefill peer's pages — spliced through
+    # the same two-phase verify path as the spill tier — and enters decode
+    # with only the tail to prefill; byte-identical streams).  Either
+    # split role needs the prefix cache (pages ARE the transfer unit) and
+    # is fenced back to "both" without it — disaggregation is a pure
+    # optimization, never a new failure mode.
+    role: str = "both"
 
 
 @dataclass
@@ -709,6 +720,27 @@ class InferenceEngine:
                 "the spill tier shadows prefix-pool pages, which "
                 "prefix_cache=False leaves uninitialised",
             )
+        if self.ecfg.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"unknown engine role {self.ecfg.role!r} "
+                "(both | prefill | decode)"
+            )
+        if self.ecfg.role != "both" and not self.ecfg.prefix_cache:
+            self._fence(
+                "role", "both",
+                "disaggregated prefill/decode ships prefix-pool pages, "
+                "which prefix_cache=False leaves uninitialised",
+            )
+        if self.ecfg.role != "both" and self.mesh is not None:
+            # Same scope limit as the spill tier: pool leaves are
+            # mesh-sharded and per-page host copies would gather the mesh
+            # on the serving path.
+            self._fence(
+                "role", "both",
+                "pool leaves are mesh-sharded (tp/sp>1); exporting or "
+                "splicing per-page host copies would gather the mesh — "
+                "same scope limit as the spill tier",
+            )
         if self.ecfg.prefix_cache:
             from p2p_llm_tunnel_tpu.engine.prefix_cache import (
                 PrefixIndex,
@@ -799,7 +831,10 @@ class InferenceEngine:
             self._page_out_op = self._page_in_op = None
             self._spill_meta: Dict = {}
             self._spill_chaos = None
-            if self.ecfg.spill_pages > 0:
+            # The split roles (ISSUE 20) reuse the spill tier's page I/O
+            # ops and pin metadata for wire transfers, so they are built
+            # whenever EITHER consumer is configured.
+            if self.ecfg.spill_pages > 0 or self.ecfg.role != "both":
                 from p2p_llm_tunnel_tpu.transport.chaos import (
                     maybe_spill_chaos,
                 )
@@ -917,6 +952,14 @@ class InferenceEngine:
         self._flight_conv = 0
         self._flight_pageouts = 0
         self._flight_pageins = 0
+        # Disaggregation accounting (ISSUE 20): export/import run off the
+        # loop's iteration rhythm (API/serve-driven), so they ACCUMULATE
+        # here on the event loop and _flight_record drains the tallies
+        # into the next iteration's row.
+        self._pages_shipped_pending = 0
+        self._pages_spliced_pending = 0
+        self._pages_shipped_total = 0
+        self._kv_xfer_inflight = 0
         self._last_burst: Tuple[int, int] = (0, 0)
         # Postmortem black box: this engine contributes the config +
         # scheduler/slot/backlog snapshot to captured bundles (latest
@@ -4056,6 +4099,260 @@ class InferenceEngine:
         )
         return out
 
+    # -- disaggregated prefill/decode (ISSUE 20) --------------------------
+
+    def disagg_stats(self) -> Dict[str, object]:
+        """/healthz ``disagg`` section: role + transfer tallies.  The
+        ``xfer_inflight`` gauge is the loadgen leak-gate invariant —
+        nonzero after drain means a transfer's executor hop leaked."""
+        pi = self._prefix
+        return {
+            "role": self.ecfg.role,
+            "pages_shipped": self._pages_shipped_total,
+            "pages_spliced": (
+                pi.wire_spliced if pi is not None else 0
+            ),
+            "xfer_inflight": self._kv_xfer_inflight,
+        }
+
+    async def export_kv_pages(self, prompt_ids) -> Optional[Dict]:
+        """Export the prompt's RESIDENT chain-prefix pages for a KV_PAGES
+        transfer.  Event loop: walk the contiguous resident prefix
+        (capped at MAX_KV_PAGES_PER_XFER; pages are a chain prefix, so a
+        truncated export just leaves the receiver more tail to prefill);
+        executor: gather bytes, pin self-check, checksum.  Returns
+        ``{"meta", "pages", "blobs"}`` or None when nothing is resident —
+        the orchestrator then ships nothing and the decode peer prefills
+        locally, exactly as if this engine did not exist."""
+        pi = self._prefix
+        if pi is None or self._page_out_op is None:
+            return None
+        from p2p_llm_tunnel_tpu.protocol.frames import MAX_KV_PAGES_PER_XFER
+
+        keys = pi.chain_keys(prompt_ids)[:MAX_KV_PAGES_PER_XFER]
+        if not keys:
+            # Prompt shorter than one full block — nothing poolable, so
+            # nothing will EVER be shippable; bail without waiting.
+            return None
+        # The pool insert runs off the TTFT-critical path: the engine loop
+        # emits the first token (ending a max_new_tokens=1 probe stream)
+        # and only THEN dispatches _prefix_insert on the executor.  An
+        # export fired the moment the probe stream ends therefore races
+        # the insert by one loop tick — poll briefly for the chain head
+        # to land before declaring the pool empty.
+        deadline = time.monotonic() + 2.0
+        while True:
+            pairs: List[Tuple[bytes, int]] = []
+            for key in keys:
+                idx = pi.id_of(key)
+                if idx is None:
+                    # The receiver's match() walks from the root, so only
+                    # the contiguous resident prefix is worth shipping.
+                    break
+                pairs.append((key, idx))
+            if pairs or time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.02)
+        if not pairs:
+            return None
+        # MRU-touch what we are about to gather so a concurrent insert
+        # wave prefers genuinely cold victims (the page-in wave idiom).
+        pi.touch_resident([k for k, _ in pairs])
+        loop = asyncio.get_running_loop()
+        self._kv_xfer_inflight += 1
+        global_metrics.set_gauge(
+            "engine_kv_xfer_inflight", self._kv_xfer_inflight
+        )
+        try:
+            result = await loop.run_in_executor(
+                self._executor, self._export_copy_out, pairs
+            )
+        finally:
+            self._kv_xfer_inflight -= 1
+            global_metrics.set_gauge(
+                "engine_kv_xfer_inflight", self._kv_xfer_inflight
+            )
+        n = len(result["pages"])
+        total = sum(len(b) for b in result["blobs"])
+        self._pages_shipped_total += n
+        self._pages_shipped_pending += n
+        global_metrics.inc("engine_pages_shipped_total", n)
+        global_metrics.inc("engine_page_xfer_bytes_total", total)
+        return result
+
+    def _export_copy_out(self, pairs) -> Dict:
+        """Executor thread: gather each resident page's leaves to host RAM
+        for the wire.  Every payload is re-pinned through
+        :func:`verify_page_pin` against this engine's OWN meta before its
+        bytes reach the frame codec — the registered tier-boundary idiom
+        (TC18/TC20), so an unpinned page can never reach the wire — then
+        checksummed so the receiver verifies integrity end to end.  Blob
+        layout: leaves in sorted-name order, contiguous C-order bytes
+        (the KvPagesManifest contract)."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            page_checksum,
+            verify_page_pin,
+        )
+
+        t0 = time.monotonic()
+        pages: List[Dict] = []
+        blobs: List[bytes] = []
+        for key, idx in pairs:
+            page = self._page_out_op(self._pool, jnp.int32(idx))
+            payload = {k: np.asarray(v) for k, v in page.items()}
+            payload = verify_page_pin(
+                payload, self._spill_meta, self._spill_meta
+            )
+            checksum = page_checksum(payload)
+            blob = b"".join(
+                np.ascontiguousarray(payload[name]).tobytes()
+                for name in sorted(payload)
+            )
+            pages.append({
+                "key": key.hex(),
+                "checksum": checksum.hex(),
+                "nbytes": len(blob),
+                "leaves": {
+                    name: {
+                        "shape": list(arr.shape),
+                        "dtype": str(arr.dtype),
+                    }
+                    for name, arr in payload.items()
+                },
+            })
+            blobs.append(blob)
+        global_metrics.observe(
+            "engine_page_export_ms", (time.monotonic() - t0) * 1000.0
+        )
+        return {"meta": dict(self._spill_meta), "pages": pages,
+                "blobs": blobs}
+
+    @staticmethod
+    def _wire_dtype(name: str):
+        """np.dtype for a wire leaf spec, including the ml_dtypes names
+        (bfloat16) numpy cannot resolve from a plain string."""
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+
+    @classmethod
+    def _blob_to_payload(cls, spec: Dict, blob: bytes) -> Dict:
+        """Reslice one page's wire bytes into per-leaf arrays: sorted leaf
+        names, contiguous C-order — the export layout.  Length-checked so
+        a short or padded blob fails loudly here, not as a silent
+        misaligned splice."""
+        payload: Dict[str, np.ndarray] = {}
+        off = 0
+        leaves = dict(spec["leaves"])
+        for name in sorted(leaves):
+            shape = [int(d) for d in leaves[name]["shape"]]
+            dtype = cls._wire_dtype(str(leaves[name]["dtype"]))
+            count = int(np.prod(shape)) if shape else 1
+            payload[name] = np.frombuffer(
+                blob, dtype=dtype, count=count, offset=off
+            ).reshape(shape)
+            off += count * dtype.itemsize
+        if off != len(blob):
+            raise ValueError(
+                f"page blob carries {len(blob)} bytes, leaves need {off}"
+            )
+        return payload
+
+    async def import_kv_pages(self, meta: Dict, pages: List[Dict],
+                              blobs: List[bytes]) -> int:
+        """Splice a KV_PAGES transfer into the pool: the manifest's pin
+        meta is checked against this pool FIRST (typed refusal before any
+        allocation), then each page rides the spill tier's two-phase
+        path — claim on the loop (``page_in_alloc`` with the wire pages
+        offered), ``verify_page_pin`` + checksum on the executor
+        (``_spill_copy_in``, unchanged), commit/abort back on the loop.
+        Returns pages spliced.  Raises PagePinError on a pin mismatch —
+        the serve layer answers the typed ``page_pin`` refusal; anything
+        milder (allocation pressure, a failed checksum) degrades to fewer
+        splices and the request simply re-prefills the difference."""
+        from p2p_llm_tunnel_tpu.engine.prefix_cache import (
+            PagePinError,
+            _SpillPage,
+        )
+
+        pi = self._prefix
+        if pi is None or self._page_in_op is None:
+            raise PagePinError(
+                "this engine has no prefix pool to splice into "
+                "(prefix_cache off or role fenced)"
+            )
+        try:
+            # One manifest-level check covers every page (shared meta);
+            # per-page verify_page_pin still runs in _spill_copy_in.
+            for key, val in self._spill_meta.items():
+                if meta.get(key) != val:
+                    raise PagePinError(
+                        f"KV page pin mismatch on {key!r}: transfer "
+                        f"carries {meta.get(key)!r}, engine wants {val!r}"
+                    )
+        except PagePinError:
+            global_metrics.inc("engine_page_refusals_total")
+            raise
+        offered: Dict[bytes, "_SpillPage"] = {}
+        order: List[bytes] = []
+        for spec, blob in zip(pages, blobs):
+            try:
+                key = bytes.fromhex(str(spec["key"]))
+                checksum = bytes.fromhex(str(spec["checksum"]))
+                payload = self._blob_to_payload(spec, blob)
+            except (KeyError, TypeError, ValueError) as e:
+                global_metrics.inc("engine_page_refusals_total")
+                raise PagePinError(f"malformed KV page: {e}") from e
+            # Recompute-cost accounting mirrors a local insert: chain
+            # depth x the live per-token prefill estimate, so imported
+            # conversation pages compete fairly under cost eviction.
+            cost = (len(order) + 1) * pi.block * (
+                self._prefill_ms_per_token or 1.0
+            )
+            offered[key] = _SpillPage(
+                payload, checksum, dict(meta), cost=cost
+            )
+            order.append(key)
+        if not offered:
+            return 0
+        items = pi.page_in_alloc(
+            order, protect=frozenset(order), offered=offered
+        )
+        if not items:
+            return 0
+        loop = asyncio.get_running_loop()
+        self._kv_xfer_inflight += 1
+        global_metrics.set_gauge(
+            "engine_kv_xfer_inflight", self._kv_xfer_inflight
+        )
+        try:
+            results = await loop.run_in_executor(
+                self._executor, self._spill_copy_in, items
+            )
+        finally:
+            self._kv_xfer_inflight -= 1
+            global_metrics.set_gauge(
+                "engine_kv_xfer_inflight", self._kv_xfer_inflight
+            )
+        ok_n = 0
+        for key, idx, ok in results:
+            if ok:
+                pi.commit_page_in(key, idx, page=offered[key])
+                ok_n += 1
+            else:
+                pi.abort_page_in(key, idx)
+        refused = len(items) - ok_n
+        if refused:
+            global_metrics.inc("engine_page_refusals_total", refused)
+        if ok_n:
+            self._pages_spliced_pending += ok_n
+            global_metrics.inc("engine_pages_spliced_total", ok_n)
+        self._publish_prefix_gauges()
+        return ok_n
+
     def _publish_prefix_gauges(self) -> None:
         """Prefix-pool memory accounting (ISSUE 6/14): pages used/free/
         reserved, resident KV bytes, and the eviction + conversation-cache
@@ -4188,6 +4485,11 @@ class InferenceEngine:
         now = time.monotonic()
         slots = self.scheduler.slots
         mux = self._last_mux
+        # Disagg transfers run off the iteration rhythm (API/serve-driven
+        # on the loop thread): drain their accumulators into THIS row so
+        # every shipped/spliced page lands in exactly one iteration.
+        shipped, self._pages_shipped_pending = self._pages_shipped_pending, 0
+        spliced, self._pages_spliced_pending = self._pages_spliced_pending, 0
         backlog = mux.get("backlog_rows")
         if backlog is None:
             # Non-mux iterations: the row-count proxy (no controller ran).
@@ -4223,6 +4525,8 @@ class InferenceEngine:
             ),
             spill_pageouts=self._flight_pageouts,
             spill_pageins=self._flight_pageins,
+            pages_shipped=shipped,
+            pages_spliced=spliced,
             cold_compiles=global_compile_watch.cold_total - cold0,
             # Speculation attribution (ISSUE 17): proposed/accepted verify
             # tokens and the burst width this iteration dispatched, so a

@@ -79,7 +79,14 @@ class PagePinError(ValueError):
     """A KV page's compatibility pins don't match the engine's (quant mode,
     group size, kv_quant, dtype, block geometry): splicing its bytes would
     silently serve KV computed under different numerics.  Callers treat
-    the page as lost and fall back to tail re-prefill."""
+    the page as lost and fall back to tail re-prefill.
+
+    ``tunnel_code`` lets the serve layer mint the TYPED refusal when the
+    mismatch crosses the tunnel (a disaggregated KV transfer, ISSUE 20) —
+    carried only on the dedicated transfer stream, never a request stream.
+    """
+
+    tunnel_code = "page_pin"
 
 
 def verify_page_pin(page, meta: Dict, want: Dict):
@@ -193,6 +200,10 @@ class PrefixIndex:
         self.spill_pageouts = 0
         self.spill_pageins = 0
         self.spill_drops = 0
+        # Disaggregation (ISSUE 20): pages spliced from the WIRE (a prefill
+        # peer's KV_PAGES transfer) rather than the host tier — same
+        # two-phase path, separate tally so spill metrics stay honest.
+        self.wire_spliced = 0
         # Thrash substrate: keys evicted recently enough that re-allocating
         # them signals reuse-distance > capacity (the detector's input).
         self._recent_evicted: "OrderedDict[bytes, float]" = OrderedDict()
@@ -567,6 +578,7 @@ class PrefixIndex:
 
     def page_in_alloc(self, keys: List[bytes],
                       protect: frozenset = frozenset(),
+                      offered: "Optional[Dict[bytes, _SpillPage]]" = None,
                       ) -> List[Tuple[bytes, int, "_SpillPage"]]:
         """Two-phase page-in, phase 1 (event loop): claim one free pool
         slot per host-tier key — evicting under the policy, never a
@@ -574,11 +586,18 @@ class PrefixIndex:
         bytes on the executor, then finishes every claim with
         :meth:`commit_page_in` or :meth:`abort_page_in`; until then the
         claimed slot is invisible to match/allocate (it is simply not in
-        ``_free``), so a racing insert can never alias it."""
+        ``_free``), so a racing insert can never alias it.
+
+        ``offered`` (ISSUE 20) sources pages from a caller-supplied map
+        instead of the host tier — a prefill peer's KV_PAGES transfer
+        rides the SAME claim/verify/commit discipline as a spill
+        page-in, it just arrives over the tunnel instead of process RAM.
+        """
         out: List[Tuple[bytes, int, _SpillPage]] = []
         prot = set(protect)
         for key in keys:
-            page = self._spill.get(key)
+            page = (self._spill.get(key) if offered is None
+                    else offered.get(key))
             if page is None or key in self._lru:
                 continue
             if self._free:
@@ -590,16 +609,26 @@ class PrefixIndex:
             out.append((key, idx, page))
         return out
 
-    def commit_page_in(self, key: bytes, idx: int) -> None:
+    def commit_page_in(self, key: bytes, idx: int,
+                       page: "Optional[_SpillPage]" = None) -> None:
         """Phase 2 success: the verified bytes are in pool slot ``idx`` —
         insert the entry (fresh GreedyDual touch) and count the splice.
         The shadow stays: its bytes still match the pool copy, so a later
-        eviction migrates back to the tier without another copy."""
-        page = self._spill.get(key)
+        eviction migrates back to the tier without another copy.
+
+        ``page`` (ISSUE 20) carries the accounting for a wire-offered
+        page that has no host-tier shadow; wire splices tally separately
+        so the spill counters stay honest."""
+        from_tier = page is None
+        if from_tier:
+            page = self._spill.get(key)
         cost = page.cost if page is not None else 0.0
         conv = page.conv if page is not None else False
         self._lru[key] = _Entry(idx, cost, conv, prio=self._clock + cost)
-        self.spill_pageins += 1
+        if from_tier:
+            self.spill_pageins += 1
+        else:
+            self.wire_spliced += 1
 
     def abort_page_in(self, key: bytes, idx: int) -> None:
         """Phase 2 failure (chaos fail/stall, checksum or pin mismatch):

@@ -6,9 +6,10 @@ Frame layout (wire-compatible with reference tunnel/src/protocol.rs:148-172):
 
 Control payloads (Hello/Agree/Req-/ResHeaders/Error) are UTF-8 JSON; body
 payloads are raw bytes. Eleven message types match the reference
-(protocol.rs:88-100); FLOW (per-stream credit) and RES_RESUME/RES_RESUMED
-(mid-stream continuity, ISSUE 13) are protocol-v2 extensions the HELLO/
-AGREE negotiation was designed to allow.
+(protocol.rs:88-100); FLOW (per-stream credit), RES_RESUME/RES_RESUMED
+(mid-stream continuity, ISSUE 13) and the KV_PAGES_* family (disaggregated
+prefill/decode page transfer, ISSUE 20) are protocol-v2 extensions the
+HELLO/AGREE negotiation was designed to allow.
 
 The handshake (reference protocol.rs:17-81): the proxy peer sends HELLO
 advertising a protocol name, a [min_version, max_version] range, and a feature
@@ -46,8 +47,12 @@ MAX_BODY_CHUNK = MAX_FRAME_SIZE - 128
 #: the protocol-v2 extension the reference's HELLO/AGREE negotiation was
 #: designed to allow (SURVEY.md §7 hard-part #3: the reference has no
 #: backpressure).  Reference peers never offer "flow", so the intersection
-#: disables it and the wire stays reference-compatible.
-SUPPORTED_FEATURES = ["sse", "flow"]
+#: disables it and the wire stays reference-compatible.  "kvpages" gates
+#: the KV_PAGES_* transfer family (ISSUE 20): ``decode()`` rejects unknown
+#: type bytes, so a peer may only ever be SENT KV frames after it
+#: advertised the feature in its own HELLO/AGREE — legacy peers never see
+#: them and the request wire stays byte-identical.
+SUPPORTED_FEATURES = ["sse", "flow", "kvpages"]
 
 #: Initial per-stream credit a serve peer assumes when "flow" is agreed;
 #: the proxy replenishes with FLOW frames as its client consumes.
@@ -83,9 +88,16 @@ CREDIT_BATCH = 64 * 1024
 #:     peer — fabric health carries engine_degraded_reason="memory")
 #:     helps; retrying instantly just thrashes the pool the code exists to
 #:     protect
+#:   page_pin — a KV_PAGES transfer was refused: the offered pages' pin
+#:     metadata (model/dtype/quant/group-size/kv-quant/seed/ckpt/block)
+#:     does not match the receiving pool, or a payload failed its
+#:     checksum.  Only ever carried on a dedicated transfer stream, never
+#:     a request stream — the handoff orchestrator treats it as "ship
+#:     nothing" and the decode peer re-prefills locally, so the client
+#:     request proceeds unperturbed
 ERROR_CODES = frozenset(
     {"timeout", "busy", "draining", "upstream", "tenant_overlimit",
-     "peer_lost", "tunnel_reset", "memory"}
+     "peer_lost", "tunnel_reset", "memory", "page_pin"}
 )
 
 _HEADER = struct.Struct(">BI")  # type:u8, stream_id:u32 BE
@@ -123,6 +135,21 @@ class MessageType(enum.IntEnum):
     #: frame instead — never silence.
     RES_RESUMED = 24
     FLOW = 30  # per-stream credit grant: payload = u32 BE byte count
+    #: Disaggregated prefill/decode page transfer (ISSUE 20).  The family
+    #: rides DEDICATED streams — never a request stream — so a refused or
+    #: half-delivered transfer cannot perturb any in-flight HTTP request,
+    #: and it is only ever sent to a peer that negotiated the "kvpages"
+    #: feature (decode() rejects unknown type bytes on legacy peers).
+    #: HDR: JSON KvPagesManifest — chain-ordered page specs + pin meta.
+    KV_PAGES_HDR = 40
+    #: Raw page bytes, chunked like RES_BODY and subject to the same FLOW
+    #: credit when negotiated: pages in manifest order, each page's leaves
+    #: concatenated in sorted-name order, contiguous C-order bytes.
+    KV_PAGES_CHUNK = 41
+    KV_PAGES_END = 42  # transfer complete; receiver verifies + splices
+    #: Receiver's verdict: JSON {"spliced": n}.  A pin/checksum refusal is
+    #: a typed ``page_pin`` ERROR on the transfer stream instead.
+    KV_PAGES_ACK = 43
     ERROR = 99
 
     @classmethod
@@ -178,19 +205,37 @@ class Hello:
 @dataclass
 class Agree:
     """Handshake reply carrying the negotiated version + feature intersection
-    (reference protocol.rs:25-81)."""
+    (reference protocol.rs:25-81).
+
+    ``role`` is the OPTIONAL disaggregation extension key (ISSUE 20): a
+    serve peer running a role-split engine advertises ``prefill`` or
+    ``decode`` so the proxy's PeerSet can route admission accordingly.
+    Omitted from the wire for the default ``both`` — classic handshakes
+    stay byte-identical to the reference — and ignored by legacy peers
+    (unknown-key-tolerant JSON), following the Hello.peer pattern.
+    """
 
     version: int = PROTOCOL_VERSION
     features: List[str] = field(default_factory=lambda: list(SUPPORTED_FEATURES))
+    role: str = "both"
 
     def to_json(self) -> bytes:
-        return json.dumps({"version": self.version, "features": self.features}).encode()
+        obj: Dict[str, object] = {
+            "version": self.version, "features": self.features,
+        }
+        if self.role and self.role != "both":
+            obj["role"] = self.role
+        return json.dumps(obj).encode()
 
     @classmethod
     def from_json(cls, data: bytes) -> "Agree":
         try:
             obj = json.loads(data)
-            return cls(version=int(obj["version"]), features=list(obj["features"]))
+            return cls(
+                version=int(obj["version"]),
+                features=list(obj["features"]),
+                role=str(obj.get("role", "both") or "both"),
+            )
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             raise ProtocolError(f"bad AGREE payload: {e}") from e
 
@@ -348,6 +393,75 @@ class ResumeFrame:
             raise ProtocolError(f"bad RES_RESUME payload: {e}") from e
 
 
+#: Most pages one KV_PAGES transfer may carry: the manifest must fit a
+#: single frame (``encode()`` raises past MAX_FRAME_SIZE), and pages are a
+#: CHAIN PREFIX — the prefix index matches from the root — so a longer
+#: prompt ships its first 64 pages and the decode peer prefills the tail
+#: it would have prefilled anyway.  Also the off-the-wire bound: a hostile
+#: manifest cannot make the receiver pre-allocate unbounded splice state.
+MAX_KV_PAGES_PER_XFER = 64
+
+
+@dataclass
+class KvPagesManifest:
+    """KV_PAGES_HDR JSON payload (ISSUE 20): what the chunk bytes mean.
+
+    ``meta`` is the sender's pool pin metadata — the same dict
+    ``verify_page_pin`` checks on every spill page-in — so the receiver
+    can refuse (typed ``page_pin``) BEFORE any bytes land.  ``pages`` is
+    chain-ordered (root first, matching the prefix index's walk): each
+    entry names the page's content-addressed chain key, its blake2b-16
+    payload checksum, its leaf specs ``{name: {"shape": [...], "dtype":
+    str}}`` and total byte count, so the receiver can slice the
+    concatenated KV_PAGES_CHUNK stream back into per-leaf arrays without
+    trusting byte counts it cannot verify.
+    """
+
+    stream_id: int
+    meta: Dict[str, object] = field(default_factory=dict)
+    pages: List[Dict[str, object]] = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        """Chunk-stream length the receiver should expect."""
+        return sum(int(p["nbytes"]) for p in self.pages)
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "stream_id": self.stream_id,
+                "meta": self.meta,
+                "pages": self.pages,
+            }
+        ).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "KvPagesManifest":
+        try:
+            obj = json.loads(data)
+            pages = list(obj["pages"])
+            if len(pages) > MAX_KV_PAGES_PER_XFER:
+                raise ValueError(
+                    f"manifest carries {len(pages)} pages "
+                    f"(max {MAX_KV_PAGES_PER_XFER})"
+                )
+            for p in pages:
+                # Every field the splice path dereferences, checked here
+                # so a malformed manifest fails as a ProtocolError at the
+                # frame boundary, not a KeyError deep in the engine.
+                str(p["key"]), str(p["checksum"])
+                if int(p["nbytes"]) < 0:
+                    raise ValueError("negative page nbytes")
+                for spec in dict(p["leaves"]).values():
+                    list(spec["shape"]), str(spec["dtype"])
+            return cls(
+                stream_id=int(obj["stream_id"]),
+                meta=dict(obj["meta"]),
+                pages=pages,
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad KV_PAGES_HDR payload: {e}") from e
+
+
 @dataclass
 class TunnelMessage:
     """One framed tunnel message (reference protocol.rs:140-262)."""
@@ -463,6 +577,31 @@ class TunnelMessage:
             raise ProtocolError("FLOW payload must be a u32 credit")
         return struct.unpack_from(">I", self.payload)[0]
 
+    @classmethod
+    def kv_pages_hdr(cls, manifest: KvPagesManifest) -> "TunnelMessage":
+        return cls(MessageType.KV_PAGES_HDR, manifest.stream_id,
+                   manifest.to_json())
+
+    @classmethod
+    def kv_pages_chunk(cls, stream_id: int, data: bytes) -> "TunnelMessage":
+        return cls(MessageType.KV_PAGES_CHUNK, stream_id, data)
+
+    @classmethod
+    def kv_pages_end(cls, stream_id: int) -> "TunnelMessage":
+        return cls(MessageType.KV_PAGES_END, stream_id)
+
+    @classmethod
+    def kv_pages_ack(cls, stream_id: int, spliced: int) -> "TunnelMessage":
+        return cls(MessageType.KV_PAGES_ACK, stream_id,
+                   json.dumps({"spliced": int(spliced)}).encode())
+
+    def kv_ack_spliced(self) -> int:
+        """Pages the receiver spliced, from a KV_PAGES_ACK payload."""
+        try:
+            return int(json.loads(self.payload)["spliced"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"bad KV_PAGES_ACK payload: {e}") from e
+
 
 #: Optional per-request time budget, in milliseconds, set by the client.
 #: Enforced by the serve endpoint (frame relay) and the engine scheduler
@@ -511,6 +650,14 @@ MAX_TENANT_LEN = 64
 #: so protocol-aware peers get the same dispatchable code whether the shed
 #: happened at the tunnel layer or inside the backend.
 ERROR_CODE_HEADER = "x-tunnel-error-code"
+
+#: Request header marking a disaggregated KV-export probe (ISSUE 20): the
+#: proxy tags an otherwise-normal generation request with it and sends it
+#: to a prefill-role peer, which answers in the KV_PAGES vocabulary (HDR +
+#: CHUNK* + END on the same stream) instead of RES_* — or a plain ERROR
+#: frame when it has nothing useful to ship.  Never forwarded to HTTP
+#: upstreams (it rides the tunnel only between proxy and serve).
+KV_EXPORT_HEADER = "x-tunnel-kv-export"
 
 
 def tenant_fingerprint(api_key: str) -> str:

@@ -93,10 +93,121 @@ def build_parser() -> argparse.ArgumentParser:
                         "TUNNEL_PREFIX_POOL_BLOCKS", "128")),
                     help="prefix pool capacity in KV blocks (shrink it to "
                          "force spill under a herd)")
+    ap.add_argument("--disagg", action="store_true",
+                    default=os.environ.get("TUNNEL_DISAGG") == "1",
+                    help="disaggregated topology (ISSUE 20): TWO engines — "
+                         "a prefill-role peer and a decode-role peer — "
+                         "behind one fabric proxy with prefix-affinity "
+                         "routing and KV-page handoff over the tunnel; "
+                         "implies --prefix-cache on both engines")
     return ap
 
 
+def _disagg_engine(args, role: str) -> InferenceEngine:
+    """One engine of the disaggregated pair (ISSUE 20).
+
+    Both roles share EVERY numerics-relevant knob — model, seed (the
+    EngineConfig default), quant/kv-quant defaults, block geometry — so
+    pages shipped from the prefill peer pass the decode peer's pin check
+    and byte-identity holds.  prefix_cache is forced on: the role fence
+    would otherwise bounce the role back to "both"."""
+    from p2p_llm_tunnel_tpu.engine.tokenizer import Latin1Tokenizer
+
+    return InferenceEngine(engine_cfg=EngineConfig(
+        model=args.model,
+        num_slots=args.slots,
+        max_seq=args.max_seq,
+        decode_steps=args.decode_steps,
+        max_waiting=args.max_waiting,
+        fair_admission=not args.no_fair_admission,
+        tenant_weights=args.tenant_weights,
+        mux=True,
+        prefix_cache=True,
+        conv_cache=True,
+        prefix_pool_blocks=args.prefix_pool_blocks,
+        spill_pages=args.spill_pages,
+        watchdog_budget_s=120.0,
+        role=role,
+    ), tokenizer=Latin1Tokenizer())
+
+
+def _peer_chaos(channel, peer_id: str):
+    """Chaos wrap scoped to one peer: with TUNNEL_CHAOS_PEER set, only that
+    peer's channels get the TUNNEL_CHAOS schedule — how the chaos matrix
+    murders exactly the prefill peer mid-transfer while the decode peer
+    (whose fallback is the behavior under test) stays healthy."""
+    target = os.environ.get("TUNNEL_CHAOS_PEER", "")
+    if target and peer_id != target:
+        return channel
+    return maybe_chaos(channel)
+
+
+async def _amain_disagg(args) -> None:
+    """Two-engine disaggregated stack: prefill-0 + decode-0 behind one
+    fabric proxy (ISSUE 20).  Same readiness contract as the single-engine
+    stack; peer ids are stable so affinity hashes and chaos targeting are
+    reproducible across runs."""
+    from p2p_llm_tunnel_tpu.endpoints.proxy import (
+        ProxyState,
+        run_proxy_fabric,
+    )
+
+    engines = {
+        "prefill-0": _disagg_engine(args, "prefill"),
+        "decode-0": _disagg_engine(args, "decode"),
+    }
+    for eng in engines.values():
+        await eng.start()
+        await eng.warmup()
+
+    state = ProxyState(tenant_fallback="local", trust_tenant_header=True,
+                       fabric=True)
+    serve_tasks = []
+    proxy_task = None
+    try:
+        for pid, eng in engines.items():
+            serve_ch, proxy_ch = loopback_pair()
+            serve_ch = _peer_chaos(serve_ch, pid)
+            proxy_ch = _peer_chaos(proxy_ch, pid)
+            task = asyncio.create_task(run_serve(
+                serve_ch, backend=engine_backend(eng, args.model),
+                max_inflight=args.max_inflight,
+            ))
+            # A peer death (chaos kill) must NOT end the stack — the
+            # fabric routes around it; that failover IS what chaos runs
+            # assert.  Log and carry on; run_proxy_fabric owns liveness.
+            task.add_done_callback(lambda t, p=pid: log.warning(
+                "serve peer %s exited: %s", p,
+                t.exception() if not t.cancelled() else "cancelled",
+            ))
+            serve_tasks.append(task)
+            await state.admit(proxy_ch, pid)
+        ready: asyncio.Future = asyncio.get_running_loop().create_future()
+        proxy_task = asyncio.create_task(run_proxy_fabric(
+            state, "127.0.0.1", args.port, ready=ready,
+        ))
+        await asyncio.wait({ready, proxy_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if not ready.done():
+            proxy_task.result()
+            raise RuntimeError("proxy exited before reporting readiness")
+        print(f"{READY_PREFIX}{ready.result()}", flush=True)
+        await proxy_task
+    finally:
+        for task in serve_tasks:
+            task.cancel()
+        if proxy_task is not None:
+            proxy_task.cancel()
+            serve_tasks.append(proxy_task)
+        await asyncio.gather(*serve_tasks, return_exceptions=True)
+        for eng in engines.values():
+            await eng.stop()
+
+
 async def amain(args) -> None:
+    if args.disagg:
+        await _amain_disagg(args)
+        return
     tokenizer = None
     if args.prefix_cache:
         # Conversation-replay experiments need the byte<->text mapping to

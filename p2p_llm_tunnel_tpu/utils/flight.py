@@ -107,6 +107,15 @@ FLIGHT_SCHEMA: Dict[str, str] = {
         "this iteration (ISSUE 16; the thrash detector's context — "
         "page-ins racing pageouts over a small window is the signature)"
     ),
+    "pages_shipped": (
+        "prefix-pool pages exported over the tunnel for KV_PAGES "
+        "transfers since the last row (ISSUE 20; exports run off the "
+        "iteration rhythm, drained into the next row)"
+    ),
+    "pages_spliced": (
+        "wire-delivered KV pages spliced into the pool since the last "
+        "row (ISSUE 20; the decode role's disagg hit signal)"
+    ),
     "spec_proposed": (
         "draft tokens proposed to the fused verify burst this iteration "
         "(ISSUE 17; greedy rows only, 0 when speculation is off/idle)"

@@ -290,6 +290,52 @@ METRICS_CATALOG: Dict[str, str] = {
         "reserved AND spill tier at capacity (counter; the 429 + "
         "Retry-After degradation contract — never thrash)"
     ),
+    # -- disaggregated prefill/decode (ISSUE 20) ---------------------------
+    "engine_pages_shipped_total": (
+        "prefix-pool pages exported over the tunnel to a decode peer "
+        "(counter; incremented by the prefill role's KV_PAGES export "
+        "path after the pin self-check passes)"
+    ),
+    "engine_pages_spliced_total": (
+        "wire-delivered KV pages spliced into this pool through the "
+        "two-phase verify path (counter; the decode role's disagg hit "
+        "signal — rate against shipped for transfer efficiency)"
+    ),
+    "engine_page_xfer_bytes_total": (
+        "page payload bytes exported for KV_PAGES transfers (counter; "
+        "kv_quant-scaled — int4 pools ship a quarter of the none-mode "
+        "bytes for the same tokens)"
+    ),
+    "engine_page_refusals_total": (
+        "wire pages refused by the pin check or integrity checksum "
+        "(counter; each refusal fell back to local re-prefill — "
+        "disaggregation is an optimization, never a failure mode)"
+    ),
+    "engine_page_export_ms": (
+        "per-transfer export latency, device gather + pin self-check + "
+        "checksum + serialization (histogram, ms)"
+    ),
+    "engine_kv_xfer_inflight": (
+        "KV page transfers (exports + imports) currently on the "
+        "executor (gauge; nonzero after drain is a transfer leak — the "
+        "loadgen leak-gate invariant, like engine_spill_inflight)"
+    ),
+    "proxy_affinity_hits_total": (
+        "dispatches where prefix-affinity routing (rendezvous hash on "
+        "the request's prefix chain key) landed the request on its "
+        "affine peer (counter; health/breaker state overrides affinity, "
+        "so misses under churn are expected, not bugs)"
+    ),
+    "proxy_disagg_handoffs_total": (
+        "requests whose KV pages were prefetched from a prefill peer "
+        "and shipped to the decode peer before dispatch (counter)"
+    ),
+    "proxy_disagg_fallbacks_total": (
+        "disagg handoffs abandoned mid-flight — prefill peer died, "
+        "refused, or timed out — where the request was dispatched "
+        "anyway for local re-prefill (counter; the chaos row's "
+        "fallback-not-failure signal)"
+    ),
     # -- fleet observability plane (ISSUE 9) ------------------------------
     # The fleet_* names live in the PROXY process: aggregates over its
     # PeerSet, refreshed by /metrics?fleet=1 scrapes and the PeerSet's

@@ -339,6 +339,27 @@ POLL_KEYS = (
     "engine_spill_inflight",
     "engine_spill_pageouts_total",
     "engine_spill_pageins_total",
+    # Disaggregated prefill/decode (ISSUE 20): handoff volume and the
+    # transfer in-flight gauge over the run — a timeline shows whether
+    # page shipping kept pace with admission or the proxy fell back.
+    "engine_pages_shipped_total",
+    "engine_pages_spliced_total",
+    "engine_page_xfer_bytes_total",
+    "engine_kv_xfer_inflight",
+    "proxy_disagg_handoffs_total",
+    "proxy_disagg_fallbacks_total",
+    "proxy_affinity_hits_total",
+)
+
+#: Disagg counters reported as RUN DELTAS in the summary row (ISSUE 20):
+#: the A/B evidence that the handoff path ran (or fell back) this run.
+DISAGG_DELTA_KEYS = (
+    "engine_pages_shipped_total",
+    "engine_pages_spliced_total",
+    "engine_page_xfer_bytes_total",
+    "proxy_disagg_handoffs_total",
+    "proxy_disagg_fallbacks_total",
+    "proxy_affinity_hits_total",
 )
 POLL_QUANTILES = {
     "engine_ttft_ms": ("0.5", "0.99"),
@@ -476,10 +497,16 @@ async def run_load(args) -> dict:
     # client-visible marker — the serve-side counter is the only honest
     # source for the `resumed` summary column (ISSUE 13).
     resumes0 = None
+    # Disagg transfer counters (ISSUE 20): deltas over the run, same
+    # server-side-only honesty argument — a spliced page is invisible in
+    # the client stream BY CONTRACT (byte identity), so only the
+    # counters can say the handoff path actually ran.
+    disagg0: Dict[str, float] = {}
     pre_text = await fetch_metrics(args.host, args.port, "/metrics", 5.0)
     if pre_text is not None:
-        resumes0 = parse_metrics_sample(pre_text).get(
-            "serve_stream_resumes_total")
+        sample = parse_metrics_sample(pre_text)
+        resumes0 = sample.get("serve_stream_resumes_total")
+        disagg0 = {k: sample.get(k) or 0.0 for k in DISAGG_DELTA_KEYS}
     if args.metrics_poll > 0:
         poller = asyncio.create_task(metrics_poller(
             args.host, args.port, args.metrics_poll, t0, timeline,
@@ -630,6 +657,21 @@ async def run_load(args) -> dict:
     pool_hz = (healthz or {}).get("prefix_pool") or {}
     spill_hz = pool_hz.get("spill") or {}
     spec_hz = (healthz or {}).get("spec") or {}
+    disagg_hz = (healthz or {}).get("disagg") or {}
+    disagg_row = None
+    if post_text is not None and disagg0:
+        post_sample = parse_metrics_sample(post_text)
+        deltas = {
+            k.replace("_total", ""): int(
+                (post_sample.get(k) or 0.0) - disagg0[k]
+            )
+            for k in DISAGG_DELTA_KEYS
+        }
+        # Only report the row when the stack is actually disaggregated
+        # (healthz advertises a role) or the counters moved — a plain
+        # single-engine run keeps its summary schema unchanged.
+        if disagg_hz or any(deltas.values()):
+            disagg_row = dict(deltas, role=disagg_hz.get("role"))
     out = {
         "clients": sum(c for _n, c, _r in args.tenants),
         "wall_s": round(wall, 2),
@@ -646,6 +688,10 @@ async def run_load(args) -> dict:
             else round(spec_hz["accepted_total"]
                        / spec_hz["proposed_total"], 3)
         ),
+        # Disaggregated prefill/decode (ISSUE 20): pages shipped/spliced
+        # and handoff/fallback/affinity deltas over the run; None when
+        # the stack is not disaggregated (schema stays stable).
+        "disagg": disagg_row,
         "tenants": tenant_rows(per_tenant),
         # Leak check: in-flight, occupancy, AND the detached-stream
         # registry must be back to zero once every client is done — a
@@ -675,6 +721,10 @@ async def run_load(args) -> dict:
             # behind by a cancel/eviction path pins stale proposals (and
             # their EMA) to whatever request lands in the slot next.
             "spec_hist_entries": spec_hz.get("hist_entries"),
+            # ISSUE 20 leak gate: the KV-transfer in-flight ledger must
+            # be zero at rest — a stuck value is an export/splice whose
+            # executor hop never finished (its finally never ran).
+            "kv_xfer_inflight": disagg_hz.get("xfer_inflight"),
             "tenants": healthz.get("tenants"),
             "retry_after_s": healthz.get("retry_after_s"),
         },
@@ -712,6 +762,10 @@ def spawn_stack(args) -> Tuple[subprocess.Popen, int]:
         # the server side, sized from the CLI so the capacity-cliff run
         # can shrink the pool and still keep returning turns warm.
         cmd += ["--spill-pages", str(args.stack_spill_pages)]
+    if args.stack_disagg:
+        # Disaggregated A/B (ISSUE 20): prefill-role + decode-role
+        # engines behind one fabric proxy with KV-page handoff.
+        cmd += ["--disagg"]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -796,6 +850,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stack-spill-pages", type=int, default=0,
                     help="host-RAM spill tier pages on the spawned stack "
                          "(0 = off)")
+    ap.add_argument("--stack-disagg", action="store_true",
+                    default=os.environ.get("TUNNEL_DISAGG") == "1",
+                    help="spawn the TWO-engine disaggregated stack "
+                         "(prefill-role + decode-role peers behind one "
+                         "fabric proxy, ISSUE 20) instead of the "
+                         "single-engine mux stack")
     args = ap.parse_args(argv)
     args.tenants = [parse_tenant_spec(s) for s in (args.tenant or
                                                    ["herd:500"])]
@@ -828,7 +888,7 @@ def main(argv=None) -> int:
             for k in ("inflight_requests", "queue_depth", "slot_occupancy",
                       "streams_detached", "replay_buffer_bytes",
                       "pool_pages_reserved", "pool_spill_inflight",
-                      "spec_hist_entries")
+                      "spec_hist_entries", "kv_xfer_inflight")
         )
         if leaked:
             detail = ("unreachable" if hz is None
@@ -847,6 +907,17 @@ def main(argv=None) -> int:
         if out.get("resumed") is not None:
             print(f"# resumed mid-run (tunnel resets survived): "
                   f"{out['resumed']}", file=sys.stderr)
+        if out.get("disagg"):
+            d = out["disagg"]
+            print(
+                f"# disagg: {d.get('engine_pages_shipped')} pages "
+                f"shipped / {d.get('engine_pages_spliced')} spliced "
+                f"({d.get('engine_page_xfer_bytes')} B); handoffs "
+                f"{d.get('proxy_disagg_handoffs')}, fallbacks "
+                f"{d.get('proxy_disagg_fallbacks')}, affinity hits "
+                f"{d.get('proxy_affinity_hits')}",
+                file=sys.stderr,
+            )
         for tr in out.get("turns", []):
             pf = tr.get("prefill_exec_p50_ms")
             print(

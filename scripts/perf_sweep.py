@@ -205,6 +205,32 @@ GRID = [
         "BENCH_SPEC_NGRAM": "0",
         "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
         "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    # ISSUE 20 disaggregation twins at the hero shape, in decision order
+    # right after the spec pair: identical weights/KV/kernels/herd, only
+    # the topology differs — the on row runs the two-engine
+    # prefill/decode fabric (KV pages over the tunnel, affinity-routed),
+    # the off twin the single-engine mux loopback.  The comparison axes
+    # are ttft_p50_ms plus its split: queue_wait/prefill_exec (the local
+    # legs) vs kv_export_p50_ms + pages_shipped/spliced (the wire leg).
+    # The ON row runs first: it banks the headline (decode streams
+    # untaxed by prefill bursts) and its program set is the same one the
+    # off twin needs, so a short chip window still pairs them.  NOTE two
+    # engines double weight HBM — the fabric pair fits v5e-1 only at
+    # int4; a shape that OOMs records config_crashed, not a wedge.
+    ("int4-kv4-fused-mux-disagg", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_DISAGG": "1",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
+    ("int4-kv4-fused-mux-disaggoff", {
+        "BENCH_QUANT": "int4", "BENCH_KV_QUANT": "int4",
+        "BENCH_FUSED_DECODE": "1", "BENCH_MUX": "1",
+        "BENCH_PREFIX_CACHE": "1", "BENCH_SHARED_PREFIX_TOKENS": "256",
+        "BENCH_DISAGG": "0",
+        "BENCH_SLOTS": "64", "BENCH_CLIENTS": "64",
+        "BENCH_DECODE_STEPS": "24", "SWEEP_DEADLINE_S": "900"}),
     # Cold shared-prefix herd at the base shape (the ISSUE 5 TTFT bar):
     # 32 clients whose prompts share a ~256-token templated prefix the
     # warm request never touched.  The off twin quantifies what the herd

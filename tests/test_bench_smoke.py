@@ -47,6 +47,12 @@ PINNED_ROW_KEYS = (
     # ISSUE 16 add-only extension: host-RAM spill-tier residency, page-in
     # success rate (rest fell back to tail re-prefill), splice latency.
     "spill_pages", "spill_tier_hit_rate", "spill_pagein_p50_ms",
+    # ISSUE 20 add-only extension: the disaggregated prefill/decode A/B
+    # — the topology knob, the KV-page wire-motion counters, and the
+    # transfer leg (kv_export_p50_ms) of the TTFT split.
+    "disagg", "pages_shipped", "pages_spliced", "page_xfer_bytes",
+    "disagg_handoffs", "disagg_fallbacks", "affinity_hits",
+    "kv_export_p50_ms",
     # ISSUE 12 add-only extension: the cold-start compile breakdown
     # (warmup total / program count / slowest single program).
     "warmup_compile_s", "warmup_programs", "warmup_compile_max_s",

@@ -1,0 +1,448 @@
+"""ISSUE 20: disaggregated prefill/decode — KV pages on the wire.
+
+Five contracts:
+
+1. **Disaggregation is a pure optimization**: a decode stream fed by
+   spliced wire pages is byte-identical to the same request prefilled
+   locally, at EVERY kv mode (none, int8, int4) — pinned in tier-1, the
+   acceptance criterion.
+2. **The wire format is bit-stable**: export → KvPagesManifest JSON →
+   KV_PAGES frame codec → chunk reassembly → splice reproduces the
+   sender's pool planes exactly (re-exporting from the receiver yields
+   identical checksums).
+3. **Refusals are typed**: a quant-pin or group-size mismatch raises
+   PagePinError carrying ``tunnel_code == "page_pin"`` (a registered
+   ERROR_CODES entry), no bytes splice, and the request re-prefills
+   locally with an unchanged stream.
+4. **Affinity hashing is stable under churn**: HRW (rendezvous) scoring
+   only remaps the keys whose winner actually joined/left — no global
+   reshuffle on peer churn.
+5. **Manifest framing round-trips**: HDR/CHUNK/END/ACK frames encode and
+   decode losslessly, and chunking under MAX_BODY_CHUNK reassembles to
+   the manifest's exact byte count.
+
+Host-pure tests (frames, HRW) run in tier-1 alongside the kv-mode
+identity matrix; the refusal matrix (extra engine boots) is slow-tier.
+"""
+
+import asyncio
+
+import pytest
+
+from p2p_llm_tunnel_tpu.endpoints.peerset import _hrw_score
+from p2p_llm_tunnel_tpu.engine.prefix_cache import PagePinError
+from p2p_llm_tunnel_tpu.protocol.frames import (
+    ERROR_CODES,
+    MAX_BODY_CHUNK,
+    KvPagesManifest,
+    MessageType,
+    TunnelMessage,
+)
+
+# ---------------------------------------------------------------------------
+# frames: manifest + HDR/CHUNK/END/ACK round-trip (host-pure, tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _manifest(sid: int = 7) -> KvPagesManifest:
+    return KvPagesManifest(
+        stream_id=sid,
+        meta={"kv_quant": "int4", "quant_group": 32},
+        pages=[
+            {
+                "key": "ab" * 16,
+                "checksum": "cd" * 16,
+                "nbytes": 64,
+                "leaves": {"k": {"shape": [4, 16], "dtype": "uint8"}},
+            },
+            {
+                "key": "ef" * 16,
+                "checksum": "01" * 16,
+                "nbytes": 32,
+                "leaves": {"k": {"shape": [2, 16], "dtype": "uint8"}},
+            },
+        ],
+    )
+
+
+def test_kv_pages_frames_roundtrip():
+    m = _manifest()
+    assert m.total_bytes() == 96
+    again = KvPagesManifest.from_json(m.to_json())
+    assert (again.stream_id, again.meta, again.pages) == (
+        m.stream_id, m.meta, m.pages
+    )
+    for msg in (
+        TunnelMessage.kv_pages_hdr(m),
+        TunnelMessage.kv_pages_chunk(7, b"\x00" * 96),
+        TunnelMessage.kv_pages_end(7),
+        TunnelMessage.kv_pages_ack(7, 2),
+    ):
+        back = TunnelMessage.decode(msg.encode())
+        assert (back.msg_type, back.stream_id, back.payload) == (
+            msg.msg_type, msg.stream_id, msg.payload
+        )
+    ack = TunnelMessage.decode(TunnelMessage.kv_pages_ack(9, 5).encode())
+    assert ack.msg_type is MessageType.KV_PAGES_ACK
+    assert ack.kv_ack_spliced() == 5
+
+
+def test_kv_chunking_reassembles_to_manifest_byte_count():
+    blob = bytes(range(256)) * 600  # > MAX_BODY_CHUNK, exercises the split
+    chunks = [
+        blob[lo : lo + MAX_BODY_CHUNK]
+        for lo in range(0, len(blob), MAX_BODY_CHUNK)
+    ]
+    assert len(chunks) > 1
+    buf = bytearray()
+    for c in chunks:
+        msg = TunnelMessage.decode(TunnelMessage.kv_pages_chunk(3, c).encode())
+        buf.extend(msg.payload)
+    assert bytes(buf) == blob
+
+
+def test_page_pin_refusal_is_a_registered_typed_error():
+    # The serve layer answers splice refusals with the typed code it reads
+    # off the exception — the code must exist in the shared registry or
+    # TC05 (and the proxy's 502 mapping) would disown it.
+    assert PagePinError.tunnel_code == "page_pin"
+    assert "page_pin" in ERROR_CODES
+
+
+# ---------------------------------------------------------------------------
+# HRW affinity: churn only remaps keys whose winner changed (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _assign(peers, keys):
+    return {
+        k: max(peers, key=lambda p: _hrw_score(p, k)) for k in keys
+    }
+
+
+def test_hrw_affinity_stable_under_join_and_leave():
+    keys = [b"prefix-%d" % n for n in range(200)]
+    three = _assign(["peer-a", "peer-b", "peer-c"], keys)
+    assert len(set(three.values())) == 3  # all peers drew some keys
+
+    # Leave: ONLY keys that belonged to the departed peer move.
+    two = _assign(["peer-a", "peer-b"], keys)
+    for k in keys:
+        if three[k] != "peer-c":
+            assert two[k] == three[k]
+
+    # Join: the only moves are keys the newcomer now wins.
+    four = _assign(["peer-a", "peer-b", "peer-c", "peer-d"], keys)
+    for k in keys:
+        if four[k] != "peer-d":
+            assert four[k] == three[k]
+    assert any(four[k] == "peer-d" for k in keys)
+
+
+def test_hrw_score_is_deterministic_and_peer_sensitive():
+    assert _hrw_score("p1", b"key") == _hrw_score("p1", b"key")
+    assert _hrw_score("p1", b"key") != _hrw_score("p2", b"key")
+    assert _hrw_score("p1", b"key") != _hrw_score("p1", b"other")
+
+
+# ---------------------------------------------------------------------------
+# cross-engine splice: wire-format bit-stability + stream identity
+# ---------------------------------------------------------------------------
+
+
+def _cfg(role="both", **kw):
+    from p2p_llm_tunnel_tpu.engine.engine import EngineConfig
+
+    base = dict(model="tiny", num_slots=4, max_seq=128, dtype="float32",
+                min_prefill_bucket=16, decode_steps=4, mux=True,
+                prefix_cache=True, prefill_chunk=16, role=role)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _wire_roundtrip(export):
+    """Push an engine export through the REAL frame codec — manifest to
+    JSON and back, blobs chunked under MAX_BODY_CHUNK and reassembled —
+    so the splice consumes exactly what a tunnel receiver would."""
+    manifest = KvPagesManifest(stream_id=5, meta=dict(export["meta"]),
+                               pages=list(export["pages"]))
+    hdr = TunnelMessage.decode(
+        TunnelMessage.kv_pages_hdr(manifest).encode()
+    )
+    again = KvPagesManifest.from_json(hdr.payload)
+    blob = b"".join(export["blobs"])
+    buf = bytearray()
+    for lo in range(0, len(blob), MAX_BODY_CHUNK):
+        frame = TunnelMessage.kv_pages_chunk(
+            5, blob[lo : lo + MAX_BODY_CHUNK]
+        ).encode()
+        buf.extend(TunnelMessage.decode(frame).payload)
+    assert again.total_bytes() == len(buf)
+    blobs, off = [], 0
+    for spec in again.pages:
+        n = int(spec["nbytes"])
+        blobs.append(bytes(buf[off : off + n]))
+        off += n
+    return again, blobs
+
+
+async def _drain(engine, prompt, max_new=6):
+    out = []
+    async for ev in engine.generate(prompt, max_new_tokens=max_new,
+                                    stop_ids=()):
+        out.append(ev.token_id)
+    return out
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8", "int4"])
+def test_disagg_on_off_byte_identity_every_kv_mode(kv_quant):
+    """ISSUE 20 acceptance: splice-then-decode produces the byte stream
+    local prefill would have, at every kv mode — and the pages really
+    crossed the wire format (wire_spliced > 0, re-export checksums match
+    the sender's bit for bit)."""
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    prompt = list(range(1, 57))  # 3 full 16-token blocks + tail
+
+    async def main():
+        off_eng = InferenceEngine(engine_cfg=_cfg("both", kv_quant=kv_quant))
+        await off_eng.start()
+        try:
+            off = await _drain(off_eng, prompt)
+        finally:
+            await off_eng.stop()
+
+        pre = InferenceEngine(engine_cfg=_cfg("prefill", kv_quant=kv_quant))
+        dec = InferenceEngine(engine_cfg=_cfg("decode", kv_quant=kv_quant))
+        await pre.start()
+        await dec.start()
+        try:
+            await _drain(pre, prompt, max_new=1)  # the export probe
+            export = await pre.export_kv_pages(prompt)
+            assert export is not None and len(export["pages"]) == 3
+            manifest, blobs = _wire_roundtrip(export)
+            spliced = await dec.import_kv_pages(
+                manifest.meta, manifest.pages, blobs
+            )
+            assert spliced == 3
+            assert dec._prefix.wire_spliced == 3
+            on = await _drain(dec, prompt)
+            # Bit-stability: the receiver's pool planes re-export with the
+            # sender's checksums — the splice wrote EXACTLY the wire bytes.
+            back = await dec.export_kv_pages(prompt)
+            assert back is not None
+            assert [p["checksum"] for p in back["pages"][:3]] == [
+                p["checksum"] for p in export["pages"]
+            ]
+            stats = dec.disagg_stats()
+            assert stats["pages_spliced"] == 3
+            assert stats["xfer_inflight"] == 0
+        finally:
+            await pre.stop()
+            await dec.stop()
+        return off, on
+
+    off, on = asyncio.run(main())
+    assert on == off, f"spliced decode diverged under kv_quant={kv_quant}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("decode_cfg", [
+    {"kv_quant": "int4"},                      # quant mode mismatch
+    {"kv_quant": "int8", "quant_group_size": 64},  # group-size mismatch
+])
+def test_pin_mismatch_typed_refusal_then_local_reprefill(decode_cfg):
+    """A transfer whose pin meta disagrees with the receiving pool is
+    refused BEFORE any bytes land — PagePinError with the registered
+    ``page_pin`` code, wire_spliced stays 0 — and the request then
+    re-prefills locally with a stream identical to a never-offered run."""
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    prompt = list(range(1, 57))
+
+    async def main():
+        pre = InferenceEngine(engine_cfg=_cfg("prefill", kv_quant="int8"))
+        await pre.start()
+        try:
+            await _drain(pre, prompt, max_new=1)
+            export = await pre.export_kv_pages(prompt)
+            assert export is not None
+        finally:
+            await pre.stop()
+
+        clean_eng = InferenceEngine(engine_cfg=_cfg("both", **decode_cfg))
+        await clean_eng.start()
+        try:
+            clean = await _drain(clean_eng, prompt)
+        finally:
+            await clean_eng.stop()
+
+        dec = InferenceEngine(engine_cfg=_cfg("decode", **decode_cfg))
+        await dec.start()
+        try:
+            manifest, blobs = _wire_roundtrip(export)
+            with pytest.raises(PagePinError) as e:
+                await dec.import_kv_pages(manifest.meta, manifest.pages,
+                                          blobs)
+            assert getattr(e.value, "tunnel_code", None) == "page_pin"
+            assert dec._prefix.wire_spliced == 0
+            fallback = await _drain(dec, prompt)
+        finally:
+            await dec.stop()
+        return clean, fallback
+
+    clean, fallback = asyncio.run(main())
+    assert fallback == clean, "refused splice contaminated the stream"
+
+
+# ---------------------------------------------------------------------------
+# chaos: prefill peer killed mid-page-transfer (the `make chaos` row)
+# ---------------------------------------------------------------------------
+
+
+async def _fabric_stack(stack_ctx):
+    """Two-engine disagg fabric (prefill-0 + decode-0) behind one proxy,
+    chaos-wrapped per peer exactly like testing/local_stack — returns the
+    HTTP port; caller POSTs and then cancels via the context dict."""
+    from p2p_llm_tunnel_tpu.endpoints.proxy import (
+        ProxyState,
+        run_proxy_fabric,
+    )
+    from p2p_llm_tunnel_tpu.endpoints.serve import run_serve
+    from p2p_llm_tunnel_tpu.engine.api import engine_backend
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+    from p2p_llm_tunnel_tpu.engine.tokenizer import Latin1Tokenizer
+    from p2p_llm_tunnel_tpu.testing.local_stack import _peer_chaos
+    from p2p_llm_tunnel_tpu.transport.loopback import loopback_pair
+
+    engines = {
+        "prefill-0": InferenceEngine(engine_cfg=_cfg("prefill"),
+                                     tokenizer=Latin1Tokenizer()),
+        "decode-0": InferenceEngine(engine_cfg=_cfg("decode"),
+                                    tokenizer=Latin1Tokenizer()),
+    }
+    for eng in engines.values():
+        await eng.start()
+    state = ProxyState(tenant_fallback="local", trust_tenant_header=True,
+                       fabric=True)
+    tasks = []
+    for pid, eng in engines.items():
+        serve_ch, proxy_ch = loopback_pair()
+        serve_ch = _peer_chaos(serve_ch, pid)
+        proxy_ch = _peer_chaos(proxy_ch, pid)
+        tasks.append(asyncio.create_task(run_serve(
+            serve_ch, backend=engine_backend(eng, "tiny"), max_inflight=64,
+        )))
+        await state.admit(proxy_ch, pid)
+    ready: asyncio.Future = asyncio.get_running_loop().create_future()
+    tasks.append(asyncio.create_task(run_proxy_fabric(
+        state, "127.0.0.1", 0, ready=ready,
+    )))
+    stack_ctx["engines"] = engines
+    stack_ctx["tasks"] = tasks
+    return await ready
+
+
+def _chaos_run_once():
+    """One stack boot + one chat request; returns (content, metric deltas
+    for fallbacks/spliced)."""
+    import json
+    import urllib.request
+
+    from p2p_llm_tunnel_tpu.utils.metrics import global_metrics
+
+    body = json.dumps({
+        "messages": [{"role": "user",
+                      "content": "disagg chaos prompt " * 3}],
+        "max_tokens": 6, "stream": False, "seed": 11,
+    }).encode()
+
+    async def main():
+        before_fb = global_metrics.counter("proxy_disagg_fallbacks_total")
+        before_sp = global_metrics.counter("engine_pages_spliced_total")
+        ctx: dict = {}
+        port = await _fabric_stack(ctx)
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+                headers={"content-type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        try:
+            out = await asyncio.to_thread(post)
+        finally:
+            for t in ctx["tasks"]:
+                t.cancel()
+            await asyncio.gather(*ctx["tasks"], return_exceptions=True)
+            for eng in ctx["engines"].values():
+                await eng.stop()
+        return (
+            out["choices"][0]["message"]["content"],
+            global_metrics.counter("proxy_disagg_fallbacks_total")
+            - before_fb,
+            global_metrics.counter("engine_pages_spliced_total")
+            - before_sp,
+        )
+
+    return asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_chaos_kill_prefill_mid_transfer_falls_back_byte_identical(
+    monkeypatch,
+):
+    """ISSUE 20 chaos row (`make chaos`, seeds 5/19): the prefill peer's
+    channel dies on its 3rd send — AGREE, KV_PAGES_HDR, then the kill
+    lands ON the page-chunk frame, mid-transfer.  The decode peer must
+    fall back to local prefill with a client stream byte-identical to the
+    unfaulted stack, and two seeded runs must behave identically."""
+    import os
+
+    seed = int(os.environ.get("CHAOS_TEST_SEED", "5"))
+    monkeypatch.delenv("TUNNEL_CHAOS", raising=False)
+    monkeypatch.delenv("TUNNEL_CHAOS_PEER", raising=False)
+    clean, fb0, sp0 = _chaos_run_once()
+    assert fb0 == 0 and sp0 > 0, "unfaulted stack never handed off"
+
+    monkeypatch.setenv("TUNNEL_CHAOS", f"kill=3,seed={seed}")
+    monkeypatch.setenv("TUNNEL_CHAOS_PEER", "prefill-0")
+    run1 = _chaos_run_once()
+    run2 = _chaos_run_once()
+    assert run1 == run2, "seeded kill schedule was not two-run identical"
+    content, fallbacks, spliced = run1
+    assert spliced == 0, "a mid-kill transfer still spliced pages"
+    assert fallbacks >= 1, "the kill never tripped the fallback path"
+    assert content == clean, "fallback prefill changed the client stream"
+
+
+@pytest.mark.slow
+def test_export_skips_subblock_prompt_and_role_fences():
+    """Sub-block prompts have nothing poolable — export answers None
+    immediately (no 2s residency wait) — and a role!=both engine refuses
+    to exist without its prefix pool (the config fence contract keeps
+    config_fences == [] on every shipping config)."""
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    async def main():
+        eng = InferenceEngine(engine_cfg=_cfg("prefill"))
+        await eng.start()
+        try:
+            await _drain(eng, [1, 2, 3], max_new=1)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            assert await eng.export_kv_pages([1, 2, 3]) is None
+            assert loop.time() - t0 < 1.0  # no residency poll for nothing
+        finally:
+            await eng.stop()
+
+    asyncio.run(main())
+    from p2p_llm_tunnel_tpu.engine.engine import InferenceEngine
+
+    fenced = InferenceEngine(
+        engine_cfg=_cfg("prefill", prefix_cache=False, conv_cache=False)
+    )
+    assert fenced.ecfg.role == "both"
+    assert any(f["knob"] == "role" for f in fenced.config_fences)

@@ -57,7 +57,7 @@ def test_hello_roundtrip():
     assert hello.proto == PROTOCOL_NAME
     assert hello.min_version == 1
     assert hello.max_version == PROTOCOL_VERSION
-    assert hello.features == ["sse", "flow"]
+    assert hello.features == ["sse", "flow", "kvpages"]
 
 
 def test_hello_json_keys():
@@ -213,7 +213,7 @@ def test_iter_body_chunks():
 def test_negotiate_exact_match():
     agree = Agree.from_hello(Hello())
     assert agree.version == PROTOCOL_VERSION
-    assert agree.features == ["sse", "flow"]
+    assert agree.features == ["sse", "flow", "kvpages"]
 
 
 def test_negotiate_overlap_picks_highest():
@@ -248,4 +248,4 @@ def test_hello_defaults():
     assert hello.proto == PROTOCOL_NAME
     assert hello.min_version == 1
     assert hello.max_version == PROTOCOL_VERSION
-    assert hello.features == ["sse", "flow"]
+    assert hello.features == ["sse", "flow", "kvpages"]

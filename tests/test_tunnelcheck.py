@@ -611,6 +611,27 @@ def test_tc05_else_containing_an_if_is_a_default(tmp_path):
     assert active == []
 
 
+def test_tc05_covers_kv_pages_frame_family(tmp_path):
+    """ISSUE 20: a dispatch ladder over the new KV_PAGES_* transfer
+    members is a MessageType dispatch like any other — no default arm,
+    TC05 fires.  Pins that enum growth grows the rule's coverage for
+    free (the exhaustiveness check reads the enum, not a hand list)."""
+    active, _ = check(
+        tmp_path,
+        DISPATCH_PREAMBLE
+        + """
+    if msg.msg_type == MessageType.KV_PAGES_HDR:
+        return "hdr"
+    elif msg.msg_type == MessageType.KV_PAGES_CHUNK:
+        return "chunk"
+    elif msg.msg_type == MessageType.KV_PAGES_END:
+        return "end"
+        """,
+    )
+    assert rules_of(active) == ["TC05"]
+    assert "unhandled" in active[0].message
+
+
 def test_tc05_sees_through_import_aliases(tmp_path):
     active, _ = check(
         tmp_path,
@@ -3535,6 +3556,42 @@ def test_tc20_registries_match_runtime():
     assert hasattr(prefix_cache.PrefixIndex, "export_state")
     for name in rt.TIER_WRITE_CALLS:
         assert callable(getattr(prefix_cache.PrefixIndex, name)), name
+
+
+def test_tc20_send_registry_covers_kv_pages_wire_path():
+    """ISSUE 20 agreement: the KV_PAGES transfer framer the runtime uses
+    to put pool bytes on the wire is a registered TC20 send sink — an
+    unpinned export cannot reach a transfer frame even when the actual
+    ``channel.send`` of the encoded frame lives in another function —
+    and the registered name IS the runtime symbol."""
+    from p2p_llm_tunnel_tpu.protocol import frames
+    from tools.tunnelcheck import rules_tierpin as rt
+
+    assert "kv_pages_chunk" in rt.SEND_CALLS
+    assert callable(getattr(frames.TunnelMessage, "kv_pages_chunk"))
+    for mt in ("KV_PAGES_HDR", "KV_PAGES_CHUNK", "KV_PAGES_END",
+               "KV_PAGES_ACK"):
+        assert hasattr(frames.MessageType, mt)
+
+
+def test_tc20_unpinned_bytes_into_kv_pages_chunk_flag(tmp_path):
+    """Pool bytes that skip verify_page_pin must not enter a KV_PAGES
+    frame: the framer itself is the sink, so the violation lands in the
+    function that builds the frame, not wherever the send happens."""
+    active, _ = check(
+        tmp_path,
+        """
+        from p2p_llm_tunnel_tpu.protocol.frames import TunnelMessage
+
+        def ship(pool, op, sid):
+            raw = op.page_out(pool, 3)
+            return TunnelMessage.kv_pages_chunk(sid, raw)
+        """,
+        filename=SPILL_FIXTURE,
+        rules=["TC20"],
+    )
+    assert rules_of(active) == ["TC20"]
+    assert "send" in active[0].message
 
 
 def test_tc20_engine_and_prefix_cache_self_run():

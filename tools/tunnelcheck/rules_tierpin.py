@@ -341,7 +341,13 @@ TIER_WRITE_CALLS = frozenset({"note_spilled"})
 #: purpose — every transport layer (fabric, chaos wrapper, signaling,
 #: frame clients) exposes ``send``-shaped methods, and the rule only fires
 #: when PAGE-tainted bytes reach one, not on ordinary frame traffic.
-SEND_CALLS = frozenset({"send", "send_bytes", "send_frame"})
+#: ``kv_pages_chunk`` is the KV_PAGES transfer framer (ISSUE 20): pool
+#: bytes entering a transfer frame ARE leaving the process, even when the
+#: ``channel.send`` of the encoded frame lives in a different function —
+#: registering the framer itself keeps the sink at the semantic boundary.
+SEND_CALLS = frozenset({
+    "send", "send_bytes", "send_frame", "kv_pages_chunk",
+})
 
 #: Words in a receiver name that mark ``x.payload`` as a PAGE body rather
 #: than a protocol-frame body (``msg.payload`` is every tunnel message;
